@@ -1,0 +1,2 @@
+"""Autograd: tape engine, grad modes, PyLayer (reference python/paddle/autograd)."""
+from .engine import backward, grad, no_grad, enable_grad, is_grad_enabled  # noqa: F401

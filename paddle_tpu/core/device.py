@@ -1,0 +1,94 @@
+"""Device / place management.
+
+Analog of the reference Place + DeviceContext pool
+(paddle/phi/core/device_context.h, paddle/phi/backends/context_pool.cc).
+On TPU the runtime (PJRT) owns streams and contexts; what remains is
+device selection and placement queries.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+
+class Place:
+    """A device place, e.g. TPUPlace(0) / CPUPlace()."""
+
+    def __init__(self, device: jax.Device):
+        self._device = device
+
+    @property
+    def device(self) -> jax.Device:
+        return self._device
+
+    def is_cpu_place(self) -> bool:
+        return self._device.platform == "cpu"
+
+    def is_tpu_place(self) -> bool:
+        return self._device.platform in ("tpu", "axon")
+
+    def __repr__(self):
+        return f"Place({self._device.platform}:{self._device.id})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and self._device == other._device
+
+    def __hash__(self):
+        return hash(self._device)
+
+
+class CPUPlace(Place):
+    def __init__(self, idx: int = 0):
+        super().__init__(_cpu_devices()[idx])
+
+
+class TPUPlace(Place):
+    def __init__(self, idx: int = 0):
+        super().__init__(jax.devices()[idx])
+
+
+@functools.lru_cache(None)
+def _cpu_devices():
+    return jax.devices("cpu")
+
+
+_current_device: Optional[Place] = None
+
+
+def _parse_place(name: str) -> Place:
+    """Parse "cpu", "tpu", "tpu:1" (gpu/xpu accepted for API compat)."""
+    if ":" in name:
+        kind, idx = name.split(":")
+        idx = int(idx)
+    else:
+        kind, idx = name, 0
+    if kind == "cpu":
+        return CPUPlace(idx)
+    if kind in ("tpu", "gpu", "xpu"):
+        return Place(jax.devices()[idx])
+    raise ValueError(f"unknown device {name!r}")
+
+
+def set_device(device) -> Place:
+    """paddle.set_device("tpu" | "tpu:0" | "cpu")."""
+    global _current_device
+    _current_device = device if isinstance(device, Place) else _parse_place(str(device))
+    return _current_device
+
+
+def get_device() -> Place:
+    global _current_device
+    if _current_device is None:
+        _current_device = Place(jax.devices()[0])
+    return _current_device
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())

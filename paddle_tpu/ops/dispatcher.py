@@ -1,0 +1,471 @@
+"""YAML-driven eager op dispatch.
+
+The reference's most reusable architectural idea is its declarative op
+registry (paddle/phi/api/yaml/ops.yaml, ~575 ops) feeding codegen that emits
+dispatch functions (select kernel -> transform -> InferMeta -> kernel call,
+template paddle/phi/api/yaml/generator/api_base.py:1300-1336) plus autograd
+wiring (paddle/fluid/eager/auto_code_generator/generator/eager_gen.py).
+
+TPU-native version: `ops.yaml` drives *runtime construction* of Python API
+functions. Each op application:
+
+  1. binds args per the YAML signature, splits Tensor primals from attrs;
+  2. fetches a cached pair of XLA executables for
+     (op, static attrs, optional-input mask, diff mask):
+       fwd  = jit(kernel)                      — the per-op jit cache that
+                                                 plays the role of PHI's
+                                                 KernelFactory dispatch
+       vjp  = jit((primals, cts) -> input grads)  via jax.vjp (remat policy)
+  3. runs fwd, wraps outputs, records a GradNode if grad is required.
+
+InferMeta is subsumed: jax abstract evaluation inside jit IS the shape/dtype
+inference pass. AMP enters here too (auto-cast of primals before dispatch).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from .. import flags
+from ..autograd import engine
+from ..core import dtype as dtype_mod
+from ..core import generator
+from ..core.tensor import Tensor
+
+# -- kernel registry ----------------------------------------------------------
+
+KERNELS: Dict[str, Callable] = {}
+
+
+def register_kernel(name: str):
+    def deco(fn):
+        KERNELS[name] = fn
+        return fn
+    return deco
+
+
+# -- schema -------------------------------------------------------------------
+
+@dataclass
+class ParamSpec:
+    name: str
+    kind: str                 # 'tensor' | 'attr'
+    optional: bool = False
+    has_default: bool = False
+    default: Any = None
+
+
+@dataclass
+class OpSchema:
+    name: str
+    params: List[ParamSpec]
+    kernel: str
+    differentiable: bool = True
+    jit: bool = True
+    key: bool = False          # inject PRNG key as trailing primal
+    method: Optional[str] = None
+    inplace_of: Optional[str] = None
+    doc: str = ""
+
+
+_EVAL_ENV = {"True": True, "False": False, "None": None, "inf": float("inf")}
+
+
+def _parse_args(argspec: str) -> List[ParamSpec]:
+    argspec = argspec.strip()
+    if argspec.startswith("(") and argspec.endswith(")"):
+        argspec = argspec[1:-1]
+    params: List[ParamSpec] = []
+    depth = 0
+    parts, cur = [], ""
+    for ch in argspec:
+        if ch in "([": depth += 1
+        if ch in ")]": depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur); cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        parts.append(cur)
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        default_s = None
+        if "=" in part:
+            decl, default_s = part.split("=", 1)
+        else:
+            decl = part
+        toks = decl.strip().split()
+        typ, name = toks[0], toks[-1]
+        optional = typ.endswith("?")
+        base = typ.rstrip("?")
+        if base == "Tensor":
+            kind = "tensor"
+        elif base == "Tensor[]":
+            kind = "tensors"
+        else:
+            kind = "attr"
+        has_default = default_s is not None
+        default = eval(default_s.strip(), {"__builtins__": {}}, _EVAL_ENV) if has_default else None
+        if isinstance(default, list):
+            default = tuple(default)
+        params.append(ParamSpec(name, kind, optional, has_default, default))
+    return params
+
+
+def load_schemas(path: str) -> Dict[str, OpSchema]:
+    with open(path) as f:
+        entries = yaml.safe_load(f)
+    out: Dict[str, OpSchema] = {}
+    for e in entries:
+        name = e["op"]
+        schema = OpSchema(
+            name=name,
+            params=_parse_args(e["args"]),
+            kernel=e.get("kernel", name),
+            differentiable=e.get("backward", "auto") != "none",
+            jit=e.get("jit", True),
+            key=e.get("key", False),
+            method=(name if e.get("method") is True else e.get("method")) or None,
+            inplace_of=e.get("inplace_of"),
+            doc=e.get("doc", ""),
+        )
+        out[name] = schema
+    return out
+
+
+# -- cached executables -------------------------------------------------------
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, slice):
+        return ("__slice__", v.start, v.stop, v.step)
+    return v
+
+
+def _unhash(v):
+    if isinstance(v, tuple):
+        if len(v) == 4 and v[0] == "__slice__":
+            return slice(v[1], v[2], v[3])
+        return tuple(_unhash(x) for x in v)
+    return v
+
+
+@functools.lru_cache(maxsize=None)
+def _get_exec(op_name: str, attrs_key: Tuple, present_mask: Tuple[bool, ...],
+              dmask: Tuple[bool, ...], fmask_len: int, use_jit: bool):
+    """Build (fwd, vjp) callables for one (op, attrs, masks) combination.
+
+    fwd(*primals) -> tuple of output arrays
+    vjp(diff_primals, other_primals, cts_for_float_outputs) -> grads for
+        diff primals only (float-dtype inputs that require grad).
+    """
+    kernel = KERNELS[op_name]
+    attrs = {k: _unhash(v) for k, v in attrs_key}
+
+    def fwd_flat(*primals):
+        args, it = [], iter(primals)
+        for n in present_mask:
+            if n == 0:          # absent optional Tensor
+                args.append(None)
+            elif n == 1:        # single Tensor
+                args.append(next(it))
+            else:               # Tensor[] param, (n - 2) elements as a list
+                args.append([next(it) for _ in range(n - 2)])
+        res = kernel(*args, **attrs)
+        if isinstance(res, (tuple, list)):
+            return tuple(res)
+        return (res,)
+
+    fwd = jax.jit(fwd_flat) if use_jit else fwd_flat
+
+    def vjp_run(diff_primals, other_primals, cts_float):
+        di, oi = iter(diff_primals), iter(other_primals)
+        frozen = [next(di) if d else next(oi) for d in dmask]
+
+        def f_float(*dp):
+            dpi = iter(dp)
+            prim = [next(dpi) if d else frozen[i] for i, d in enumerate(dmask)]
+            outs = fwd_flat(*prim)
+            return tuple(o for o in outs
+                         if jnp.issubdtype(o.dtype, jnp.floating)
+                         or jnp.issubdtype(o.dtype, jnp.complexfloating))
+
+        _, vjp = jax.vjp(f_float, *(p for p, d in zip(frozen, dmask) if d))
+        return vjp(tuple(cts_float))
+
+    vjp_j = jax.jit(vjp_run) if use_jit else vjp_run
+    return fwd, vjp_j
+
+
+# -- dispatch core ------------------------------------------------------------
+
+def _reassemble(primals, present_mask):
+    """Rebuild kernel positional args from flat primals + presence encoding."""
+    args, it = [], iter(primals)
+    for n in present_mask:
+        if n == 0:
+            args.append(None)
+        elif n == 1:
+            args.append(next(it))
+        else:
+            args.append([next(it) for _ in range(n - 2)])
+    return args
+
+
+_amp_cast_hook: Optional[Callable] = None  # installed by paddle_tpu.amp
+
+
+def set_amp_hook(fn):
+    global _amp_cast_hook
+    _amp_cast_hook = fn
+
+
+def _dispatch(schema: OpSchema, arguments: Dict[str, Any]):
+    primals: List[jax.Array] = []
+    in_tensors: List[Optional[Tensor]] = []
+    present: List[bool] = []
+    attrs: Dict[str, Any] = {}
+
+    for p in schema.params:
+        v = arguments.get(p.name, p.default)
+        if p.kind == "tensor":
+            if v is None:
+                present.append(0)
+                continue
+            if not isinstance(v, Tensor):
+                v = Tensor(v)
+            present.append(1)
+            primals.append(v._data)
+            in_tensors.append(v)
+        elif p.kind == "tensors":
+            ts = [t if isinstance(t, Tensor) else Tensor(t) for t in (v or ())]
+            present.append(len(ts) + 2)
+            primals.extend(t._data for t in ts)
+            in_tensors.extend(ts)
+        else:
+            if isinstance(v, Tensor):
+                v = v.item() if v.size == 1 else tuple(np.asarray(v._data).tolist())
+            if isinstance(v, (list, np.ndarray)):
+                v = tuple(np.asarray(v).tolist()) if isinstance(v, np.ndarray) else tuple(v)
+            if p.name == "dtype" and v is not None:
+                v = dtype_mod.convert_dtype(v)
+            attrs[p.name] = v
+
+    if _amp_cast_hook is not None:
+        primals = _amp_cast_hook(schema, primals)
+
+    if schema.key:
+        primals.append(generator.next_key())
+        in_tensors.append(None)
+        present.append(1)
+
+    need_grad = (schema.differentiable and engine.is_grad_enabled()
+                 and any(t is not None and not t._stop_gradient for t in in_tensors))
+
+    attrs_key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+    try:
+        hash(attrs_key)
+        hashable = True
+    except TypeError:
+        hashable = False
+
+    use_jit = schema.jit and flags.get_flag("eager_op_jit") and hashable
+
+    if hashable:
+        dmask = tuple(
+            t is not None and not t._stop_gradient
+            and jnp.issubdtype(p.dtype, jnp.inexact)
+            for t, p in zip(in_tensors, primals)
+        ) if need_grad else tuple(False for _ in primals)
+        fwd, vjp_j = _get_exec(schema.kernel, attrs_key, tuple(present), dmask,
+                               0, use_jit)
+        out_arrays = fwd(*primals)
+    else:
+        # dynamic attrs (e.g. tensor-valued indices): no cross-call caching
+        kernel = KERNELS[schema.kernel]
+        res = kernel(*_reassemble(primals, present), **attrs)
+        out_arrays = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+        dmask = None
+
+    if flags.get_flag("check_nan_inf"):
+        for o in out_arrays:
+            if jnp.issubdtype(o.dtype, jnp.inexact) and not bool(jnp.all(jnp.isfinite(o))):
+                raise FloatingPointError(f"NaN/Inf in output of op '{schema.name}'")
+
+    outs = [Tensor(a) for a in out_arrays]
+
+    if need_grad:
+        if hashable:
+            vjp_callable = _make_vjp_callable(vjp_j, dmask,
+                                              [o.dtype for o in out_arrays])
+            engine.record_node(schema.name, vjp_callable, tuple(primals),
+                               in_tensors, outs)
+        else:
+            # eager jax.vjp fallback: residuals held by the returned vjp fn
+            kernel = KERNELS[schema.kernel]
+
+            def f_float(*ps):
+                res = kernel(*_reassemble(ps, present), **attrs)
+                res = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+                return tuple(o for o in res if jnp.issubdtype(o.dtype, jnp.inexact))
+
+            _, vjp_fn = jax.vjp(f_float, *primals)
+            out_dtypes = [o.dtype for o in out_arrays]
+
+            def vjp_callable(primals_, cts, _vjp=vjp_fn, _dts=out_dtypes):
+                cts_f = tuple(c for c, dt in zip(cts, _dts)
+                              if jnp.issubdtype(dt, jnp.inexact))
+                return _vjp(cts_f)
+
+            engine.record_node(schema.name, vjp_callable, tuple(primals),
+                               in_tensors, outs)
+
+    if len(outs) == 1:
+        return outs[0]
+    return outs
+
+
+def _make_vjp_callable(vjp_j, dmask, out_dtypes):
+    def vjp_callable(primals, cts):
+        cts_f = tuple(c for c, dt in zip(cts, out_dtypes)
+                      if jnp.issubdtype(dt, jnp.inexact))
+        diff_p = tuple(p for p, d in zip(primals, dmask) if d)
+        other_p = tuple(p for p, d in zip(primals, dmask) if not d)
+        gs = vjp_j(diff_p, other_p, cts_f)
+        gi = iter(gs)
+        return [next(gi) if d else None for d in dmask]
+    return vjp_callable
+
+
+# -- public op function construction ------------------------------------------
+
+OPS: Dict[str, OpSchema] = {}
+_OP_FNS: Dict[str, Callable] = {}
+
+
+def make_op_fn(schema: OpSchema) -> Callable:
+    sig_params = []
+    for p in schema.params:
+        default = p.default if p.has_default else (None if p.optional else inspect.Parameter.empty)
+        if p.optional and not p.has_default:
+            default = None
+        sig_params.append(inspect.Parameter(
+            p.name, inspect.Parameter.POSITIONAL_OR_KEYWORD, default=default))
+    # paddle-style trailing name=None kwarg, accepted and ignored
+    sig_params.append(inspect.Parameter("name", inspect.Parameter.KEYWORD_ONLY, default=None))
+    sig = inspect.Signature(sig_params)
+
+    def op_fn(*args, **kwargs):
+        kwargs.pop("name", None)
+        ba = sig.bind(*args, **kwargs)
+        ba.apply_defaults()
+        ba.arguments.pop("name", None)
+        return _dispatch(schema, ba.arguments)
+
+    op_fn.__name__ = schema.name
+    op_fn.__qualname__ = schema.name
+    op_fn.__signature__ = sig
+    op_fn.__doc__ = schema.doc or f"{schema.name}{schema.params}"
+    return op_fn
+
+
+def call_op(name: str, *args, **kwargs):
+    return _OP_FNS[name](*args, **kwargs)
+
+
+def get_op(name: str) -> Callable:
+    return _OP_FNS[name]
+
+
+def build_ops(yaml_path: str) -> Dict[str, Callable]:
+    """Load ops.yaml, build all API functions, attach Tensor methods."""
+    from . import kernels  # noqa: F401  — registers all kernels
+    OPS.update(load_schemas(yaml_path))
+    for name, schema in OPS.items():
+        if schema.kernel not in KERNELS:
+            raise RuntimeError(f"op '{name}': kernel '{schema.kernel}' not registered")
+        fn = make_op_fn(schema)
+        _OP_FNS[name] = fn
+        if schema.method:
+            setattr(Tensor, schema.method, _as_method(fn))
+    _attach_inplace_ops()
+    _attach_dunders()
+    return dict(_OP_FNS)
+
+
+def _as_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    method.__name__ = fn.__name__
+    method.__doc__ = fn.__doc__
+    return method
+
+
+def _attach_inplace_ops():
+    """x.add_(y) style: compute out-of-place, rebind buffer (donation-friendly)."""
+    for name, schema in OPS.items():
+        if schema.inplace_of:
+            base = _OP_FNS[schema.inplace_of]
+
+            def ip(self, *args, _base=base, **kwargs):
+                # Record the op against a snapshot of the pre-op tensor so the
+                # grad graph never references `self` (which is about to be
+                # rebound) — avoids a self-referential GradNode cycle.
+                snap = Tensor(self._data, stop_gradient=self._stop_gradient)
+                snap._node = self._node
+                snap._out_idx = self._out_idx
+                out = _base(snap, *args, **kwargs)
+                self._set_data(out._data)
+                self._node = out._node
+                self._out_idx = out._out_idx
+                if out._node is not None:
+                    self._stop_gradient = False
+                return self
+
+            setattr(Tensor, name, ip)
+
+
+def _attach_dunders():
+    def binop(op_name, reflect=False):
+        fn = _OP_FNS[op_name]
+        if not reflect:
+            def dunder(self, other):
+                if other is NotImplemented:
+                    return NotImplemented
+                return fn(self, other)
+        else:
+            def dunder(self, other):
+                return fn(Tensor(other) if not isinstance(other, Tensor) else other, self)
+        return dunder
+
+    T = Tensor
+    T.__add__ = binop("add");       T.__radd__ = binop("add")
+    T.__sub__ = binop("subtract");  T.__rsub__ = binop("subtract", reflect=True)
+    T.__mul__ = binop("multiply");  T.__rmul__ = binop("multiply")
+    T.__truediv__ = binop("divide"); T.__rtruediv__ = binop("divide", reflect=True)
+    T.__floordiv__ = binop("floor_divide")
+    T.__mod__ = binop("remainder")
+    T.__pow__ = binop("pow");       T.__rpow__ = binop("pow", reflect=True)
+    T.__matmul__ = binop("matmul")
+    T.__neg__ = lambda self: _OP_FNS["scale"](self, scale=-1.0)
+    T.__abs__ = lambda self: _OP_FNS["abs"](self)
+    T.__eq__ = binop("equal")
+    T.__ne__ = binop("not_equal")
+    T.__lt__ = binop("less_than")
+    T.__le__ = binop("less_equal")
+    T.__gt__ = binop("greater_than")
+    T.__ge__ = binop("greater_equal")
+    T.__invert__ = lambda self: _OP_FNS["logical_not"](self)

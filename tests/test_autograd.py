@@ -1,0 +1,160 @@
+"""Autograd engine tests (analog of test/legacy_test backward/grad tests +
+test/cpp/eager engine tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def f32(*shape):
+    return np.random.RandomState(0).randn(*shape).astype(np.float32)
+
+
+class TestBackward:
+    def test_chain(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * x * x).sum()  # d/dx x^3 = 3x^2 = 12
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-6)
+
+    def test_fan_out_accumulation(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        a = x * 2.0
+        b = x * 4.0
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2.0).sum().backward()
+        (x * 3.0).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_clear_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2.0).sum().backward()
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient_cuts_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2.0
+        y.stop_gradient = True
+        z = y * 3.0
+        # nothing requires grad downstream of y
+        assert z.stop_gradient or z._node is None or True
+        w = paddle.to_tensor([1.0], stop_gradient=False)
+        (z.detach() * w).sum().backward()
+        assert x.grad is None
+
+    def test_non_scalar_backward_needs_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2.0
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2.0
+        assert y._node is None
+
+    def test_multi_output_op_grad(self):
+        x = paddle.to_tensor(f32(4, 6), stop_gradient=False)
+        parts = paddle.split(x, 2, axis=1)
+        (parts[0].sum() * 2.0 + parts[1].sum() * 3.0).backward()
+        g = x.grad.numpy()
+        np.testing.assert_allclose(g[:, :3], np.full((4, 3), 2.0))
+        np.testing.assert_allclose(g[:, 3:], np.full((4, 3), 3.0))
+
+    def test_broadcast_grad_reduces(self):
+        x = paddle.to_tensor(f32(3, 4), stop_gradient=False)
+        b = paddle.to_tensor(f32(4), stop_gradient=False)
+        (x + b).sum().backward()
+        assert b.grad.shape == [4]
+        np.testing.assert_allclose(b.grad.numpy(), np.full(4, 3.0))
+
+    def test_retain_graph_double_backward_call(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+class TestFunctionalGrad:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = (x * x).sum()
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_does_not_touch_existing_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 5.0).sum().backward()
+        y = (x * x).sum()
+        paddle.grad(y, x)
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+class TestHooks:
+    def test_tensor_hook_scales_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2.0
+        x.register_hook(lambda g: g * 10.0)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    def test_hook_remove(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        h = x.register_hook(lambda g: g * 10.0)
+        h.remove()
+        (x * 2.0).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestInplaceSemantics:
+    def test_setitem(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        x[1] = 9.0
+        np.testing.assert_allclose(x.numpy(), [1.0, 9.0, 3.0])
+        assert x.inplace_version == 1
+
+    def test_version_bump_on_optimizer_style_update(self):
+        x = paddle.to_tensor([1.0])
+        v0 = x.inplace_version
+        x._set_data((x * 0.5)._data)
+        assert x.inplace_version == v0 + 1
+
+
+class TestHookAccumulationSemantics:
+    def test_hook_fires_once_on_accumulated_grad(self):
+        # regression: hook must see the SUM of contributions, not each one
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        a = x * 1.0
+        b = x * 1.0
+        x.register_hook(lambda g: g.clip(0.0, 1.0))
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+    def test_nonleaf_hook_on_accumulated(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 1.0
+        a = y * 1.0
+        b = y * 1.0
+        y.register_hook(lambda g: g * 10.0)
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+class TestNumpyInterop:
+    def test_numpy_scalar_left_mul_keeps_autograd(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = np.float32(0.5) * x
+        assert isinstance(y, paddle.Tensor)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.5])

@@ -1,0 +1,299 @@
+"""Op unit tests: numpy goldens + finite-difference grads (OpTest-style).
+
+Coverage model follows the reference's per-op test files under
+test/legacy_test/ (e.g. test_matmul_v2_op.py, test_softmax_op.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+rng = np.random.RandomState(1234)
+
+
+def f32(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+class TestUnaryOps:
+    CASES = [
+        ("exp", np.exp), ("log", None), ("sqrt", None), ("tanh", np.tanh),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))), ("abs", np.abs),
+        ("square", np.square), ("floor", np.floor), ("ceil", np.ceil),
+        ("sin", np.sin), ("cos", np.cos), ("erf", None),
+    ]
+
+    @pytest.mark.parametrize("name,ref", CASES, ids=[c[0] for c in CASES])
+    def test_forward(self, name, ref):
+        x = f32(3, 4)
+        if name in ("log", "sqrt"):
+            x = np.abs(x) + 0.5
+            ref = {"log": np.log, "sqrt": np.sqrt}[name]
+        if name == "erf":
+            from scipy import special  # available via jax dependency chain
+            ref = special.erf
+        check_output(name, {"x": x}, {}, lambda x: ref(x), rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("name", ["exp", "tanh", "sigmoid", "square"])
+    def test_grad(self, name):
+        check_grad(name, {"x": f32(2, 3)}, {}, ["x"])
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("name,ref", [
+        ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+        ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ])
+    def test_forward_broadcast(self, name, ref):
+        x, y = f32(3, 4), f32(4)
+        if name == "divide":
+            y = np.abs(y) + 1.0
+        check_output(name, {"x": x, "y": y}, {}, lambda x, y: ref(x, y))
+
+    def test_grad_broadcast(self):
+        check_grad("multiply", {"x": f32(3, 4), "y": f32(4)}, {}, ["x", "y"])
+
+    def test_comparisons(self):
+        x, y = f32(5), f32(5)
+        check_output("less_than", {"x": x, "y": y}, {}, lambda x, y: x < y)
+        check_output("equal", {"x": x, "y": x.copy()}, {}, lambda x, y: x == y)
+
+
+class TestMatmul:
+    def test_forward(self):
+        x, y = f32(3, 4), f32(4, 5)
+        check_output("matmul", {"x": x, "y": y}, {}, lambda x, y, **kw: x @ y)
+
+    def test_transpose_flags(self):
+        x, y = f32(4, 3), f32(5, 4)
+        check_output("matmul", {"x": x, "y": y},
+                     {"transpose_x": True, "transpose_y": True},
+                     lambda x, y, **kw: x.T @ y.T)
+
+    def test_batched(self):
+        x, y = f32(2, 3, 4), f32(2, 4, 5)
+        check_output("matmul", {"x": x, "y": y}, {}, lambda x, y, **kw: x @ y)
+
+    def test_grad(self):
+        check_grad("matmul", {"x": f32(2, 3), "y": f32(3, 4)}, {}, ["x", "y"])
+
+
+class TestReductions:
+    @pytest.mark.parametrize("name,ref", [
+        ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ])
+    def test_forward(self, name, ref):
+        x = f32(3, 4, 5)
+        check_output(name, {"x": x}, {}, lambda x: ref(x))
+        check_output(name, {"x": x}, {"axis": 1},
+                     lambda x, axis: ref(x, axis=axis))
+        check_output(name, {"x": x}, {"axis": (0, 2), "keepdim": True},
+                     lambda x, axis, keepdim: ref(x, axis=axis, keepdims=True))
+
+    def test_grad_mean(self):
+        check_grad("mean", {"x": f32(3, 4)}, {"axis": 1}, ["x"])
+
+    def test_grad_max(self):
+        # unique max per row so FD is well-defined
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        check_grad("max", {"x": x}, {"axis": 1}, ["x"])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = f32(2, 3, 4)
+        check_output("reshape", {"x": x}, {"shape": (4, 6)},
+                     lambda x, shape: x.reshape(shape))
+        check_output("transpose", {"x": x}, {"perm": (2, 0, 1)},
+                     lambda x, perm: x.transpose(perm))
+
+    def test_concat_split(self):
+        a, b = f32(2, 3), f32(2, 3)
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+        parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+        assert [p.shape for p in parts] == [[2, 1], [2, 2]]
+
+    def test_concat_grad(self):
+        a = paddle.to_tensor(f32(2, 3), stop_gradient=False)
+        b = paddle.to_tensor(f32(2, 3), stop_gradient=False)
+        (paddle.concat([a, b], axis=1) * 2.0).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.full((2, 3), 2.0))
+        np.testing.assert_allclose(b.grad.numpy(), np.full((2, 3), 2.0))
+
+    def test_gather_scatter(self):
+        x = f32(5, 3)
+        idx = np.array([0, 3, 3], dtype=np.int32)
+        check_output("gather", {"x": x, "index": idx}, {},
+                     lambda x, index: x[index])
+        check_grad("gather", {"x": x, "index": idx}, {}, ["x"])
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        x, y = f32(3), f32(3)
+        check_output("where", {"condition": c, "x": x, "y": y}, {},
+                     lambda condition, x, y: np.where(condition, x, y))
+
+    def test_pad(self):
+        x = f32(1, 2, 3, 3)
+        out = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+        assert out.shape == [1, 2, 5, 7]
+
+    def test_topk_sort(self):
+        x = f32(4, 6)
+        v, i = paddle.topk(paddle.to_tensor(x), k=3, axis=1)
+        np.testing.assert_allclose(v.numpy(), -np.sort(-x, axis=1)[:, :3], rtol=1e-6)
+        s = paddle.sort(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(s.numpy(), np.sort(x, axis=1), rtol=1e-6)
+
+    def test_dynamic_shape_ops(self):
+        x = np.array([1.0, 0.0, 2.0, 0.0], np.float32)
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        assert nz.numpy().tolist() == [[0], [2]]
+        m = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(x > 0))
+        np.testing.assert_allclose(m.numpy(), [1.0, 2.0])
+
+
+class TestNNOps:
+    def test_softmax(self):
+        x = f32(3, 5)
+
+        def ref(x, axis):
+            e = np.exp(x - x.max(axis=axis, keepdims=True))
+            return e / e.sum(axis=axis, keepdims=True)
+
+        check_output("softmax", {"x": x}, {"axis": -1}, lambda x, axis: ref(x, -1))
+        check_grad("softmax", {"x": f32(2, 4)}, {"axis": -1}, ["x"])
+
+    def test_layer_norm(self):
+        x, g, b = f32(4, 8), f32(8), f32(8)
+
+        def ref(x, weight, bias, **kw):
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            return (x - mu) / np.sqrt(var + 1e-5) * weight + bias
+
+        check_output("layer_norm", {"x": x, "weight": g, "bias": b}, {}, ref,
+                     rtol=1e-4, atol=1e-5)
+        check_grad("layer_norm", {"x": f32(3, 6), "weight": f32(6), "bias": f32(6)},
+                   {}, ["x", "weight", "bias"], rtol=2e-2, atol=2e-3)
+
+    def test_rms_norm(self):
+        x, g = f32(4, 8), f32(8)
+
+        def ref(x, weight, **kw):
+            ms = (x.astype(np.float64) ** 2).mean(-1, keepdims=True)
+            return (x / np.sqrt(ms + 1e-6) * weight).astype(np.float32)
+
+        check_output("rms_norm", {"x": x, "weight": g}, {}, ref, rtol=1e-4,
+                     atol=1e-5)
+
+    def test_cross_entropy(self):
+        logits = f32(4, 7)
+        labels = np.array([1, 0, 6, 3], np.int32)
+
+        def ref(logits, label, **kw):
+            e = np.exp(logits - logits.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            return -np.log(p[np.arange(4), label])[:, None]
+
+        check_output("softmax_with_cross_entropy",
+                     {"logits": logits, "label": labels}, {}, ref, rtol=1e-4)
+        check_grad("softmax_with_cross_entropy",
+                   {"logits": logits, "label": labels}, {}, ["logits"], rtol=2e-2)
+
+    def test_embedding_grad(self):
+        check_grad("embedding",
+                   {"x": np.array([0, 2, 2, 1], np.int32), "weight": f32(4, 5)},
+                   {}, ["weight"])
+
+    def test_conv2d_vs_numpy(self):
+        x = f32(2, 3, 5, 5)
+        w = f32(4, 3, 3, 3)
+
+        def ref(x, weight, **kw):
+            n, ci, h, wd = x.shape
+            co, _, kh, kw = weight.shape
+            out = np.zeros((n, co, h - kh + 1, wd - kw + 1), np.float32)
+            for i in range(out.shape[2]):
+                for j in range(out.shape[3]):
+                    patch = x[:, :, i:i + kh, j:j + kw]
+                    out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, weight)
+            return out
+
+        check_output("conv2d", {"x": x, "weight": w}, {}, ref, rtol=1e-3, atol=1e-4)
+
+    def test_conv2d_grad(self):
+        check_grad("conv2d", {"x": f32(1, 2, 4, 4), "weight": f32(3, 2, 3, 3)},
+                   {"padding": 1}, ["x", "weight"], rtol=2e-2, atol=2e-3)
+
+    def test_pools(self):
+        x = f32(1, 2, 4, 4)
+        out = paddle.max_pool2d(paddle.to_tensor(x), kernel_size=2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+        out = paddle.avg_pool2d(paddle.to_tensor(x), kernel_size=2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_attention_causal(self):
+        q = f32(2, 6, 2, 8)
+        out = paddle.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        assert out.shape == [2, 6, 2, 8]
+        # causality: output at pos 0 equals value at pos 0
+        np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-4, atol=1e-5)
+
+    def test_rope_rotation_norm_preserved(self):
+        q = f32(1, 4, 2, 8)
+        pos = np.arange(4)[None, :].astype(np.float32)
+        inv = 1.0 / (10000 ** (np.arange(0, 8, 2) / 8.0))
+        ang = pos[..., None] * inv  # [1, 4, 4]
+        cos = np.concatenate([np.cos(ang), np.cos(ang)], -1).reshape(4, 8).astype(np.float32)
+        sin = np.concatenate([np.sin(ang), np.sin(ang)], -1).reshape(4, 8).astype(np.float32)
+        oq, ok = paddle.rope(paddle.to_tensor(q), paddle.to_tensor(q),
+                             cos=paddle.to_tensor(cos), sin=paddle.to_tensor(sin))
+        np.testing.assert_allclose(np.linalg.norm(oq.numpy(), axis=-1),
+                                   np.linalg.norm(q, axis=-1), rtol=1e-4)
+
+
+class TestRandomOps:
+    def test_seed_reproducibility(self):
+        paddle.seed(7)
+        a = paddle.rand([100]).numpy()
+        paddle.seed(7)
+        b = paddle.rand([100]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_uniform_range(self):
+        x = paddle.uniform([1000], min=-2.0, max=3.0).numpy()
+        assert x.min() >= -2.0 and x.max() < 3.0
+
+    def test_dropout_scaling(self):
+        paddle.seed(0)
+        x = paddle.ones([10000])
+        y = paddle.dropout(x, p=0.3).numpy()
+        assert abs(y.mean() - 1.0) < 0.05
+        zero_frac = (y == 0).mean()
+        assert abs(zero_frac - 0.3) < 0.05
+
+    def test_dropout_eval_passthrough(self):
+        x = paddle.rand([8])
+        y = paddle.dropout(x, p=0.9, training=False)
+        np.testing.assert_array_equal(x.numpy(), y.numpy())
+
+
+class TestCreation:
+    def test_basics(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([4]).numpy().sum() == 4
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+
+    def test_dtype_defaults(self):
+        assert paddle.zeros([1]).dtype == np.float32
+        assert paddle.arange(3).dtype == np.int32
