@@ -60,5 +60,14 @@ def is_tensor(x):
 
 # -- subpackages ---------------------------------------------------------------
 from . import autograd  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from .framework import save, load  # noqa: E402,F401
+from .nn.layer_base import Parameter  # noqa: E402,F401
+from . import ops  # noqa: E402,F401
 
 __version__ = "0.1.0"

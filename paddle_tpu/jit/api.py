@@ -1,0 +1,248 @@
+"""to_static + TrainStep implementation. See package docstring."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..core import generator
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+@contextlib.contextmanager
+def _swap_state(tensors: List[Tensor], arrays: List[jax.Array]):
+    """Temporarily rebind tensor buffers (to tracers during tracing)."""
+    saved = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    try:
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._data = s
+
+
+@contextlib.contextmanager
+def _traced_rng(key: jax.Array):
+    """Route generator.next_key() through a traced key during tracing so
+    random ops stay random across compiled steps."""
+    gen = generator.default_generator()
+    box = {"key": key}
+    orig = gen.next_key
+
+    def traced_next_key():
+        box["key"], sub = jax.random.split(box["key"])
+        return sub
+
+    gen.next_key = traced_next_key
+    try:
+        yield
+    finally:
+        gen.next_key = orig
+
+
+def _collect_state(layer: Layer) -> Tuple[List[Tensor], List[Tensor]]:
+    params = list(layer.parameters())
+    buffers = [b for _, b in layer.named_buffers()]
+    return params, buffers
+
+
+class StaticFunction:
+    """Result of to_static: a compiled forward with buffer-state threading."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer]):
+        self._fn = fn
+        self._layer = layer
+        self._compiled = None
+        functools.update_wrapper(self, fn, updated=())
+
+    def _build(self):
+        layer = self._layer
+
+        def pure(param_arrays, buffer_arrays, rng, in_arrays, kw_arrays,
+                 static_kwargs):
+            params, buffers = (_collect_state(layer) if layer is not None
+                               else ([], []))
+            with _swap_state(params + buffers, list(param_arrays) + list(buffer_arrays)):
+                with _traced_rng(rng), engine.no_grad():
+                    args = jax.tree.map(Tensor, list(in_arrays))
+                    kwargs = {k: Tensor(v) for k, v in kw_arrays.items()}
+                    out = self._fn(*args, **dict(static_kwargs), **kwargs)
+                    out_arrays = jax.tree.map(
+                        lambda t: t._data if isinstance(t, Tensor) else t, out,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                    new_buf = [b._data for b in buffers]
+            return out_arrays, new_buf
+
+        self._compiled = jax.jit(pure, static_argnums=(5,))
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._build()
+        params, buffers = (_collect_state(self._layer)
+                           if self._layer is not None else ([], []))
+        in_arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in args]
+        kw_arrays = {k: v._data for k, v in kwargs.items() if isinstance(v, Tensor)}
+        static_kwargs = tuple(sorted(
+            (k, v) for k, v in kwargs.items() if not isinstance(v, Tensor)))
+        rng = generator.next_key()
+        out_arrays, new_buf = self._compiled(
+            tuple(p._data for p in params), tuple(b._data for b in buffers),
+            rng, in_arrays, kw_arrays, static_kwargs)
+        for b, nb in zip(buffers, new_buf):
+            b._set_data(nb)
+        return jax.tree.map(Tensor, out_arrays)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              full_graph=True, backend=None):
+    """paddle.jit.to_static (reference jit/api.py:171). Works as decorator or
+    wrapper over a function or a Layer (compiles its forward)."""
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(lambda *a, **k: layer.forward(*a, **k), layer)
+            return _LayerStaticWrapper(layer, sf)
+        return StaticFunction(fn, None)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+class _LayerStaticWrapper:
+    """Callable wrapper: compiled forward + delegation to the Layer."""
+
+    def __init__(self, layer: Layer, sf: StaticFunction):
+        self._layer = layer
+        self._sf = sf
+
+    def __call__(self, *args, **kwargs):
+        return self._sf(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+
+def not_to_static(fn=None):
+    """Marker for functions excluded from tracing (reference jit.not_to_static);
+    tracing is value-transparent here, so this is an identity."""
+    return fn
+
+
+class TrainStep:
+    """Whole-training-step compilation: loss fwd + grads + optimizer update
+    in one donated XLA program.
+
+    train = TrainStep(model, loss_fn, opt)   # loss_fn(model_out..., *labels)
+    loss = train(inputs, labels)
+
+    The optimizer's pure `_update` rule and state are reused, so eager
+    optimizer.step() and compiled TrainStep produce identical updates."""
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 grad_accum: int = 1):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._compiled = None
+        self._step = 0
+
+    def _build(self):
+        from ..nn import clip as clip_mod
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        all_params, buffers = _collect_state(model)
+        params = [p for p in all_params if not p.stop_gradient]   # trainable
+        frozen = [p for p in all_params if p.stop_gradient]
+        # materialize optimizer state eagerly (aligned with trainable params)
+        opt._parameter_list = params
+        opt._states = [None] * len(params)
+        opt._masters = [None] * len(params)
+        for i, p in enumerate(params):
+            master = None
+            if opt._multi_precision and p._data.dtype in (jnp.bfloat16, jnp.float16):
+                master = p._data.astype(jnp.float32)
+            opt._masters[i] = master
+            opt._states[i] = opt._init_state(
+                master if master is not None else p._data)
+        wd = tuple(jnp.asarray(opt._param_weight_decay(i), jnp.float32)
+                   for i in range(len(params)))
+        grad_clip = opt._grad_clip
+
+        def loss_of(param_arrays, frozen_arrays, buffer_arrays, rng, inputs, labels):
+            with _swap_state(params + frozen + buffers,
+                             list(param_arrays) + list(frozen_arrays)
+                             + list(buffer_arrays)):
+                with _traced_rng(rng), engine.no_grad():
+                    t_in = jax.tree.map(Tensor, inputs)
+                    t_lb = jax.tree.map(Tensor, labels)
+                    out = model(*t_in) if isinstance(t_in, (list, tuple)) \
+                        else model(t_in)
+                    outs = out if isinstance(out, (list, tuple)) else (out,)
+                    lbls = t_lb if isinstance(t_lb, (list, tuple)) else (t_lb,)
+                    loss = loss_fn(*outs, *lbls)
+                    new_buf = tuple(b._data for b in buffers)
+            return loss._data.astype(jnp.float32), new_buf
+
+        grad_fn = jax.value_and_grad(loss_of, argnums=0, has_aux=True)
+
+        def step(param_arrays, master_arrays, opt_states, buffer_arrays,
+                 frozen_arrays, rng, inputs, labels, lr, stepno):
+            (loss, new_buf), grads = grad_fn(param_arrays, frozen_arrays,
+                                             buffer_arrays, rng, inputs, labels)
+            if grad_clip is not None:
+                grads = clip_mod.pure_clip(grad_clip, grads)
+            new_params, new_masters, new_states = [], [], []
+            for p, m, s, g, w in zip(param_arrays, master_arrays, opt_states,
+                                     grads, wd):
+                target = m if m is not None else p
+                g = g.astype(target.dtype)
+                np_, ns_ = opt._update(target, g, s, lr, stepno, w)
+                if m is not None:
+                    new_masters.append(np_)
+                    new_params.append(np_.astype(p.dtype))
+                else:
+                    new_masters.append(None)
+                    new_params.append(np_)
+                new_states.append(ns_)
+            return (tuple(new_params), tuple(new_masters), tuple(new_states),
+                    new_buf, loss)
+
+        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        self._params, self._buffers, self._frozen = params, buffers, frozen
+
+    def __call__(self, inputs, labels):
+        if self._compiled is None:
+            self._build()
+        opt = self.optimizer
+        self._step += 1
+        opt._step_count = self._step
+        params, buffers = self._params, self._buffers
+        to_arr = lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t)
+        inputs = jax.tree.map(to_arr, inputs,
+                              is_leaf=lambda x: isinstance(x, Tensor))
+        labels = jax.tree.map(to_arr, labels,
+                              is_leaf=lambda x: isinstance(x, Tensor))
+        new_p, new_m, new_s, new_buf, loss = self._compiled(
+            tuple(p._data for p in params),
+            tuple(opt._masters[i] for i in range(len(params))),
+            tuple(opt._states[i] for i in range(len(params))),
+            tuple(b._data for b in buffers),
+            tuple(f._data for f in self._frozen),
+            generator.next_key(), inputs, labels,
+            jnp.asarray(opt.get_lr(), jnp.float32), self._step)
+        for i, p in enumerate(params):
+            p._set_data(new_p[i])
+            opt._masters[i] = new_m[i]
+            opt._states[i] = new_s[i]
+        for b, nb in zip(buffers, new_buf):
+            b._set_data(nb)
+        return Tensor(loss)

@@ -449,10 +449,12 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
 
 
 @register_kernel("cross_entropy_mean")
-def cross_entropy_mean(logits, label, soft_label=False, ignore_index=-100, axis=-1,
-                       weight=None, reduction="mean"):
+def cross_entropy_mean(logits, label, weight=None, soft_label=False,
+                       ignore_index=-100, axis=-1, reduction="mean"):
     loss = softmax_with_cross_entropy(logits, label, soft_label, ignore_index, axis)
     loss = jnp.squeeze(loss, axis=axis)
+    if not soft_label and label.ndim == logits.ndim and label.shape[axis] == 1:
+        label = jnp.squeeze(label, axis=axis)  # (N,1) hard labels -> (N,)
     if weight is not None and not soft_label:
         w = jnp.take(weight, jnp.where(label == ignore_index, 0, label))
         w = jnp.where(label == ignore_index, 0.0, w)
@@ -471,8 +473,9 @@ def cross_entropy_mean(logits, label, soft_label=False, ignore_index=-100, axis=
 
 @register_kernel("nll_loss")
 def nll_loss(log_prob, label, weight=None, ignore_index=-100, reduction="mean"):
-    nll = -jnp.take_along_axis(log_prob, label[..., None] if label.ndim < log_prob.ndim
-                               else label, axis=-1)
+    if label.ndim == log_prob.ndim and label.shape[-1] == 1:
+        label = jnp.squeeze(label, axis=-1)  # (N,1) -> (N,)
+    nll = -jnp.take_along_axis(log_prob, label[..., None], axis=-1)
     nll = jnp.squeeze(nll, axis=-1)
     mask = (label != ignore_index).astype(log_prob.dtype)
     if weight is not None:
@@ -649,3 +652,22 @@ def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=Tru
         out_k = k * cos + rot(k) * sin
         return out_q, out_k
     return out_q
+
+
+@register_kernel("flash_attention")
+def flash_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                    is_causal=False, scale=None):
+    """Routes to the Pallas flash kernel when enabled (ops/kernels/pallas),
+    else the XLA composite above."""
+    from ... import flags
+    if flags.get_flag("use_pallas_kernels") and attn_mask is None \
+            and dropout_p == 0.0:
+        try:
+            from .pallas import flash_attention as fa
+            return fa.flash_attention(query, key, value, causal=is_causal,
+                                      scale=scale)
+        except ImportError:
+            pass
+    return scaled_dot_product_attention(query, key, value, attn_mask=attn_mask,
+                                        dropout_p=dropout_p, is_causal=is_causal,
+                                        scale=scale)

@@ -1,0 +1,345 @@
+"""Optimizers (reference python/paddle/optimizer/optimizer.py:103 base +
+adamw.py, sgd.py, momentum.py).
+
+TPU-native design: each optimizer defines a pure `_update(param, grad,
+state, lr, ...)` rule; `step()` applies it to the WHOLE parameter pytree in
+ONE jitted XLA program (the analog — and superset — of the reference's
+multi-tensor fused adamw paths, phi/kernels/fusion fused_adam), with fp32
+master weights for low-precision params (multi_precision, reference
+mix_precision_utils).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip: Optional[ClipGradBase] = None,
+                 multi_precision: bool = True, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (list of Tensors)")
+        self._parameter_list = list(parameters)
+        self._lr = learning_rate
+        self._weight_decay = 0.0 if weight_decay is None else float(weight_decay) \
+            if not hasattr(weight_decay, "coeff") else float(weight_decay.coeff)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._apply_decay_param_fun = None  # set by AdamW
+        # per-param optimizer state: list of dicts of jax arrays
+        self._states: List[Optional[Dict]] = [None] * len(self._parameter_list)
+        self._masters: List[Optional[jax.Array]] = [None] * len(self._parameter_list)
+        self._step_count = 0
+
+    def _param_weight_decay(self, i: int) -> float:
+        """Per-param decay coeff honoring apply_decay_param_fun (reference
+        adamw.py: the no-decay-on-bias/norm recipe)."""
+        fn = self._apply_decay_param_fun
+        if fn is not None:
+            p = self._parameter_list[i]
+            name = p.name or f"param_{i}"
+            if not fn(name):
+                return 0.0
+        return self._weight_decay
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("optimizer uses an LRScheduler; call scheduler APIs")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state rules (override) ----------------------------------------------
+    def _init_state(self, param: jax.Array) -> Dict:
+        return {}
+
+    def _update(self, p, g, state, lr, step, wd):
+        """Pure rule: returns (new_p, new_state). `wd` is this param's
+        weight-decay coeff as a traced scalar. Implemented by subclasses."""
+        raise NotImplementedError
+
+    # -- step ----------------------------------------------------------------
+    def step(self):
+        params, grads, idxs = [], [], []
+        for i, p in enumerate(self._parameter_list):
+            if p.grad is None or p.stop_gradient:
+                continue
+            params.append(p)
+            grads.append(p.grad)
+            idxs.append(i)
+        if not params:
+            return
+        if self._grad_clip is not None:
+            pg = self._grad_clip(list(zip(params, grads)))
+            grads = [g for _, g in pg]
+
+        self._step_count += 1
+        lr = self.get_lr()
+
+        # lazily create state + fp32 masters
+        for k, i in enumerate(idxs):
+            p = self._parameter_list[i]
+            if self._states[i] is None:
+                master = None
+                if self._multi_precision and p._data.dtype in (jnp.bfloat16, jnp.float16):
+                    master = p._data.astype(jnp.float32)
+                self._masters[i] = master
+                self._states[i] = self._init_state(
+                    master if master is not None else p._data)
+
+        p_arrays = []
+        for k, i in enumerate(idxs):
+            m = self._masters[i]
+            p_arrays.append(m if m is not None else self._parameter_list[i]._data)
+        g_arrays = tuple(g._data for g in grads)
+        s_pytree = tuple(self._states[i] for i in idxs)
+        wd_arrays = tuple(jnp.asarray(self._param_weight_decay(i), jnp.float32)
+                          for i in idxs)
+
+        new_p, new_s = _apply_pytree_update(
+            self, self._update_static_key(),
+            tuple(p_arrays), g_arrays, s_pytree,
+            jnp.asarray(lr, jnp.float32), self._step_count, wd_arrays)
+
+        for k, i in enumerate(idxs):
+            p = self._parameter_list[i]
+            if self._masters[i] is not None:
+                self._masters[i] = new_p[k]
+                p._set_data(new_p[k].astype(p._data.dtype))
+            else:
+                p._set_data(new_p[k])
+            self._states[i] = new_s[k]
+
+    def _update_static_key(self):
+        """Hashable config that changes the compiled update rule."""
+        return (self._weight_decay,)
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self) -> Dict:
+        out = {"step": self._step_count, "states": self._states,
+               "masters": self._masters}
+        if isinstance(self._lr, LRScheduler):
+            out["lr"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, sd: Dict):
+        from ..core.tensor import Tensor as _T
+
+        def unwrap(x):  # paddle.load rehydrates arrays as Tensor
+            return x._data if isinstance(x, _T) else x
+
+        self._step_count = sd.get("step", 0)
+        states = sd.get("states")
+        if states is not None:
+            self._states = [jax.tree.map(unwrap, s,
+                                         is_leaf=lambda x: isinstance(x, _T))
+                            if s is not None else None for s in states]
+        masters = sd.get("masters")
+        if masters is not None:
+            self._masters = [unwrap(m) for m in masters]
+        if "lr" in sd and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(sd["lr"])
+
+    # -- paddle compat -------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+_JIT_CACHE: Dict = {}
+
+
+def _apply_pytree_update(opt, static_key, p_tuple, g_tuple, s_tuple, lr, step,
+                         wd_tuple):
+    """One XLA program updating every parameter (fused multi-tensor step).
+
+    Cached per optimizer INSTANCE (weakly): the compiled rule closes over the
+    instance's hyperparameters, so sharing across instances would silently
+    reuse stale constants, and a strong ref would pin dead optimizers."""
+    import weakref
+    for k in [k for k, (ref, _) in _JIT_CACHE.items() if ref() is None]:
+        del _JIT_CACHE[k]  # drop rules for collected optimizers
+    cache_key = (id(opt), static_key)
+    ent = _JIT_CACHE.get(cache_key)
+    if ent is None or ent[0]() is not opt:
+        ref = weakref.ref(opt)
+
+        def run(p_tuple, g_tuple, s_tuple, lr, step, wd_tuple):
+            o = ref()
+            outs = [o._update(p, g.astype(p.dtype) if g.dtype != p.dtype else g,
+                              s, lr, step, wd)
+                    for p, g, s, wd in zip(p_tuple, g_tuple, s_tuple, wd_tuple)]
+            return tuple(x[0] for x in outs), tuple(x[1] for x in outs)
+
+        fn = jax.jit(run, donate_argnums=(0, 2))
+        _JIT_CACHE[cache_key] = (ref, fn)
+    else:
+        fn = ent[1]
+    return fn(p_tuple, g_tuple, s_tuple, lr, step, wd_tuple)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update(self, p, g, state, lr, step, wd):
+        g = g + wd.astype(p.dtype) * p
+        return p - lr.astype(p.dtype) * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._momentum, self._nesterov)
+
+    def _init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        g = g + wd.astype(p.dtype) * p
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return p - lr.astype(p.dtype) * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, lazy_mode=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._beta1, self._beta2, self._eps,
+                self._decoupled())
+
+    def _decoupled(self):
+        return False
+
+    def _init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        lr = lr.astype(p.dtype)
+        wd = wd.astype(p.dtype)
+        if not self._decoupled():
+            g = g + wd * p
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        bc1 = 1 - b1 ** step
+        bc2 = 1 - b2 ** step
+        m_hat = m / bc1
+        v_hat = v / bc2
+        upd = m_hat / (jnp.sqrt(v_hat) + eps)
+        if self._decoupled():
+            upd = upd + wd * p
+        return p - lr * upd, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 grad_clip=None, multi_precision=True,
+                 apply_decay_param_fun=None, lr_ratio=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decoupled(self):
+        return True
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._eps, self._init_acc)
+
+    def _init_state(self, param):
+        return {"acc": jnp.full_like(param, self._init_acc)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        g = g + wd.astype(p.dtype) * p
+        acc = state["acc"] + jnp.square(g)
+        return p - lr.astype(p.dtype) * g / (jnp.sqrt(acc) + self._eps), {"acc": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-06,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._rho, self._eps, self._momentum,
+                self._centered)
+
+    def _init_state(self, param):
+        s = {"ms": jnp.zeros_like(param), "mom": jnp.zeros_like(param)}
+        if self._centered:
+            s["mg"] = jnp.zeros_like(param)
+        return s
+
+    def _update(self, p, g, state, lr, step, wd):
+        g = g + wd.astype(p.dtype) * p
+        ms = self._rho * state["ms"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * state["mg"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            new_state = {"ms": ms, "mg": mg}
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+            new_state = {"ms": ms}
+        mom = self._momentum * state["mom"] + lr.astype(p.dtype) * g / denom
+        new_state["mom"] = mom
+        return p - mom, new_state

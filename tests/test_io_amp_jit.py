@@ -1,0 +1,174 @@
+"""DataLoader / AMP / jit.to_static / TrainStep tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def f32(*shape):
+    return np.random.RandomState(0).randn(*shape).astype(np.float32)
+
+
+class TestDataLoader:
+    def test_batching_and_order(self):
+        X = np.arange(10, dtype=np.float32)[:, None]
+        ds = paddle.io.TensorDataset([X])
+        loader = paddle.io.DataLoader(ds, batch_size=3, shuffle=False)
+        batches = [b[0].numpy() for b in loader]
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+        np.testing.assert_array_equal(np.concatenate(batches).ravel(), X.ravel())
+
+    def test_drop_last(self):
+        ds = paddle.io.TensorDataset([np.arange(10, dtype=np.float32)])
+        loader = paddle.io.DataLoader(ds, batch_size=3, drop_last=True)
+        assert len(loader) == 3 and len(list(loader)) == 3
+
+    def test_shuffle_covers_all(self):
+        ds = paddle.io.TensorDataset([np.arange(32, dtype=np.float32)])
+        loader = paddle.io.DataLoader(ds, batch_size=8, shuffle=True)
+        seen = np.sort(np.concatenate([b[0].numpy() for b in loader]))
+        np.testing.assert_array_equal(seen, np.arange(32))
+
+    def test_tuple_samples_collate(self):
+        ds = paddle.io.TensorDataset([f32(6, 2), np.arange(6, dtype=np.int32)])
+        xb, yb = next(iter(paddle.io.DataLoader(ds, batch_size=4)))
+        assert xb.shape == [4, 2] and yb.shape == [4]
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = paddle.io.TensorDataset([np.arange(16, dtype=np.float32)])
+        s0 = paddle.io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                               rank=0)
+        s1 = paddle.io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                               rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert not set(i0) & set(i1)
+        assert len(i0) == len(i1) == 8
+
+    def test_iterable_dataset(self):
+        class Stream(paddle.io.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32(i)
+
+        loader = paddle.io.DataLoader(Stream(), batch_size=3)
+        batches = [b.numpy().tolist() for b in loader]
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+
+
+class TestAMP:
+    def test_o1_casts_matmul_only(self):
+        x = paddle.to_tensor(f32(4, 4))
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            mm = paddle.matmul(x, x)
+            sm = paddle.softmax(x)
+        assert mm.dtype == paddle.bfloat16
+        assert sm.dtype == paddle.float32
+
+    def test_o2_casts_most(self):
+        x = paddle.to_tensor(f32(4, 4))
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            y = paddle.relu(x)
+        assert y.dtype == paddle.bfloat16
+
+    def test_custom_black_list(self):
+        x = paddle.to_tensor(f32(4, 4))
+        with paddle.amp.auto_cast(level="O1", custom_black_list=["matmul"]):
+            mm = paddle.matmul(x, x)
+        assert mm.dtype == paddle.float32
+
+    def test_grad_scaler_fp16_flow(self):
+        w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        loss = (w * 3.0).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        opt.clear_grad()
+        # grad must be unscaled before the step: w = 1 - 0.1*3
+        np.testing.assert_allclose(w.numpy(), [0.7, 0.7], rtol=1e-6)
+
+    def test_grad_scaler_skips_on_inf(self):
+        w = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        loss = (w * np.float32(np.inf)).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+
+
+class TestToStatic:
+    def test_function_compiles_and_matches_eager(self):
+        def f(x, y):
+            return paddle.tanh(paddle.matmul(x, y)) + 1.0
+
+        sf = paddle.jit.to_static(f)
+        x, y = paddle.to_tensor(f32(3, 4)), paddle.to_tensor(f32(4, 5))
+        np.testing.assert_allclose(sf(x, y).numpy(), f(x, y).numpy(), rtol=1e-6)
+
+    def test_layer_compiled_forward(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sm = paddle.jit.to_static(m)
+        x = paddle.to_tensor(f32(3, 4))
+        np.testing.assert_allclose(sm(x).numpy(), m(x).numpy(), rtol=1e-6)
+
+    def test_static_randomness_advances(self):
+        @paddle.jit.to_static
+        def f(x):
+            return paddle.dropout(x, p=0.5)
+
+        x = paddle.to_tensor(np.ones((64,), np.float32))
+        a, b = f(x).numpy(), f(x).numpy()
+        assert not np.array_equal(a, b), "rng must advance across compiled calls"
+
+    def test_buffer_mutation_threads_through(self):
+        bn = nn.BatchNorm1D(4)
+        sbn = paddle.jit.to_static(bn)
+        before = bn._mean.numpy().copy()
+        sbn(paddle.to_tensor(f32(16, 4) + 5.0))
+        after = bn._mean.numpy()
+        assert not np.array_equal(before, after), "running stats must update"
+
+
+class TestTrainStep:
+    def test_matches_eager_training(self):
+        def build():
+            paddle.seed(42)
+            m = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 2))
+            o = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+            return m, o
+
+        X = f32(32, 4)
+        Y = np.random.RandomState(1).randint(0, 2, 32).astype(np.int32)
+        loss_fn = nn.CrossEntropyLoss()
+
+        m1, o1 = build()
+        for _ in range(5):
+            loss = loss_fn(m1(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+
+        m2, o2 = build()
+        train = paddle.jit.TrainStep(m2, loss_fn, o2)
+        for _ in range(5):
+            last = train(paddle.to_tensor(X), paddle.to_tensor(Y))
+
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=2e-3,
+                                       atol=2e-5)
+
+    def test_loss_decreases(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+        o = paddle.optimizer.Adam(learning_rate=5e-3, parameters=m.parameters())
+        train = paddle.jit.TrainStep(m, nn.CrossEntropyLoss(), o)
+        X = f32(64, 8)
+        Y = (X.sum(-1) > 0).astype(np.int32)
+        first = train(paddle.to_tensor(X), paddle.to_tensor(Y)).item()
+        for _ in range(60):
+            last = train(paddle.to_tensor(X), paddle.to_tensor(Y)).item()
+        assert last < first * 0.5

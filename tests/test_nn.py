@@ -1,0 +1,323 @@
+"""nn.Layer / layers / losses tests (reference test/legacy_test
+test_layers.py and per-layer suites)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def f32(*shape):
+    return np.random.RandomState(0).randn(*shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def make(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+                self.act = nn.ReLU()
+                self.register_buffer("counter", paddle.to_tensor([0.0]))
+
+            def forward(self, x):
+                return self.fc2(self.act(self.fc1(x)))
+
+        return M()
+
+    def test_parameter_registry(self):
+        m = self.make()
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        assert all(not p.stop_gradient for p in m.parameters())
+
+    def test_state_dict_roundtrip(self):
+        m = self.make()
+        sd = m.state_dict()
+        assert "counter" in sd and len(sd) == 5
+        m2 = self.make()
+        missing, unexpected = m2.set_state_dict(sd)
+        assert not missing and not unexpected
+        x = paddle.to_tensor(f32(3, 4))
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_train_eval_propagates(self):
+        m = self.make()
+        m.eval()
+        assert all(not l.training for l in m.sublayers(include_self=True))
+        m.train()
+        assert all(l.training for l in m.sublayers(include_self=True))
+
+    def test_to_dtype(self):
+        m = self.make()
+        m.to(dtype="bfloat16")
+        assert all(p.dtype == paddle.bfloat16 for p in m.parameters())
+
+    def test_apply_and_sublayers(self):
+        m = self.make()
+        seen = []
+        m.apply(lambda l: seen.append(type(l).__name__))
+        assert "Linear" in seen and len(seen) == 4
+
+    def test_forward_hooks(self):
+        m = self.make()
+        calls = []
+        h = m.register_forward_post_hook(lambda l, i, o: calls.append(o.shape))
+        m(paddle.to_tensor(f32(2, 4)))
+        assert calls == [[2, 2]]
+        h.remove()
+        m(paddle.to_tensor(f32(2, 4)))
+        assert len(calls) == 1
+
+
+class TestLayers:
+    def test_linear_shapes(self):
+        fc = nn.Linear(5, 7)
+        assert fc.weight.shape == [5, 7] and fc.bias.shape == [7]
+        out = fc(paddle.to_tensor(f32(3, 5)))
+        assert out.shape == [3, 7]
+
+    def test_conv_bn_pool_stack(self):
+        m = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+            nn.MaxPool2D(2), nn.Flatten(), nn.Linear(8 * 4 * 4, 10))
+        out = m(paddle.to_tensor(f32(2, 3, 8, 8)))
+        assert out.shape == [2, 10]
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm2D(4, momentum=0.5)
+        x = paddle.to_tensor(np.random.RandomState(1).randn(8, 4, 5, 5)
+                             .astype(np.float32) * 3 + 1)
+        bn(x)
+        # running mean moved toward batch mean 1
+        assert abs(bn._mean.numpy().mean() - 0.5) < 0.3
+        bn.eval()
+        y = bn(x)
+        assert y.shape == [8, 4, 5, 5]
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor(np.array([0, 1], np.int32)))
+        np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+
+    def test_dropout_respects_mode(self):
+        d = nn.Dropout(0.99)
+        x = paddle.to_tensor(np.ones((100,), np.float32))
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+        d.train()
+        assert (d(x).numpy() == 0).mean() > 0.8
+
+    def test_sequential_and_layerlist(self):
+        s = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 4))
+        assert len(s) == 2 and s[1].weight.shape == [3, 4]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(list(ll)) == 3
+        assert len(nn.Sequential(*ll, nn.ReLU())(paddle.to_tensor(f32(1, 2))).shape) == 2
+
+    def test_mha_shape_and_grad(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(f32(2, 5, 16))
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+        out.sum().backward()
+        assert mha.q_proj.weight.grad is not None
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.to_tensor(f32(2, 6, 16)))
+        assert out.shape == [2, 6, 16]
+        # the two stacked layers must be distinct parameters
+        p = enc.parameters()
+        assert len(p) == 2 * len(layer.parameters())
+
+    def test_clip_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        g1 = paddle.to_tensor(np.full(4, 3.0, np.float32))
+        g2 = paddle.to_tensor(np.full(4, 4.0, np.float32))
+        out = clip([(None, g1), (None, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+class TestOptimizers:
+    def _quad_problem(self, opt_cls, steps=150, **kw):
+        paddle.seed(0)
+        target = np.array([1.0, -2.0, 3.0], np.float32)
+        w = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+        opt = opt_cls(parameters=[w], **kw)
+        for _ in range(steps):
+            loss = ((w - paddle.to_tensor(target)) ** 2.0).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return w.numpy(), target
+
+    def test_sgd(self):
+        w, t = self._quad_problem(paddle.optimizer.SGD, learning_rate=0.1)
+        np.testing.assert_allclose(w, t, atol=1e-3)
+
+    def test_momentum(self):
+        w, t = self._quad_problem(paddle.optimizer.Momentum, learning_rate=0.05)
+        np.testing.assert_allclose(w, t, atol=1e-3)
+
+    def test_adam(self):
+        w, t = self._quad_problem(paddle.optimizer.Adam, learning_rate=0.3)
+        np.testing.assert_allclose(w, t, atol=1e-2)
+
+    def test_adamw_weight_decay_shrinks(self):
+        w = paddle.to_tensor(np.full(3, 5.0, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[w],
+                                     weight_decay=0.5)
+        for _ in range(50):
+            (w * 0.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.all(np.abs(w.numpy()) < 5.0 * 0.9)
+
+    def test_multi_precision_master_weights(self):
+        w = paddle.Parameter(np.ones(4, np.float32))
+        w._set_data(w._data.astype(paddle.bfloat16))
+        opt = paddle.optimizer.SGD(learning_rate=1e-3, parameters=[w],
+                                   multi_precision=True)
+        for _ in range(10):
+            (w * 1.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        # bf16 alone can't represent 1 - 10*1e-3 steps distinctly; master must
+        master = opt._masters[0]
+        assert master is not None
+        np.testing.assert_allclose(np.asarray(master), 1.0 - 0.01, atol=1e-4)
+
+    def test_lr_scheduler_integration(self):
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.1)
+        w = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step(); sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
+
+    def test_optimizer_state_dict_roundtrip(self):
+        w = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w ** 2.0).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        np.testing.assert_allclose(np.asarray(opt2._states[0]["m"]),
+                                   np.asarray(opt._states[0]["m"]))
+
+
+class TestLRSchedulers:
+    def test_cosine(self):
+        s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        s.step(10)
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_linear_warmup(self):
+        s = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                             end_lr=0.1)
+        assert s() == pytest.approx(0.0)
+        s.step(5)
+        assert s() == pytest.approx(0.05)
+        s.step(20)
+        assert s() == pytest.approx(0.1)
+
+    def test_piecewise(self):
+        s = paddle.optimizer.lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+        vals = []
+        for i in range(8):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 0.1 and vals[4] == 0.01 and vals[7] == 0.001
+
+
+class TestReviewRegressions:
+    def test_optimizer_ckpt_through_paddle_save(self, tmp_path):
+        import numpy as np
+        w = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w ** 2.0).sum().backward()
+        opt.step(); opt.clear_grad()
+        p = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), p)
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        opt2.set_state_dict(paddle.load(p))
+        (w ** 2.0).sum().backward()
+        opt2.step()  # must not crash on rehydrated state
+
+    def test_adamw_apply_decay_param_fun(self):
+        import numpy as np
+        m = nn.Linear(4, 4)
+        list(m.named_parameters())  # assign names
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.0, weight_decay=0.5, parameters=m.parameters(),
+            apply_decay_param_fun=lambda n: "bias" not in n)
+        b0 = m.bias.numpy().copy() + 1.0
+        m.bias._set_data((m.bias + 1.0)._data)
+        w0 = m.weight.numpy().copy()
+        (m.weight.sum() * 0.0 + m.bias.sum() * 0.0).backward()
+        opt.step()
+        # lr=0: only decay acts; weight decays via upd, bias must not change
+        np.testing.assert_allclose(m.bias.numpy(), b0, rtol=1e-6)
+
+    def test_trainstep_respects_grad_clip_and_frozen(self):
+        import numpy as np
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        m[0].weight.trainable = False
+        frozen0 = m[0].weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=m.parameters(),
+                                   grad_clip=nn.ClipGradByGlobalNorm(1e-6))
+        train = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32))
+        w0 = m[1].weight.numpy().copy()
+        train(x, y)
+        # frozen param untouched; trainable moved by at most ~clip*lr
+        np.testing.assert_array_equal(m[0].weight.numpy(), frozen0)
+        assert np.abs(m[1].weight.numpy() - w0).max() < 1e-5
+
+    def test_gradscaler_double_unscale_guard(self):
+        import numpy as np
+        w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        scaler.scale((w * 0.5).sum()).backward()
+        scaler.unscale_(opt)   # user clips here
+        scaler.step(opt)       # must NOT unscale again
+        np.testing.assert_allclose(w.numpy(), [0.95, 0.95], rtol=1e-5)
+
+    def test_dataloader_propagates_worker_error(self):
+        import pytest
+
+        class Bad(paddle.io.Dataset):
+            def __len__(self):
+                return 10
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("corrupt sample")
+                import numpy as np
+                return np.float32(i)
+
+        loader = paddle.io.DataLoader(Bad(), batch_size=2)
+        with pytest.raises(ValueError, match="corrupt sample"):
+            list(loader)
+
+    def test_cross_entropy_weight_with_n1_labels(self):
+        import numpy as np
+        logits = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        lab = np.array([[1], [0], [3], [2]], np.int32)
+        w = np.ones(5, np.float32)
+        weighted = paddle.nn.functional.cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(lab),
+            weight=paddle.to_tensor(w))
+        plain = paddle.nn.functional.cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(lab))
+        np.testing.assert_allclose(weighted.item(), plain.item(), rtol=1e-5)
