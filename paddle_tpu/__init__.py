@@ -60,6 +60,8 @@ def is_tensor(x):
 
 # -- subpackages ---------------------------------------------------------------
 from . import autograd  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from .distributed import DataParallel  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import io  # noqa: E402,F401

@@ -126,7 +126,7 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable or self._scale == 1.0:
+        if not self._enable:
             return
         if id(optimizer) in self._unscaled:  # guard against double unscale
             return
@@ -135,11 +135,11 @@ class GradScaler:
         found_inf = False
         for p in optimizer._parameter_list:
             if p.grad is not None:
-                g = p.grad._data * inv
+                g = p.grad._data * inv if inv != 1.0 else p.grad._data
                 if not bool(jnp.all(jnp.isfinite(g))):
                     found_inf = True
                 p.grad._set_data(g)
-        self._found_inf = found_inf
+        self._found_inf = found_inf  # always refreshed, even at scale 1.0
 
     def step(self, optimizer):
         self.unscale_(optimizer)

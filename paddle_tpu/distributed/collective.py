@@ -1,0 +1,218 @@
+"""Collective communication Python API.
+
+Reference: python/paddle/distributed/communication/* (all_reduce.py,
+all_gather.py, ...) over ProcessGroupNCCL (process_group_nccl.cc).
+
+TPU-native semantics: under a single controller, tensors are global objects
+carrying shardings, so SPMD collectives are *implicit* (GSPMD). This API
+exists for (a) reference parity, (b) explicit cross-axis operations on
+sharded eager tensors, where each call lowers to a tiny jitted shard_map
+with the matching jax collective over the named axis — riding ICI exactly
+like the NCCL ring rides NVLink.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from . import env
+from .topology import get_hybrid_communicate_group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a mesh axis name (+ degree)."""
+
+    def __init__(self, axis: str, degree: int, ranks=None):
+        self.axis = axis
+        self.nranks = degree
+        self.ranks = ranks or list(range(degree))
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return env.get_rank()
+
+    @property
+    def world_size(self):
+        return env.get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+
+def init_parallel_env() -> ParallelEnv:
+    """reference parallel.py:943 — rendezvous + proc group bootstrap. The
+    single-controller runtime owns all local devices; multi-host bootstrap is
+    jax.distributed.initialize (launcher wires it)."""
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    return env.get_rank()
+
+
+def get_world_size(group=None) -> int:
+    return env.get_world_size()
+
+
+def new_group(ranks=None, backend=None, axis: str = "dp") -> Group:
+    return Group(axis, len(ranks) if ranks else get_world_size(), ranks)
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def _axis_of(group) -> Optional[str]:
+    if isinstance(group, Group):
+        return group.axis
+    if isinstance(group, str):
+        return group
+    return None
+
+
+def _sharded_axes(t: Tensor):
+    sh = getattr(t._data, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None, []
+    names = []
+    for entry in sh.spec:
+        if entry is None:
+            continue
+        names.extend(entry if isinstance(entry, tuple) else (entry,))
+    return sh, names
+
+
+def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
+               sync_op: bool = True):
+    """On a tensor sharded over the group axis: psum/pmax over that axis and
+    return it replicated (paddle mutates in place — we match that)."""
+    axis = _axis_of(group)
+    sh, axes = _sharded_axes(tensor)
+    target = axis if axis in axes else (axes[0] if axes else None)
+    if target is None:
+        return tensor  # replicated already — allreduce is identity
+    mesh = sh.mesh
+
+    def _prod(x, ax):  # no lax.pprod: gather then reduce locally
+        return jnp.prod(jax.lax.all_gather(x, ax), axis=0)
+
+    reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+               "prod": _prod}[
+        "sum" if op in (ReduceOp.SUM, ReduceOp.AVG) else op]
+
+    in_spec = sh.spec
+    out_spec = PartitionSpec(*[
+        _strip_axis(e, target) for e in _pad_spec(in_spec, tensor.ndim)])
+    fn = jax.jit(jax.shard_map(
+        lambda x: reducer(x, target), mesh=mesh,
+        in_specs=(in_spec,), out_specs=out_spec))
+    out = fn(tensor._data)
+    if op == ReduceOp.AVG:
+        out = out / mesh.shape[target]
+    tensor._set_data(out)
+    return tensor
+
+
+def _pad_spec(spec, ndim):
+    entries = list(spec)
+    return entries + [None] * (ndim - len(entries))
+
+
+def _strip_axis(entry, axis):
+    if entry is None:
+        return None
+    if entry == axis:
+        return None
+    if isinstance(entry, tuple):
+        rest = tuple(e for e in entry if e != axis)
+        return rest if len(rest) > 1 else (rest[0] if rest else None)
+    return entry
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
+    """Gather shards into per-rank tensors (reference all_gather.py)."""
+    sh, axes = _sharded_axes(tensor)
+    if not axes:
+        n = (group.nranks if isinstance(group, Group) else 1)
+        tensor_list.extend(Tensor(tensor._data) for _ in range(max(n, 1)))
+        return tensor_list
+    axis = _axis_of(group) or axes[0]
+    mesh = sh.mesh
+    full = jax.device_put(tensor._data, NamedSharding(
+        mesh, PartitionSpec(*([None] * tensor.ndim))))
+    # split along the tensor dim that was sharded by `axis`
+    dim = 0
+    for d, entry in enumerate(_pad_spec(sh.spec, tensor.ndim)):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if entry is not None and axis in names:
+            dim = d
+            break
+    n = mesh.shape[axis]
+    for piece in jnp.split(full, n, axis=dim):
+        tensor_list.append(Tensor(piece))
+    return tensor_list
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    """Single-controller tensors are already consistent; replicate placement."""
+    sh, axes = _sharded_axes(tensor)
+    if axes:
+        mesh = sh.mesh
+        tensor._set_data(jax.device_put(tensor._data, NamedSharding(
+            mesh, PartitionSpec(*([None] * tensor.ndim)))))
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        stacked = jnp.concatenate([t._data for t in tensor_list], axis=0)
+        tensor._set_data(stacked[: tensor.shape[0]])
+    return tensor
+
+
+def all_to_all(out_tensor_list: List, in_tensor_list: List, group=None,
+               sync_op=True):
+    """Single-controller: transpose of the (rank, chunk) matrix."""
+    n = len(in_tensor_list)
+    for i in range(n):
+        chunks = jnp.split(in_tensor_list[i]._data, n, axis=0)
+        if len(out_tensor_list) < n:
+            out_tensor_list.extend([None] * (n - len(out_tensor_list)))
+    for j in range(n):
+        parts = [jnp.split(in_tensor_list[i]._data, n, axis=0)[j]
+                 for i in range(n)]
+        out_tensor_list[j] = Tensor(jnp.concatenate(parts, axis=0))
+    return out_tensor_list
+
+
+def split(x: Tensor, num_or_sections, axis=0):
+    from ..ops.dispatcher import call_op
+    return call_op("split", x, num_or_sections=num_or_sections, axis=axis)

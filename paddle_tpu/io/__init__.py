@@ -139,10 +139,11 @@ class DistributedBatchSampler(Sampler):
         if self.shuffle:
             rng = np.random.RandomState(self.epoch)
             rng.shuffle(indices)
-        # pad to divide evenly
-        total = int(np.ceil(n / (self.batch_size * self.nranks))) * \
-            self.batch_size * self.nranks
-        if not self.drop_last:
+        step = self.batch_size * self.nranks
+        if self.drop_last:
+            indices = indices[: (n // step) * step]  # equal batches per rank
+        else:
+            total = int(np.ceil(n / step)) * step
             pad = total - n
             if pad:
                 indices = np.concatenate([indices, indices[:pad]])
@@ -152,9 +153,10 @@ class DistributedBatchSampler(Sampler):
 
     def __len__(self):
         n = len(self.data_source)
-        per = n // self.nranks if self.drop_last else int(np.ceil(n / self.nranks))
-        return per // self.batch_size if self.drop_last else \
-            int(np.ceil(per / self.batch_size))
+        step = self.batch_size * self.nranks
+        if self.drop_last:
+            return n // step
+        return int(np.ceil(n / step))
 
 
 class BatchSampler(Sampler):

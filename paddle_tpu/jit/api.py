@@ -54,51 +54,113 @@ def _collect_state(layer: Layer) -> Tuple[List[Tensor], List[Tensor]]:
 
 
 class StaticFunction:
-    """Result of to_static: a compiled forward with buffer-state threading."""
+    """Result of to_static: a compiled forward with buffer-state threading.
+
+    Trainable: the whole compiled forward is recorded as ONE GradNode whose
+    VJP is jax.vjp of the pure function — the analog of the reference's
+    run_program_op grad (paddle/fluid/operators/run_program_op) that makes
+    a to_static sub-program differentiable inside the eager tape."""
 
     def __init__(self, fn: Callable, layer: Optional[Layer]):
         self._fn = fn
         self._layer = layer
         self._compiled = None
+        self._vjp_cache = {}
         functools.update_wrapper(self, fn, updated=())
 
+    def _pure(self, param_arrays, buffer_arrays, rng, in_arrays, kw_arrays,
+              static_kwargs):
+        params, buffers = (_collect_state(self._layer)
+                           if self._layer is not None else ([], []))
+        with _swap_state(params + buffers,
+                         list(param_arrays) + list(buffer_arrays)):
+            with _traced_rng(rng), engine.no_grad():
+                args = jax.tree.map(Tensor, list(in_arrays))
+                kwargs = {k: Tensor(v) for k, v in kw_arrays.items()}
+                out = self._fn(*args, **dict(static_kwargs), **kwargs)
+                out_arrays = jax.tree.map(
+                    lambda t: t._data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+                new_buf = [b._data for b in buffers]
+        return out_arrays, new_buf
+
     def _build(self):
-        layer = self._layer
+        self._compiled = jax.jit(self._pure, static_argnums=(5,))
 
-        def pure(param_arrays, buffer_arrays, rng, in_arrays, kw_arrays,
-                 static_kwargs):
-            params, buffers = (_collect_state(layer) if layer is not None
-                               else ([], []))
-            with _swap_state(params + buffers, list(param_arrays) + list(buffer_arrays)):
-                with _traced_rng(rng), engine.no_grad():
-                    args = jax.tree.map(Tensor, list(in_arrays))
-                    kwargs = {k: Tensor(v) for k, v in kw_arrays.items()}
-                    out = self._fn(*args, **dict(static_kwargs), **kwargs)
-                    out_arrays = jax.tree.map(
-                        lambda t: t._data if isinstance(t, Tensor) else t, out,
-                        is_leaf=lambda x: isinstance(x, Tensor))
-                    new_buf = [b._data for b in buffers]
-            return out_arrays, new_buf
+    def _get_vjp(self, pmask, imask, static_kwargs):
+        key = (pmask, imask, static_kwargs)
+        fn = self._vjp_cache.get(key)
+        if fn is None:
+            def vjp_run(diff_primals, param_arrays, buffer_arrays, rng,
+                        in_arrays, kw_arrays, cts_f):
+                def f(*dp):
+                    it = iter(dp)
+                    pa = [next(it) if m else a
+                          for a, m in zip(param_arrays, pmask)]
+                    ia = [next(it) if m else a
+                          for a, m in zip(in_arrays, imask)]
+                    outs, _ = self._pure(pa, buffer_arrays, rng, ia, kw_arrays,
+                                         static_kwargs)
+                    flat = jax.tree.leaves(outs)
+                    return tuple(o for o in flat
+                                 if jnp.issubdtype(o.dtype, jnp.inexact))
 
-        self._compiled = jax.jit(pure, static_argnums=(5,))
+                _, vjp = jax.vjp(f, *diff_primals)
+                return vjp(tuple(cts_f))
+
+            fn = jax.jit(vjp_run)
+            self._vjp_cache[key] = fn
+        return fn
 
     def __call__(self, *args, **kwargs):
         if self._compiled is None:
             self._build()
         params, buffers = (_collect_state(self._layer)
                            if self._layer is not None else ([], []))
+        in_tensors = [a if isinstance(a, Tensor) else None for a in args]
         in_arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
                      for a in args]
-        kw_arrays = {k: v._data for k, v in kwargs.items() if isinstance(v, Tensor)}
+        kw_arrays = {k: v._data for k, v in kwargs.items()
+                     if isinstance(v, Tensor)}
         static_kwargs = tuple(sorted(
             (k, v) for k, v in kwargs.items() if not isinstance(v, Tensor)))
         rng = generator.next_key()
+        param_arrays = tuple(p._data for p in params)
+        buffer_arrays = tuple(b._data for b in buffers)
         out_arrays, new_buf = self._compiled(
-            tuple(p._data for p in params), tuple(b._data for b in buffers),
-            rng, in_arrays, kw_arrays, static_kwargs)
+            param_arrays, buffer_arrays, rng, in_arrays, kw_arrays,
+            static_kwargs)
         for b, nb in zip(buffers, new_buf):
             b._set_data(nb)
-        return jax.tree.map(Tensor, out_arrays)
+        out = jax.tree.map(Tensor, out_arrays)
+
+        # -- autograd wiring: one node for the whole compiled program --------
+        if engine.is_grad_enabled():
+            pmask = tuple(not p.stop_gradient for p in params)
+            imask = tuple(t is not None and not t.stop_gradient
+                          and jnp.issubdtype(t.dtype, jnp.inexact)
+                          for t in in_tensors)
+            if any(pmask) or any(imask):
+                node_parents = [p for p, m in zip(params, pmask) if m] + \
+                    [t for t, m in zip(in_tensors, imask) if m]
+                diff_primals = tuple(a for a, m in zip(param_arrays, pmask) if m) \
+                    + tuple(a for a, m in zip(in_arrays, imask) if m)
+                out_leaves = [t for t in jax.tree.leaves(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))]
+                out_dtypes = [t.dtype for t in out_leaves]
+                vjp_fn = self._get_vjp(pmask, imask, static_kwargs)
+
+                def vjp_callable(primals, cts, _saved=(param_arrays,
+                                                       buffer_arrays, rng,
+                                                       in_arrays, kw_arrays)):
+                    cts_f = [c for c, dt in zip(cts, out_dtypes)
+                             if jnp.issubdtype(dt, jnp.inexact)]
+                    return vjp_fn(primals, _saved[0], _saved[1], _saved[2],
+                                  _saved[3], _saved[4], cts_f)
+
+                engine.record_node("to_static", vjp_callable, diff_primals,
+                                   node_parents, out_leaves)
+        return out
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
@@ -153,7 +215,11 @@ class TrainStep:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.grad_accum = int(grad_accum)
         self._compiled = None
+        self._accum_fn = None
+        self._accum = None      # grad accumulation buffers
+        self._micro = 0         # micro-batch counter within the accum window
         self._step = 0
 
     def _build(self):
@@ -162,17 +228,25 @@ class TrainStep:
         all_params, buffers = _collect_state(model)
         params = [p for p in all_params if not p.stop_gradient]   # trainable
         frozen = [p for p in all_params if p.stop_gradient]
-        # materialize optimizer state eagerly (aligned with trainable params)
+        # align optimizer state with trainable params, PRESERVING any
+        # previously loaded/accumulated state (checkpoint resume)
+        old = {id(p): (opt._states[i], opt._masters[i])
+               for i, p in enumerate(opt._parameter_list)
+               if i < len(opt._states)}
         opt._parameter_list = params
-        opt._states = [None] * len(params)
-        opt._masters = [None] * len(params)
-        for i, p in enumerate(params):
-            master = None
-            if opt._multi_precision and p._data.dtype in (jnp.bfloat16, jnp.float16):
-                master = p._data.astype(jnp.float32)
-            opt._masters[i] = master
-            opt._states[i] = opt._init_state(
-                master if master is not None else p._data)
+        states, masters = [], []
+        for p in params:
+            s, m = old.get(id(p), (None, None))
+            if s is None:
+                m = None
+                if opt._multi_precision and p._data.dtype in (jnp.bfloat16,
+                                                              jnp.float16):
+                    m = p._data.astype(jnp.float32)
+                s = opt._init_state(m if m is not None else p._data)
+            states.append(s)
+            masters.append(m)
+        opt._states, opt._masters = states, masters
+        self._step = opt._step_count
         wd = tuple(jnp.asarray(opt._param_weight_decay(i), jnp.float32)
                    for i in range(len(params)))
         grad_clip = opt._grad_clip
@@ -193,11 +267,24 @@ class TrainStep:
             return loss._data.astype(jnp.float32), new_buf
 
         grad_fn = jax.value_and_grad(loss_of, argnums=0, has_aux=True)
+        n_accum = self.grad_accum
 
-        def step(param_arrays, master_arrays, opt_states, buffer_arrays,
+        if n_accum > 1:
+            def accum_step(accum, param_arrays, frozen_arrays, buffer_arrays,
+                           rng, inputs, labels):
+                (loss, new_buf), grads = grad_fn(param_arrays, frozen_arrays,
+                                                 buffer_arrays, rng, inputs,
+                                                 labels)
+                return tuple(a + g for a, g in zip(accum, grads)), new_buf, loss
+
+            self._accum_fn = jax.jit(accum_step, donate_argnums=(0,))
+
+        def step(accum, param_arrays, master_arrays, opt_states, buffer_arrays,
                  frozen_arrays, rng, inputs, labels, lr, stepno):
             (loss, new_buf), grads = grad_fn(param_arrays, frozen_arrays,
                                              buffer_arrays, rng, inputs, labels)
+            if n_accum > 1:
+                grads = tuple((a + g) / n_accum for a, g in zip(accum, grads))
             if grad_clip is not None:
                 grads = clip_mod.pure_clip(grad_clip, grads)
             new_params, new_masters, new_states = [], [], []
@@ -216,22 +303,40 @@ class TrainStep:
             return (tuple(new_params), tuple(new_masters), tuple(new_states),
                     new_buf, loss)
 
-        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
         self._params, self._buffers, self._frozen = params, buffers, frozen
 
     def __call__(self, inputs, labels):
         if self._compiled is None:
             self._build()
         opt = self.optimizer
-        self._step += 1
-        opt._step_count = self._step
         params, buffers = self._params, self._buffers
         to_arr = lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t)
         inputs = jax.tree.map(to_arr, inputs,
                               is_leaf=lambda x: isinstance(x, Tensor))
         labels = jax.tree.map(to_arr, labels,
                               is_leaf=lambda x: isinstance(x, Tensor))
+
+        if self.grad_accum > 1 and self._accum is None:
+            self._accum = tuple(jnp.zeros(p._data.shape, p._data.dtype)
+                                for p in params)
+
+        if self.grad_accum > 1 and self._micro < self.grad_accum - 1:
+            # accumulation-only micro-step: no optimizer update
+            self._accum, new_buf, loss = self._accum_fn(
+                self._accum, tuple(p._data for p in params),
+                tuple(f._data for f in self._frozen),
+                tuple(b._data for b in buffers),
+                generator.next_key(), inputs, labels)
+            for b, nb in zip(buffers, new_buf):
+                b._set_data(nb)
+            self._micro += 1
+            return Tensor(loss)
+
+        self._step += 1
+        opt._step_count = self._step
         new_p, new_m, new_s, new_buf, loss = self._compiled(
+            self._accum if self.grad_accum > 1 else (),
             tuple(p._data for p in params),
             tuple(opt._masters[i] for i in range(len(params))),
             tuple(opt._states[i] for i in range(len(params))),
@@ -245,4 +350,6 @@ class TrainStep:
             opt._states[i] = new_s[i]
         for b, nb in zip(buffers, new_buf):
             b._set_data(nb)
+        self._accum = None
+        self._micro = 0
         return Tensor(loss)

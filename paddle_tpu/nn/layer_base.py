@@ -56,6 +56,8 @@ class Layer:
         elif isinstance(value, Layer):
             self._sub_layers[name] = value
             self.__dict__.pop(name, None)
+        elif name in self._buffers and isinstance(value, Tensor):
+            self._buffers[name] = value  # rebinding a registered buffer
         else:
             # plain assignment (including rebinding a registered name)
             for reg in (self._parameters, self._buffers, self._sub_layers):

@@ -592,7 +592,7 @@ def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
 # -- attention & rope ---------------------------------------------------------
 
 @register_kernel("scaled_dot_product_attention")
-def scaled_dot_product_attention(query, key, value, attn_mask=None,
+def scaled_dot_product_attention(query, key, value, attn_mask=None, rng_key=None,
                                  dropout_p=0.0, is_causal=False, scale=None):
     """Reference composite path (paddle/phi/kernels/gpu/flash_attn_kernel.cu
     dispatches to the flash-attn lib; the Pallas override lives in
@@ -620,6 +620,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         else:
             logits = logits + attn_mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and rng_key is not None:
+        keep = 1.0 - dropout_p
+        mask_d = jax.random.bernoulli(rng_key, keep, probs.shape)
+        probs = jnp.where(mask_d, probs / keep, 0.0).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return jnp.swapaxes(out, 1, 2)
 
@@ -655,8 +659,8 @@ def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=Tru
 
 
 @register_kernel("flash_attention")
-def flash_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                    is_causal=False, scale=None):
+def flash_attention(query, key, value, attn_mask=None, rng_key=None,
+                    dropout_p=0.0, is_causal=False, scale=None):
     """Routes to the Pallas flash kernel when enabled (ops/kernels/pallas),
     else the XLA composite above."""
     from ... import flags
@@ -669,5 +673,5 @@ def flash_attention(query, key, value, attn_mask=None, dropout_p=0.0,
         except ImportError:
             pass
     return scaled_dot_product_attention(query, key, value, attn_mask=attn_mask,
-                                        dropout_p=dropout_p, is_causal=is_causal,
-                                        scale=scale)
+                                        rng_key=rng_key, dropout_p=dropout_p,
+                                        is_causal=is_causal, scale=scale)

@@ -172,3 +172,98 @@ class TestTrainStep:
         for _ in range(60):
             last = train(paddle.to_tensor(X), paddle.to_tensor(Y)).item()
         assert last < first * 0.5
+
+
+class TestReviewRegressions2:
+    def test_to_static_model_is_trainable(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        sm = paddle.jit.to_static(m)
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=m.parameters())
+        x = paddle.to_tensor(f32(16, 4))
+        y = paddle.to_tensor(f32(16, 2))
+        first = last = None
+        for _ in range(30):
+            loss = nn.MSELoss()(sm(x), y)
+            loss.backward()
+            opt.step(); opt.clear_grad()
+            if first is None: first = loss.item()
+            last = loss.item()
+        assert m[0].weight.grad is None  # cleared
+        assert last < first * 0.5, (first, last)
+
+    def test_to_static_grad_matches_eager(self):
+        m = nn.Linear(3, 3)
+        sm = paddle.jit.to_static(m)
+        x = paddle.to_tensor(f32(5, 3))
+        sm(x).sum().backward()
+        g_static = m.weight.grad.numpy().copy()
+        m.weight.clear_grad(); m.bias.clear_grad()
+        m(x).sum().backward()
+        np.testing.assert_allclose(g_static, m.weight.grad.numpy(), rtol=1e-5)
+
+    def test_trainstep_preserves_loaded_optimizer_state(self):
+        m = nn.Linear(2, 2)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=m.parameters())
+        # accumulate some state eagerly
+        nn.MSELoss()(m(paddle.to_tensor(f32(4, 2))),
+                     paddle.to_tensor(f32(4, 2))).backward()
+        opt.step(); opt.clear_grad()
+        m_before = np.asarray(opt._states[0]["m"]).copy()
+        assert np.abs(m_before).max() > 0
+        train = paddle.jit.TrainStep(m, nn.MSELoss(), opt)
+        train._build()
+        np.testing.assert_array_equal(np.asarray(opt._states[0]["m"]), m_before)
+
+    def test_trainstep_grad_accum(self):
+        def build():
+            paddle.seed(7)
+            m = nn.Linear(4, 2)
+            o = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters(),
+                                     multi_precision=False)
+            return m, o
+        X1, X2 = f32(8, 4), f32(8, 4) + 1.0
+        Y1, Y2 = f32(8, 2), f32(8, 2)
+        # reference: single step on mean of the two micro-batch grads
+        m1, o1 = build()
+        l1 = nn.MSELoss()(m1(paddle.to_tensor(X1)), paddle.to_tensor(Y1))
+        l2 = nn.MSELoss()(m1(paddle.to_tensor(X2)), paddle.to_tensor(Y2))
+        ((l1 + l2) / 2.0).backward()
+        o1.step()
+        # grad_accum=2 TrainStep
+        m2, o2 = build()
+        train = paddle.jit.TrainStep(m2, nn.MSELoss(), o2, grad_accum=2)
+        train(paddle.to_tensor(X1), paddle.to_tensor(Y1))
+        train(paddle.to_tensor(X2), paddle.to_tensor(Y2))
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mha_dropout_active_in_train(self):
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.9)
+        x = paddle.to_tensor(f32(1, 6, 8))
+        mha.train()
+        a = mha(x).numpy()
+        mha.eval()
+        b = mha(x).numpy()
+        assert not np.allclose(a, b), "train-mode attention dropout must act"
+
+    def test_gradscaler_recovers_at_scale_1(self):
+        w = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0,
+                                       decr_every_n_nan_or_inf=1)
+        # drive scale to 1.0 with an inf grad
+        scaler.scale((w * np.float32(np.inf)).sum()).backward()
+        scaler.step(opt); opt.clear_grad()
+        assert scaler.get_loss_scaling() == 1.0
+        # now a finite step must actually update w
+        scaler.scale((w * 3.0).sum()).backward()
+        scaler.step(opt); opt.clear_grad()
+        np.testing.assert_allclose(w.numpy(), [0.7], rtol=1e-6)
+
+    def test_buffer_rebind_stays_registered(self):
+        bn = nn.BatchNorm1D(4)
+        bn._mean = paddle.zeros([4])
+        assert "_mean" in dict(bn.named_buffers())
+        assert "_mean" in bn.state_dict()
