@@ -1,0 +1,105 @@
+"""Headline benchmark: Llama pretrain step throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric (BASELINE.json): Llama pretrain MFU (target 40% on v5p).
+We run a scaled Llama (same arch as Llama-3, sized for one chip), compile
+the full train step (fwd+bwd+AdamW, bf16 params + fp32 master), and report
+model FLOPs utilisation: 6 * params * tokens/sec / peak_flops.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+# bf16 peak FLOP/s per chip by TPU generation
+_PEAK = {
+    "v4": 275e12,
+    "v5e": 197e12, "v5 lite": 197e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v6e": 918e12, "trillium": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in sorted(_PEAK.items(), key=lambda kv: -len(kv[0])):
+        if k in kind:
+            return v
+    return 275e12  # conservative default (v4)
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        # sized for one v5e chip (16G HBM): ~210M params, bf16 + fp32 master
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            dtype="bfloat16", recompute=True)
+        batch, seq, steps = 4, 2048, 10
+        paddle.set_default_dtype("bfloat16")
+    else:  # smoke path for dev boxes
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 2, 64, 3
+
+    model = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                 parameters=model.parameters())
+    train = TrainStep(model, lambda logits, labels: crit(logits, labels), opt)
+
+    n_params = sum(
+        int(p._data.size) for p in model.parameters())
+    ids = Tensor(jnp.asarray(
+        (jnp.arange(batch * seq) % cfg.vocab_size).reshape(batch, seq),
+        dtype=jnp.int32))
+
+    loss = train((ids,), (ids,))  # compile + warmup
+    jax.block_until_ready(loss._data)
+    loss = train((ids,), (ids,))
+    jax.block_until_ready(loss._data)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train((ids,), (ids,))
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_per_token = 6 * n_params  # fwd 2N + bwd 4N
+    mfu = tokens_per_sec * flops_per_token / _peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu_1chip",
+        "value": round(mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+            "params": n_params,
+            "device": getattr(dev, "device_kind", str(dev)),
+            "batch": batch, "seq": seq,
+            "final_loss": float(loss._data),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

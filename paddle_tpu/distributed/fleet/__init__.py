@@ -75,10 +75,6 @@ def init(role_maker=None, is_collective: bool = False,
     return hcg
 
 
-def get_hybrid_communicate_group_():
-    return get_hybrid_communicate_group()
-
-
 def get_strategy() -> Optional[DistributedStrategy]:
     return _fleet_strategy
 

@@ -646,8 +646,8 @@ def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=Tru
     if position_ids is not None:
         cos = jnp.take(cos.reshape(cos.shape[-2], cos.shape[-1]), position_ids, axis=0)
         sin = jnp.take(sin.reshape(sin.shape[-2], sin.shape[-1]), position_ids, axis=0)
-        cos = cos[:, :, None, :]
-        sin = sin[:, :, None, :]
+        cos = cos[:, :, None, :].astype(q.dtype)
+        sin = sin[:, :, None, :].astype(q.dtype)
     else:
         cos = bshape(cos, q)
         sin = bshape(sin, q)
