@@ -125,12 +125,23 @@ class _ReplicatedModelWrapper(Layer):
 
 
 def distributed_model(model: Layer) -> Layer:
-    """fleet.distributed_model (reference fleet/model.py:32): wrap by
-    strategy. TP layers are already mesh-sharded at construction; the wrapper
-    adds data-axis input sharding and replicates any unplaced params."""
+    """fleet.distributed_model (reference fleet/model.py:32,141-160): wrap by
+    strategy — PipelineParallel / SegmentParallel / TensorParallel /
+    ShardingParallel / DataParallel. TP layers are already mesh-sharded at
+    construction; wrappers add input placement (and for PP, the schedule)."""
     hcg = get_hybrid_communicate_group()
     if hcg is None:
         raise RuntimeError("call fleet.init(is_collective=True) first")
+    from .meta_parallel import PipelineParallel, SegmentParallel
+    from .pp_layers import PipelineLayer
+    # non-PipelineLayer models handle pp internally (e.g. Llama's pipelined
+    # LayerStack) and only need the input-sharding wrapper
+    if isinstance(model, PipelineLayer):
+        return PipelineParallel(_ReplicatedModelWrapper(model, hcg), hcg,
+                                _fleet_strategy)
+    if hcg.get_sep_parallel_world_size() > 1:
+        return SegmentParallel(_ReplicatedModelWrapper(model, hcg), hcg,
+                               _fleet_strategy)
     return _ReplicatedModelWrapper(model, hcg)
 
 

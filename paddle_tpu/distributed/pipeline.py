@@ -1,0 +1,202 @@
+"""SPMD pipeline-parallel engine: microbatch rotation over the `pp` mesh axis.
+
+Reference counterpart: the dygraph pipeline runtime
+(`fleet/meta_parallel/pipeline_parallel.py:150,440` 1F1B,
+`:906` interleaved VPP) built on point-to-point isend/irecv between stage
+processes (`pp_utils/p2p_communication.py:313`), plus the static-graph
+FThenB/1F1B schedule passes (`passes/pipeline_scheduler_pass.py:47-465`).
+
+TPU-first redesign: inside a TPU slice there are no independent per-stage
+processes — the schedule must compile into ONE program (SURVEY.md §7
+"Hard parts"). The engine expresses the pipeline as a `lax.scan` over
+`M + S - 1` ticks inside `jax.shard_map` over the `pp` axis:
+
+- each device holds its stage's parameters (the LayerStack leading axis
+  reshaped [S, layers_per_stage, ...] and sharded over `pp`),
+- activations rotate stage->stage+1 with `lax.ppermute` (ICI
+  collective-permute; the p2p isend/irecv analog),
+- stage 0 feeds microbatch t at tick t; the last stage's outputs are
+  collected ticks S-1..T-1; all other positions compute bubble garbage that
+  never reaches an output (same wall-clock as an idle bubble),
+- backward is jax AD through the scan: the transposed program rotates
+  gradients stage->stage-1, which IS the 1F1B cooldown; `jax.checkpoint`
+  around the block bounds live activation memory to one microbatch per
+  stage per in-flight tick.
+
+Other mesh axes (dp/mp/sharding/sep) stay in GSPMD "auto" mode inside the
+shard_map body, so tensor-parallel layers keep working within a stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+_ENGINE_CACHE: dict = {}
+
+
+def pipeline_scan(block_apply: Callable[..., jax.Array],
+                  stacked: Sequence[jax.Array],
+                  x_mb: jax.Array,
+                  shared: tuple,
+                  mesh: Mesh,
+                  num_stages: int,
+                  num_micro: int,
+                  remat: bool = True,
+                  rng_key: jax.Array = None,
+                  cache_key=None) -> jax.Array:
+    """Run the pipelined stack.
+
+    block_apply(leaves, x, shared, key) -> y : one block, pure.
+    stacked: leaves [L, ...] (L = num_stages * layers_per_stage); their
+    leading axis should live pp-sharded at rest (LayerStack does this) —
+    the engine constrains only the stage axis and leaves block dims
+    UNCONSTRAINED so mp/TP shardings propagate from the inputs.
+    x_mb: [M, mb, ...] microbatched activations (post-embedding).
+    Returns [M, mb, ...] outputs (replicated over pp).
+    """
+    S, M = num_stages, num_micro
+    L = stacked[0].shape[0]
+    assert L % S == 0, f"{L} layers not divisible by {S} stages"
+    if rng_key is None:
+        rng_key = jax.random.key(0)
+
+    # cache compiled engines on the owning object (usually the LayerStack)
+    # so their lifetime matches the model's — no global leak, no id reuse
+    owner = getattr(block_apply, "__self__", None)
+    key = (mesh, S, M, remat)
+    if owner is not None:
+        cache = owner.__dict__.setdefault("_pipeline_engine_cache", {})
+    else:
+        cache = _ENGINE_CACHE
+        key = (cache_key, mesh, S, M, remat)
+    fn = cache.get(key)
+    if fn is None:
+        fn = _build_engine(block_apply, mesh, S, M, remat)
+        cache[key] = fn
+    return fn(tuple(stacked), x_mb, shared, rng_key)
+
+
+def _build_engine(block_apply, mesh, S, M, remat):
+    T = M + S - 1
+    U = P.UNCONSTRAINED
+
+    def stage_fn(my_leaves, x, shared, key):
+        """Apply this stage's nl blocks (leaves [nl, ...])."""
+        def body(carry, leaves):
+            xx, k = carry
+            k, sub = jax.random.split(k)
+            return (block_apply(leaves, xx, shared, sub), k), None
+
+        if remat:
+            body = jax.checkpoint(body)
+        (y, _), _ = jax.lax.scan(body, (x, key), my_leaves)
+        return y
+
+    def pipelined(leaves, x_mb, shared, rng_key):
+        # per-device view: leaves [1, nl, ...]; x_mb full (pp-replicated)
+        my = tuple(l[0] for l in leaves)
+        stage = jax.lax.axis_index("pp")
+        mb_shape = x_mb.shape[1:]
+        state0 = jnp.zeros(mb_shape, x_mb.dtype)
+        outs0 = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        key0 = jax.random.fold_in(rng_key, stage)
+
+        def tick(carry, t):
+            state, outs = carry
+            inp = jnp.where(stage == 0,
+                            x_mb[jnp.clip(t, 0, M - 1)], state)
+            y = stage_fn(my, inp, shared, jax.random.fold_in(key0, t))
+            # rotate to the next stage (last stage's send is discarded)
+            nxt = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % S) for i in range(S)])
+            oi = jnp.clip(t - (S - 1), 0, M - 1)
+            take = jnp.logical_and(stage == S - 1, t >= S - 1)
+            outs = jnp.where(take, outs.at[oi].set(y), outs)
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (state0, outs0), jnp.arange(T))
+        # replicate the last stage's outputs across pp
+        outs = jax.lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), "pp")
+        return outs
+
+    smapped = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=P(),
+        axis_names=frozenset({"pp"}),
+        check_vma=False,
+    )
+
+    def run(stacked, x_mb, shared, rng_key):
+        # [L, ...] -> [S, nl, ...]: constrain ONLY the stage axis to pp;
+        # block dims stay UNCONSTRAINED so tensor-parallel shardings flow
+        # through from the input arrays
+        st = tuple(
+            jax.lax.with_sharding_constraint(
+                a.reshape((S, a.shape[0] // S) + a.shape[1:]),
+                jax.sharding.NamedSharding(mesh, P("pp", *([U] * a.ndim))))
+            for a in stacked)
+        return smapped(st, x_mb, shared, rng_key)
+
+    # partial-manual shard_map requires a surrounding jit (the eager impl
+    # re-enters with full specs); the jitted engine is cached per
+    # (stack, mesh, schedule) so repeated eager steps don't retrace
+    return jax.jit(run)
+
+
+def pipelined_stack_forward(stack, x, shared, num_stages: int,
+                            remat: bool, accumulate_steps: int = None):
+    """Shared orchestration for LayerStack-backed pipelined forwards:
+    microbatch -> pipeline_scan -> unmicrobatch, with one eager tape node
+    (nn/stack.py run_with_tape). `x` is a Tensor; `shared` is a tuple of
+    Tensors/arrays/None passed to every block. accumulate_steps defaults
+    from the fleet strategy's pipeline_configs."""
+    from ..core import generator
+    from ..core.tensor import Tensor
+    from ..nn.stack import run_with_tape
+    from . import fleet as fleet_mod
+    from .topology import get_hybrid_communicate_group
+
+    mesh = get_hybrid_communicate_group().mesh.mesh
+    if accumulate_steps is None:
+        strategy = fleet_mod.get_strategy()
+        accumulate_steps = 1 if strategy is None else int(
+            strategy.pipeline_configs.get("accumulate_steps", 1))
+    m = int(accumulate_steps) or 1
+    if x.shape[0] % m != 0:
+        raise ValueError(
+            f"batch size {x.shape[0]} is not divisible by accumulate_steps "
+            f"{m} (pipeline microbatching)")
+    rng = generator.next_key()  # once: fwd and vjp recompute share it
+    shared_arrays = tuple(s._data if isinstance(s, Tensor) else s
+                          for s in shared)
+
+    def pure(stacked_arrays, x_arr):
+        x_mb = microbatch(x_arr, m)
+        y = pipeline_scan(stack.apply_block, stacked_arrays, x_mb,
+                          shared_arrays, mesh, num_stages, m,
+                          remat=remat or m > 1, rng_key=rng,
+                          cache_key=id(stack))
+        return unmicrobatch(y)
+
+    return run_with_tape("pipeline", pure, stack.stacked_params(), x)
+
+
+def microbatch(x: jax.Array, num_micro: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...]."""
+    B = x.shape[0]
+    assert B % num_micro == 0, f"batch {B} not divisible by {num_micro} microbatches"
+    return x.reshape((num_micro, B // num_micro) + x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [M*mb, ...]."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])

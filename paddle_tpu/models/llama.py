@@ -45,6 +45,7 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     recompute: bool = False
+    use_scan_layers: bool = False   # stacked-params lax.scan over layers
     dtype: str = "float32"
 
     @staticmethod
@@ -207,18 +208,41 @@ class LlamaModel(Layer):
         else:
             self.embed_tokens = nn.Embedding(config.vocab_size,
                                              config.hidden_size)
-        self.layers = nn.LayerList(
-            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        if self._pp_degree() > 1 or config.use_scan_layers:
+            from ..nn.stack import LayerStack
+            self.layer_stack = LayerStack(
+                lambda: LlamaDecoderLayer(config), config.num_hidden_layers,
+                remat=config.recompute)
+        else:
+            self.layers = nn.LayerList(
+                [LlamaDecoderLayer(config)
+                 for _ in range(config.num_hidden_layers)])
         self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    @staticmethod
+    def _pp_degree() -> int:
+        hcg = _get_hcg()
+        return hcg.get_pipe_parallel_world_size() if hcg is not None else 1
 
     def forward(self, input_ids, attn_mask=None, position_ids=None):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            if self.config.recompute and self.training:
-                from ..distributed.recompute import recompute
-                x = recompute(layer, x, attn_mask, position_ids)
-            else:
-                x = layer(x, attn_mask, position_ids)
+        pp = self._pp_degree()
+        if pp > 1 and hasattr(self, "layer_stack"):
+            # decoder stack over the pp mesh axis: microbatch + ppermute
+            # rotation; embedding/norm/head stay outside, replicated over pp
+            from ..distributed.pipeline import pipelined_stack_forward
+            x = pipelined_stack_forward(
+                self.layer_stack, x, (attn_mask, position_ids), pp,
+                remat=self.config.recompute)
+        elif hasattr(self, "layer_stack"):
+            x = self.layer_stack(x, attn_mask, position_ids)
+        else:
+            for layer in self.layers:
+                if self.config.recompute and self.training:
+                    from ..distributed.recompute import recompute
+                    x = recompute(layer, x, attn_mask, position_ids)
+                else:
+                    x = layer(x, attn_mask, position_ids)
         return self.norm(x)
 
 

@@ -668,10 +668,11 @@ def flash_attention(query, key, value, attn_mask=None, rng_key=None,
             and dropout_p == 0.0:
         try:
             from .pallas import flash_attention as fa
+        except ImportError:
+            fa = None
+        if fa is not None and fa.supported(query.shape, key.shape, is_causal):
             return fa.flash_attention(query, key, value, causal=is_causal,
                                       scale=scale)
-        except ImportError:
-            pass
     return scaled_dot_product_attention(query, key, value, attn_mask=attn_mask,
                                         rng_key=rng_key, dropout_p=dropout_p,
                                         is_causal=is_causal, scale=scale)
