@@ -155,9 +155,14 @@ class LlamaAttention(Layer):
         cos, sin = self.rotary(s)
         q, k = call_op("rope", q, k, cos=cos, sin=sin,
                        position_ids=position_ids)
-        op = "flash_attention" if self.config.use_flash_attention \
-            else "scaled_dot_product_attention"
-        out = call_op(op, q, k, v, attn_mask=attn_mask, is_causal=True)
+        hcg = _get_hcg()
+        if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
+            # context parallelism: seq dim sharded over sep, ring attention
+            out = call_op("ring_attention", q, k, v, is_causal=True)
+        else:
+            op = "flash_attention" if self.config.use_flash_attention \
+                else "scaled_dot_product_attention"
+            out = call_op(op, q, k, v, attn_mask=attn_mask, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.o_proj(out)
 

@@ -628,6 +628,21 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, rng_key=None
     return jnp.swapaxes(out, 1, 2)
 
 
+@register_kernel("ring_attention")
+def ring_attention(query, key, value, is_causal=False, scale=None):
+    """Sequence-parallel attention: q resident, K/V rotated over the `sep`
+    ring (kernels/pallas/ring_attention.py). Requires an active hybrid
+    topology with sep_degree > 1; falls back to the composite otherwise."""
+    from ...distributed.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.get_sep_parallel_world_size() <= 1:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=is_causal, scale=scale)
+    from .pallas import ring_attention as ra
+    return ra.ring_attention(query, key, value, hcg.mesh.mesh, "sep",
+                             causal=is_causal, scale=scale)
+
+
 @register_kernel("rope")
 def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=True):
     """fused rotary embedding (reference phi/kernels/fusion/gpu/fused_rope*).
