@@ -1,0 +1,171 @@
+"""Mixture-of-Experts layers with expert parallelism.
+
+Reference counterpart: `python/paddle/incubate/distributed/models/moe/`
+(`MoELayer` moe_layer.py:99 with `MoEScatter`/`MoEGather` PyLayers over the
+CUDA `global_scatter`/`global_gather` collective ops,
+`paddle/fluid/operators/collective/global_scatter_op*`), plus gate impls
+under `.../moe/gate/`.
+
+TPU-first redesign (GShard/Switch style): routing is dense algebra —
+  - gate: softmax(x @ wg) in f32, top-k choice, capacity-bounded positions
+    via cumsum (tokens over capacity are dropped, standard GShard policy);
+  - dispatch:  [t, E*C] one-hot matmul gathers tokens into [E, C, h];
+  - experts:   stacked weights [E, h, m] -> one batched matmul (grouped
+    GEMM on the MXU), not a Python loop over experts;
+  - combine:   the transposed one-hot matmul, weighted by gate probs.
+The expert axis E is sharded over a mesh axis (default `dp`, matching the
+reference's MoE-group == data-group convention); with tokens batch-sharded
+on the same axis, XLA's partitioner derives the all-to-all exchanges that
+the reference implements manually with global_scatter/global_gather.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..ops.dispatcher import call_op
+from . import initializer as I
+from .layer_base import Layer
+
+
+class TopKGate(Layer):
+    """Top-k softmax router with capacity (reference moe/gate/topk_gate).
+
+    Returns (combine [t, E, C], dispatch-bool [t, E, C], aux_loss scalar).
+    """
+
+    def __init__(self, hidden_size: int, num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            (hidden_size, num_experts),
+            default_initializer=I.XavierUniform())
+
+    def capacity(self, num_tokens: int) -> int:
+        c = int(self.capacity_factor * num_tokens * self.top_k
+                / self.num_experts)
+        return max(c, self.top_k, 4)
+
+    def forward(self, x):
+        """x: [t, h] -> (combine [t,E,C], dispatch [t,E,C], aux_loss)."""
+        t, _ = x.shape
+        E, K = self.num_experts, self.top_k
+        C = self.capacity(t)
+        logits = call_op("matmul", x.astype("float32"),
+                         self.weight.astype("float32"))        # [t, E]
+        probs = call_op("softmax", logits, axis=-1)
+        topv, topi = call_op("topk", probs, k=K, axis=-1)      # [t, K]
+
+        # Switch-style load-balance loss: E * sum_e mean_prob_e * frac_e
+        me = probs.mean(axis=0)                                # [E]
+        first = call_op("one_hot", topi[:, 0], num_classes=E)  # [t, E]
+        ce = first.astype("float32").mean(axis=0)
+        aux = (me * ce).sum() * float(E)
+
+        combine = None
+        dispatch = None
+        counts = None  # running per-expert token counts [1, E]
+        for j in range(K):
+            m_j = call_op("one_hot", topi[:, j], num_classes=E)  # [t, E]
+            m_j = m_j.astype("float32")
+            pos_in_e = call_op("cumsum", m_j, axis=0) - m_j      # [t, E]
+            if counts is not None:
+                pos_in_e = pos_in_e + counts
+            pos = (pos_in_e * m_j).sum(axis=-1)                  # [t]
+            keep = (pos < float(C)).astype("float32")
+            gate_j = topv[:, j] * keep                           # [t]
+            oh_c = call_op("one_hot", pos.astype("int32"),
+                           num_classes=C).astype("float32")      # [t, C]
+            d_j = m_j.unsqueeze(-1) * oh_c.unsqueeze(1)          # [t, E, C]
+            d_j = d_j * keep.unsqueeze(-1).unsqueeze(-1)
+            c_j = d_j * gate_j.unsqueeze(-1).unsqueeze(-1)
+            combine = c_j if combine is None else combine + c_j
+            dispatch = d_j if dispatch is None else dispatch + d_j
+            new_counts = m_j.sum(axis=0, keepdim=True)
+            counts = new_counts if counts is None else counts + new_counts
+        return combine, dispatch, aux
+
+
+class ExpertFFN(Layer):
+    """Stacked SwiGLU expert weights: one grouped GEMM over [E, C, h]."""
+
+    def __init__(self, num_experts: int, hidden_size: int,
+                 intermediate_size: int):
+        super().__init__()
+        E, h, m = num_experts, hidden_size, intermediate_size
+        init = I.XavierUniform()
+        self.gate_weight = self.create_parameter((E, h, m),
+                                                 default_initializer=init)
+        self.up_weight = self.create_parameter((E, h, m),
+                                               default_initializer=init)
+        self.down_weight = self.create_parameter((E, m, h),
+                                                 default_initializer=init)
+
+    def forward(self, x):
+        """x: [E, C, h] -> [E, C, h] (batched over experts)."""
+        g = call_op("matmul", x, self.gate_weight)       # [E, C, m]
+        u = call_op("matmul", x, self.up_weight)
+        return call_op("matmul", call_op("swiglu", g, u), self.down_weight)
+
+
+class MoELayer(Layer):
+    """Dense-dispatch MoE block (reference MoELayer moe_layer.py:99).
+
+    forward(x [b, s, h]) -> [b, s, h]; the load-balance aux loss is
+    accumulated on self.aux_loss (read+reset by the model's criterion).
+    """
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25,
+                 expert_axis: str = "dp"):
+        super().__init__()
+        self.gate = TopKGate(hidden_size, num_experts, top_k, capacity_factor)
+        self.experts = ExpertFFN(num_experts, hidden_size, intermediate_size)
+        self.expert_axis = expert_axis
+        self.aux_loss = None
+        self._shard_experts(expert_axis, num_experts)
+
+    def _shard_experts(self, axis: str, E: int):
+        from ..distributed.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            return
+        try:
+            deg = hcg.axis_degree(axis)
+        except KeyError:
+            return
+        if deg <= 1 or E % deg != 0:
+            return
+        mesh = hcg.mesh.mesh
+        for p in self.experts.parameters():
+            p._set_data(jax.device_put(p._data, NamedSharding(
+                mesh, PartitionSpec(axis))))
+
+    def forward(self, x):
+        b, s, h = x.shape
+        t = b * s
+        flat = x.reshape([t, h])
+        combine, dispatch, aux = self.gate(flat)          # [t, E, C]
+        self.aux_loss = aux
+        E = self.gate.num_experts
+        C = combine.shape[-1]
+        # dispatch: [E*C, t] @ [t, h] — the all-to-all falls out of the
+        # (batch-sharded tokens) x (expert-sharded result) contraction
+        d2 = dispatch.reshape([t, E * C]).transpose([1, 0])
+        expert_in = call_op("matmul", d2, flat.astype(d2.dtype))
+        expert_in = expert_in.reshape([E, C, h]).astype(x.dtype)
+        expert_out = self.experts(expert_in)              # [E, C, h]
+        # combine: [t, E*C] @ [E*C, h], gate-weighted
+        c2 = combine.reshape([t, E * C])
+        out = call_op("matmul", c2, expert_out.reshape([E * C, h])
+                      .astype(c2.dtype))
+        return out.astype(x.dtype).reshape([b, s, h])
