@@ -367,10 +367,11 @@ class AdaptiveAvgPool2D(Layer):
 class AdaptiveMaxPool2D(Layer):
     def __init__(self, output_size, data_format="NCHW"):
         super().__init__()
-        self.output_size = output_size
+        self.output_size, self.data_format = output_size, data_format
 
     def forward(self, x):
-        return call_op("adaptive_max_pool2d", x, output_size=self.output_size)
+        return call_op("adaptive_max_pool2d", x, output_size=self.output_size,
+                       data_format=self.data_format)
 
 
 class Flatten(Layer):

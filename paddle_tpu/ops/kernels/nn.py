@@ -342,29 +342,44 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return out / denom
 
 
-@register_kernel("adaptive_avg_pool2d")
-def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+def _adaptive_bins(in_size, out_size):
+    """paddle bin i covers [floor(i*H/oh), ceil((i+1)*H/oh))."""
+    return [(i * in_size // out_size,
+             -(-((i + 1) * in_size) // out_size)) for i in range(out_size)]
+
+
+def _adaptive_pool2d(x, output_size, reduce_fn, data_format):
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
-    if data_format == "NCHW":
-        N, C, H, W = x.shape
-        oh, ow = output_size
-        x6 = x.reshape(N, C, oh, H // oh, ow, W // ow)
-        return jnp.mean(x6, axis=(3, 5))
-    N, H, W, C = x.shape
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    N, C, H, W = x.shape
     oh, ow = output_size
-    x6 = x.reshape(N, oh, H // oh, ow, W // ow, C)
-    return jnp.mean(x6, axis=(2, 4))
+    if H % oh == 0 and W % ow == 0:
+        # uniform bins: single reshape-reduce, fuses cleanly in XLA
+        x6 = x.reshape(N, C, oh, H // oh, ow, W // ow)
+        out = reduce_fn(x6, axis=(3, 5))
+    else:
+        # non-uniform (incl. upsampling oh>H): static python loop over bins
+        rows = [reduce_fn(x[:, :, a:b, :], axis=2, keepdims=True)
+                for a, b in _adaptive_bins(H, oh)]
+        xr = jnp.concatenate(rows, axis=2)
+        cols = [reduce_fn(xr[:, :, :, a:b], axis=3, keepdims=True)
+                for a, b in _adaptive_bins(W, ow)]
+        out = jnp.concatenate(cols, axis=3)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool2d(x, output_size, jnp.mean, data_format)
 
 
 @register_kernel("adaptive_max_pool2d")
 def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
-    if isinstance(output_size, int):
-        output_size = (output_size, output_size)
-    N, C, H, W = x.shape
-    oh, ow = output_size
-    x6 = x.reshape(N, C, oh, H // oh, ow, W // ow)
-    return jnp.max(x6, axis=(3, 5))
+    return _adaptive_pool2d(x, output_size, jnp.max, data_format)
 
 
 @register_kernel("interpolate_nearest")

@@ -1,0 +1,293 @@
+"""Vision package tests: transforms (host numpy), dataset archive parsers,
+model-zoo forward/backward. Mirrors the reference's test/legacy_test
+test_transforms*.py / test_datasets*.py / test_vision_models.py strategy:
+shape + value checks against numpy, tiny inputs.
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision import datasets, models, ops as vops
+
+
+def _img(h=32, w=24, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 256, (h, w, c), dtype=np.uint8)
+
+
+class TestTransforms:
+    def test_to_tensor(self):
+        t = T.to_tensor(_img())
+        assert t.shape == [3, 32, 24]
+        assert t.numpy().max() <= 1.0 and t.numpy().min() >= 0.0
+
+    def test_resize_shapes(self):
+        img = _img(32, 24)
+        assert T.resize(img, (16, 20)).shape == (16, 20, 3)
+        # int size = shorter edge
+        out = T.resize(img, 12)
+        assert out.shape == (16, 12, 3)
+
+    def test_resize_identity(self):
+        img = _img()
+        np.testing.assert_array_equal(T.resize(img, (32, 24)), img)
+
+    def test_bilinear_matches_numpy_upscale(self):
+        img = np.arange(16, dtype=np.float32).reshape(4, 4, 1)
+        out = T.resize(img, (8, 8))
+        assert out.shape == (8, 8, 1)
+        # mean preserved under half-pixel bilinear upscale (within rounding)
+        assert abs(out.mean() - img.mean()) < 0.3
+
+    def test_flips(self):
+        img = _img()
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+
+    def test_crops(self):
+        img = _img(10, 10)
+        assert T.center_crop(img, 4).shape == (4, 4, 3)
+        np.testing.assert_array_equal(T.crop(img, 1, 2, 3, 4),
+                                      img[1:4, 2:6])
+
+    def test_pad(self):
+        img = _img(4, 4)
+        assert T.pad(img, 2).shape == (8, 8, 3)
+        assert T.pad(img, (1, 2)).shape == (8, 6, 3)
+        assert T.pad(img, (1, 2, 3, 4)).shape == (10, 8, 3)
+
+    def test_normalize(self):
+        chw = T.to_tensor(_img())
+        out = T.normalize(chw, [0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+        np.testing.assert_allclose(out.numpy(),
+                                   (chw.numpy() - 0.5) / 0.5, rtol=1e-6)
+
+    def test_grayscale(self):
+        g = T.to_grayscale(_img())
+        assert g.shape == (32, 24, 1)
+        g3 = T.to_grayscale(_img(), 3)
+        np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+
+    def test_adjust_brightness(self):
+        img = _img()
+        np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+        assert T.adjust_brightness(img, 0.0).sum() == 0
+
+    def test_adjust_hue_identity(self):
+        img = _img()
+        out = T.adjust_hue(img, 0.0)
+        assert np.abs(out.astype(int) - img.astype(int)).max() <= 2
+
+    def test_rotate90(self):
+        img = _img(8, 8)
+        out = T.rotate(img, 90)
+        # CCW rotate by 90 maps (y,x) -> (x, H-1-y); spot-check center block
+        assert out.shape == img.shape
+
+    def test_compose_pipeline(self):
+        tf = T.Compose([
+            T.Resize(36), T.RandomCrop(32), T.RandomHorizontalFlip(0.5),
+            T.ToTensor(), T.Normalize([0.5] * 3, [0.25] * 3),
+        ])
+        out = tf(_img(40, 48))
+        assert out.shape == [3, 32, 32]
+
+    def test_random_resized_crop(self):
+        out = T.RandomResizedCrop(16)(_img())
+        assert out.shape == (16, 16, 3)
+
+    def test_color_jitter_runs(self):
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(_img())
+        assert out.shape == (32, 24, 3)
+
+    def test_random_erasing(self):
+        out = T.RandomErasing(prob=1.0)(_img())
+        assert out.shape == (32, 24, 3)
+
+
+def _make_cifar(path, n=20, cifar100=False):
+    key = b"fine_labels" if cifar100 else b"labels"
+    rng = np.random.RandomState(0)
+    with tarfile.open(path, "w:gz") as tf:
+        names = (["train", "test"] if cifar100
+                 else ["data_batch_1", "data_batch_2", "test_batch"])
+        for name in names:
+            batch = {b"data": rng.randint(0, 256, (n, 3072), dtype=np.uint8)
+                     .astype(np.uint8),
+                     key: rng.randint(0, 10, n).tolist()}
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo(f"cifar/{name}")
+            info.size = len(blob)
+            import io
+            tf.addfile(info, io.BytesIO(blob))
+
+
+def _make_mnist(dirpath, n=10):
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for stem in ("train", "t10k"):
+        imgs = rng.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+        labels = rng.randint(0, 10, n, dtype=np.uint8)
+        with gzip.open(os.path.join(dirpath, f"{stem}-images-idx3-ubyte.gz"),
+                       "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(os.path.join(dirpath, f"{stem}-labels-idx1-ubyte.gz"),
+                       "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labels.tobytes())
+    return dirpath
+
+
+class TestDatasets:
+    def test_cifar10(self, tmp_path):
+        p = str(tmp_path / "cifar10.tar.gz")
+        _make_cifar(p)
+        train = datasets.Cifar10(data_file=p, mode="train")
+        test = datasets.Cifar10(data_file=p, mode="test")
+        assert len(train) == 40 and len(test) == 20
+        img, label = train[0]
+        assert img.shape == (32, 32, 3) and label.dtype == np.int64
+
+    def test_cifar100(self, tmp_path):
+        p = str(tmp_path / "cifar100.tar.gz")
+        _make_cifar(p, cifar100=True)
+        train = datasets.Cifar100(data_file=p, mode="train")
+        assert len(train) == 20
+
+    def test_cifar_transform(self, tmp_path):
+        p = str(tmp_path / "cifar10.tar.gz")
+        _make_cifar(p)
+        ds = datasets.Cifar10(data_file=p, mode="test",
+                              transform=T.Compose([T.ToTensor()]))
+        img, _ = ds[3]
+        assert img.shape == [3, 32, 32]
+
+    def test_mnist(self, tmp_path):
+        d = _make_mnist(str(tmp_path / "mnist"))
+        train = datasets.MNIST(
+            image_path=os.path.join(d, "train-images-idx3-ubyte.gz"),
+            label_path=os.path.join(d, "train-labels-idx1-ubyte.gz"))
+        assert len(train) == 10
+        img, label = train[0]
+        assert img.shape == (28, 28, 1)
+        assert 0 <= int(label) < 10
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            os.makedirs(tmp_path / cls)
+            for i in range(3):
+                np.save(tmp_path / cls / f"{i}.npy", _img(8, 8))
+        ds = datasets.DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        sample, target = ds[0]
+        assert sample.shape == (8, 8, 3) and target == 0
+
+    def test_missing_file_raises(self):
+        with pytest.raises(RuntimeError, match="not found"):
+            datasets.Cifar10(data_file="/nonexistent.tar.gz")
+
+
+class TestModels:
+    def test_lenet_forward_backward(self):
+        model = models.LeNet()
+        x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype("float32"))
+        out = model(x)
+        assert out.shape == [2, 10]
+        loss = out.mean()
+        loss.backward()
+        assert model.fc[0].weight.grad is not None
+
+    def test_resnet18(self):
+        model = models.resnet18(num_classes=10)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+        assert model(x).shape == [1, 10]
+
+    def test_resnet50_bottleneck(self):
+        model = models.resnet50(num_classes=4)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+        assert model(x).shape == [1, 4]
+
+    def test_resnext_groups(self):
+        model = models.resnext50_32x4d(num_classes=3)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+        assert model(x).shape == [1, 3]
+
+    def test_vgg11(self):
+        model = models.vgg11(num_classes=5)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+        assert model(x).shape == [1, 5]
+
+    def test_mobilenet_v2(self):
+        model = models.MobileNetV2(num_classes=6)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+        assert model(x).shape == [1, 6]
+
+    def test_mobilenet_v3_small(self):
+        model = models.MobileNetV3Small(num_classes=6)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+        assert model(x).shape == [1, 6]
+
+    def test_squeezenet(self):
+        model = models.squeezenet1_1(num_classes=7)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+        assert model(x).shape == [1, 7]
+
+    def test_pretrained_raises(self):
+        with pytest.raises(RuntimeError, match="pretrained"):
+            models.resnet18(pretrained=True)
+
+    def test_resnet_train_step(self):
+        # config-1 smoke: one SGD step of ResNet-18 on fake CIFAR batch
+        model = models.resnet18(num_classes=10)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+        y = paddle.to_tensor(np.array([1, 3], dtype="int64"))
+        loss = paddle.nn.CrossEntropyLoss()(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert np.isfinite(float(loss))
+
+
+class TestVisionOps:
+    def test_box_iou(self):
+        b1 = np.array([[0, 0, 2, 2]], dtype="float32")
+        b2 = np.array([[1, 1, 3, 3], [0, 0, 2, 2]], dtype="float32")
+        iou = vops.box_iou(b1, b2).numpy()
+        np.testing.assert_allclose(iou[0], [1 / 7, 1.0], rtol=1e-5)
+
+    def test_nms(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                         dtype="float32")
+        scores = np.array([0.9, 0.8, 0.7], dtype="float32")
+        keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                        paddle.to_tensor(scores)).numpy()
+        np.testing.assert_array_equal(keep, [0, 2])
+
+    def test_nms_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], dtype="float32")
+        scores = np.array([0.9, 0.8], dtype="float32")
+        cats = np.array([0, 1], dtype="int64")
+        keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                        paddle.to_tensor(scores),
+                        category_idxs=paddle.to_tensor(cats),
+                        categories=[0, 1]).numpy()
+        assert set(keep.tolist()) == {0, 1}
