@@ -71,6 +71,10 @@ from . import framework  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework import save, load  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
+from .hapi import Model, summary  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401
 from .nn.layer_base import Parameter  # noqa: E402,F401
 from . import ops  # noqa: E402,F401
 

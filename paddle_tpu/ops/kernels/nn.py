@@ -355,6 +355,8 @@ def _adaptive_pool2d(x, output_size, reduce_fn, data_format):
         x = jnp.moveaxis(x, -1, 1)
     N, C, H, W = x.shape
     oh, ow = output_size
+    oh = H if oh is None else oh   # None = keep input extent (reference
+    ow = W if ow is None else ow   # adaptive_avg_pool2d accepts None)
     if H % oh == 0 and W % ow == 0:
         # uniform bins: single reshape-reduce, fuses cleanly in XLA
         x6 = x.reshape(N, C, oh, H // oh, ow, W // ow)

@@ -154,8 +154,13 @@ class Normalize(BaseTransform):
             std = [std, std, std]
         self.mean, self.std = mean, std
         self.data_format = data_format
+        self.to_rgb = to_rgb
 
     def _apply_image(self, img):
+        if self.to_rgb:
+            img = np.asarray(img)
+            img = img[::-1, :, :] if self.data_format == "CHW" \
+                else img[:, :, ::-1]
         return F.normalize(img, self.mean, self.std, self.data_format)
 
 

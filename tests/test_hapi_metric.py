@@ -1,0 +1,305 @@
+"""hapi Model + metric tests (modeled on reference test/legacy_test/
+test_metrics.py and hapi tests: numpy-golden checks + end-to-end fit)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+# --------------------------------------------------------------------- metric
+class TestAccuracy:
+    def test_top1(self):
+        m = Accuracy()
+        pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+        label = np.array([1, 0, 0])
+        correct = m.compute(paddle.to_tensor(pred), paddle.to_tensor(label))
+        m.update(correct)
+        assert abs(m.accumulate() - 2.0 / 3.0) < 1e-6
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.5, 0.3, 0.2], [0.2, 0.5, 0.3]], np.float32)
+        label = np.array([[1], [2]])
+        m.update(m.compute(paddle.to_tensor(pred), paddle.to_tensor(label)))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.0) < 1e-6
+        assert abs(top2 - 1.0) < 1e-6
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_one_hot_label(self):
+        m = Accuracy()
+        pred = np.array([[0.1, 0.9], [0.9, 0.1]], np.float32)
+        onehot = np.array([[0.0, 1.0], [0.0, 1.0]], np.float32)
+        m.update(m.compute(paddle.to_tensor(pred), paddle.to_tensor(onehot)))
+        assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+class TestPrecisionRecall:
+    def test_precision(self):
+        m = Precision()
+        preds = np.array([0.9, 0.8, 0.1, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        m.update(preds, labels)
+        assert abs(m.accumulate() - 2.0 / 3.0) < 1e-6  # tp=2 fp=1
+        # accumulation across updates
+        m.update(np.array([0.6]), np.array([0]))
+        assert abs(m.accumulate() - 2.0 / 4.0) < 1e-6
+
+    def test_recall(self):
+        m = Recall()
+        preds = np.array([0.9, 0.2, 0.8])
+        labels = np.array([1, 1, 0])
+        m.update(preds, labels)
+        assert abs(m.accumulate() - 0.5) < 1e-6  # tp=1 fn=1
+
+
+class TestAuc:
+    def test_perfect_separation(self):
+        m = Auc()
+        preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+        labels = np.array([0, 0, 1, 1])
+        m.update(preds, labels)
+        assert abs(m.accumulate() - 1.0) < 1e-3
+
+    def test_against_sklearn_style_reference(self):
+        rng = np.random.RandomState(0)
+        scores = rng.rand(200)
+        labels = (rng.rand(200) < scores).astype(np.int64)  # correlated
+        m = Auc(num_thresholds=4095)
+        m.update(np.stack([1 - scores, scores], axis=1), labels)
+        # exact AUC by rank statistic
+        pos = scores[labels == 1]
+        neg = scores[labels == 0]
+        exact = np.mean((pos[:, None] > neg[None, :]).astype(np.float64)
+                        + 0.5 * (pos[:, None] == neg[None, :]))
+        assert abs(m.accumulate() - exact) < 5e-3
+
+
+# ----------------------------------------------------------------------- hapi
+class _XorData(Dataset):
+    """Tiny separable dataset."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 4).astype(np.float32)
+        w = np.array([1.0, -2.0, 0.5, 1.5], np.float32)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+
+
+class TestModelFit:
+    def test_fit_improves_accuracy(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+        model.fit(_XorData(64), epochs=4, batch_size=16, verbose=0)
+        logs = model.evaluate(_XorData(64, seed=1), batch_size=32, verbose=0)
+        assert logs["acc"] > 0.8
+        assert "loss" in logs
+
+    def test_train_batch_eval_batch(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+        x = np.random.randn(8, 4).astype(np.float32)
+        y = np.random.randint(0, 2, (8,))
+        losses, metrics = model.train_batch([x], [y])
+        assert np.isfinite(losses[0])
+        losses2, _ = model.eval_batch([x], [y])
+        assert np.isfinite(losses2[0])
+
+    def test_predict(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare()
+        outs = model.predict(_XorData(16), batch_size=8, verbose=0,
+                             stack_outputs=True)
+        assert outs[0].shape == (16, 2)
+
+    def test_save_load(self, tmp_path):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        x = np.random.randn(4, 4).astype(np.float32)
+        y = np.random.randint(0, 2, (4,))
+        model.train_batch([x], [y])
+        p = str(tmp_path / "ckpt" / "model")
+        model.save(p)
+
+        net2 = _mlp()
+        model2 = paddle.Model(net2)
+        opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+        model2.prepare(opt2, nn.CrossEntropyLoss())
+        model2.load(p)
+        for a, b in zip(net.parameters(), net2.parameters()):
+            np.testing.assert_allclose(np.asarray(a._data),
+                                       np.asarray(b._data))
+
+    def test_jit_fit(self):
+        """prepare(jit=True) compiles the step via TrainStep."""
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), jit=True)
+        x = np.random.randn(16, 4).astype(np.float32)
+        y = np.random.randint(0, 2, (16,))
+        l0 = model.train_batch([x], [y])
+        for _ in range(10):
+            l1 = model.train_batch([x], [y])
+        assert l1 < l0
+
+    def test_summary(self, capsys):
+        net = _mlp()
+        info = paddle.summary(net)
+        expected = 4 * 16 + 16 + 16 * 2 + 2
+        assert info["total_params"] == expected
+        assert "Total params" in capsys.readouterr().out
+
+
+class TestCallbacks:
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+        es = EarlyStopping(monitor="loss", patience=1, verbose=0,
+                           save_best_model=False)
+        model.fit(_XorData(32), eval_data=_XorData(32, seed=1), epochs=10,
+                  batch_size=16, verbose=0, callbacks=[es])
+        assert model.stop_training  # lr=0 -> no improvement -> stopped
+
+    def test_lr_scheduler_callback(self):
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        model.fit(_XorData(16), epochs=2, batch_size=8, verbose=0)
+        assert sched.last_epoch >= 2
+
+    def test_model_checkpoint(self, tmp_path):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        model.fit(_XorData(16), epochs=1, batch_size=8, verbose=0,
+                  save_dir=str(tmp_path))
+        assert (tmp_path / "final.pdparams").exists()
+        assert (tmp_path / "0.pdparams").exists()
+
+    def test_reduce_lr_on_plateau(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0)
+        model.fit(_XorData(16), eval_data=_XorData(16, seed=1), epochs=5,
+                  batch_size=8, verbose=0, callbacks=[cb])
+        assert opt.get_lr() == 0.0  # lr 0 stays 0 but path exercised
+
+        opt2 = paddle.optimizer.SGD(learning_rate=1.0,
+                                    parameters=net.parameters())
+        model2 = paddle.Model(net)
+        model2.prepare(opt2, nn.CrossEntropyLoss())
+        cb2 = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=0,
+                                verbose=0)
+        model2.fit(_XorData(16), eval_data=_XorData(16, seed=1), epochs=3,
+                   batch_size=8, verbose=0, callbacks=[cb2])
+        assert opt2.get_lr() <= 1.0
+
+
+class TestReviewRegressions:
+    def test_evaluate_without_loss_or_metrics(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare()
+        logs = model.evaluate(_XorData(8), batch_size=4, verbose=0)
+        assert isinstance(logs, dict)
+
+    def test_early_stopping_not_fired_on_improvement(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=0, verbose=0,
+                           save_best_model=False)
+        model.fit(_XorData(64), eval_data=_XorData(64), epochs=3,
+                  batch_size=16, verbose=0, callbacks=[es])
+        assert not model.stop_training  # loss improves -> never stops
+
+    def test_train_batch_update_false_keeps_params(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), jit=True)
+        before = [np.asarray(p._data).copy() for p in net.parameters()]
+        x = np.random.randn(8, 4).astype(np.float32)
+        y = np.random.randint(0, 2, (8,))
+        model.train_batch([x], [y], update=False)
+        for b, p in zip(before, net.parameters()):
+            np.testing.assert_array_equal(b, np.asarray(p._data))
+
+    def test_jit_with_metrics(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy(), jit=True)
+        model.fit(_XorData(64), epochs=3, batch_size=16, verbose=0)
+        acc = model._metrics[0].accumulate()
+        assert acc > 0.7  # metrics updated under jit
+
+    def test_amp_prepare_wires_autocast(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), amp_configs="O1")
+        x = np.random.randn(4, 4).astype(np.float32)
+        y = np.random.randint(0, 2, (4,))
+        loss = model.train_batch([x], [y])
+        assert np.isfinite(loss if not isinstance(loss, list) else loss[0])
+
+    def test_normalize_to_rgb_flips_channels(self):
+        from paddle_tpu.vision.transforms import Normalize
+        img = np.zeros((3, 2, 2), np.float32)
+        img[0] = 1.0  # "B" channel
+        out = Normalize(mean=[0, 0, 0], std=[1, 1, 1], to_rgb=True,
+                        data_format="CHW")(img)
+        assert out[2].max() == 1.0 and out[0].max() == 0.0
+
+    def test_adaptive_pool_none_output_size(self):
+        from paddle_tpu import nn as pnn
+        x = paddle.to_tensor(np.random.randn(1, 2, 6, 8).astype(np.float32))
+        out = pnn.AdaptiveAvgPool2D(output_size=[None, 4])(x)
+        assert tuple(out.shape) == (1, 2, 6, 4)
