@@ -72,6 +72,7 @@ from . import models  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework import save, load  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401

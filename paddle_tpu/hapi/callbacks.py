@@ -294,10 +294,15 @@ class ReduceLROnPlateau(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 if isinstance(opt._learning_rate, Sched):
-                    old = float(opt._learning_rate.last_lr)
-                    new = max(old * self.factor, self.min_lr)
-                    opt._learning_rate.base_lr = new
-                    opt._learning_rate.last_lr = new
+                    # scale base_lr so the scheduler's own decay schedule
+                    # keeps applying on top of the reduction (NOT
+                    # base_lr = last_lr*factor, which would re-apply the
+                    # accumulated decay on the next step())
+                    sched = opt._learning_rate
+                    old = float(sched.last_lr)
+                    sched.base_lr *= self.factor
+                    sched.last_lr = max(old * self.factor, self.min_lr)
+                    new = sched.last_lr
                 else:
                     old = opt.get_lr()
                     new = max(old * self.factor, self.min_lr)

@@ -109,16 +109,22 @@ class Model:
                     return loss
 
                 self._train_step = TrainStep(self.network, _scalar_loss,
-                                             self._optimizer)
+                                             self._optimizer,
+                                             amp_level=self._amp_level)
             loss = self._train_step(tuple(inputs), tuple(labels))
             lv = float(loss._data if isinstance(loss, Tensor) else loss)
             if not self._metrics:
                 return self._with_metric_results(None, labels, [lv])
             # metrics need network outputs, which the compiled step does not
-            # expose — pay one extra no-grad forward for them
+            # expose — pay one extra no-grad forward for them, in eval mode
+            # so BatchNorm stats / dropout are not perturbed a second time
             from ..autograd.engine import no_grad
-            with no_grad():
-                outputs = _to_list(self.network(*inputs))
+            self.network.eval()
+            try:
+                with no_grad():
+                    outputs = _to_list(self.network(*inputs))
+            finally:
+                self.network.train()
             return self._with_metric_results(outputs, labels, [lv])
 
         if not update:  # loss/metrics only, no parameter change
@@ -261,12 +267,18 @@ class Model:
         for m in self._metrics:
             m.reset()
         logs = {}
+        loss_sum, loss_n = 0.0, 0
         for step, batch in enumerate(eval_loader):
             cbks.on_eval_batch_begin(step)
             ins, lbs = self._split_batch(batch, n_labels)
             res = self.eval_batch(ins, lbs)
             logs = self._update_logs(res)
+            if "loss" in logs:
+                loss_sum += logs["loss"]
+                loss_n += 1
             cbks.on_eval_batch_end(step, logs)
+        if loss_n:  # epoch-mean loss, not last-batch (monitored by
+            logs["loss"] = loss_sum / loss_n  # EarlyStopping/ReduceLR)
         cbks.on_eval_end(logs)
         return logs
 
@@ -294,13 +306,13 @@ class Model:
             cbks.on_predict_batch_begin(step)
             ins = _to_list(batch)
             # predict data may still carry labels: keep declared inputs if
-            # specs were given, else drop a trailing label element
+            # specs were given, else trim to the network's positional arity
             if self._inputs:
                 ins = ins[:len(self._inputs)]
             elif self._labels:
                 ins, _ = self._split_batch(batch, len(self._labels))
-            elif len(ins) >= 2:
-                ins = ins[:-1]
+            else:
+                ins = ins[:self._forward_arity(len(ins))]
             out = self.predict_batch(ins)
             outputs.append(out)
             cbks.on_predict_batch_end(step, {})
@@ -310,6 +322,22 @@ class Model:
             return [np.concatenate([b[i] for b in outputs], axis=0)
                     for i in range(n_out)]
         return outputs
+
+    def _forward_arity(self, have: int) -> int:
+        """How many of `have` batch elements the network's forward can
+        take positionally (*args -> all of them)."""
+        import inspect
+        try:
+            sig = inspect.signature(self.network.forward)
+        except (TypeError, ValueError):
+            return have
+        n = 0
+        for p in sig.parameters.values():
+            if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                return have
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                n += 1
+        return min(have, n)
 
     # ------------------------------------------------------------- save/load
     def save(self, path, training=True):

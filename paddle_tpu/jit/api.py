@@ -211,11 +211,12 @@ class TrainStep:
     optimizer.step() and compiled TrainStep produce identical updates."""
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1, amp_level: Optional[str] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.grad_accum = int(grad_accum)
+        self.amp_level = amp_level  # trace fwd under amp.auto_cast(level)
         self._compiled = None
         self._accum_fn = None
         self._accum = None      # grad accumulation buffers
@@ -251,11 +252,19 @@ class TrainStep:
                    for i in range(len(params)))
         grad_clip = opt._grad_clip
 
+        amp_level = self.amp_level
+
+        def _amp_ctx():
+            if amp_level:
+                from .. import amp as amp_mod
+                return amp_mod.auto_cast(level=amp_level)
+            return contextlib.nullcontext()
+
         def loss_of(param_arrays, frozen_arrays, buffer_arrays, rng, inputs, labels):
             with _swap_state(params + frozen + buffers,
                              list(param_arrays) + list(frozen_arrays)
                              + list(buffer_arrays)):
-                with _traced_rng(rng), engine.no_grad():
+                with _traced_rng(rng), engine.no_grad(), _amp_ctx():
                     t_in = jax.tree.map(Tensor, inputs)
                     t_lb = jax.tree.map(Tensor, labels)
                     out = model(*t_in) if isinstance(t_in, (list, tuple)) \

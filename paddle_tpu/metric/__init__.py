@@ -72,6 +72,8 @@ class Accuracy(Metric):
         Returns [N, maxk] float correctness matrix (on device)."""
         p = pred._data if isinstance(pred, Tensor) else jnp.asarray(pred)
         l = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+        if p.ndim == 1:  # binary scores [N] -> two-column [N, 2]
+            p = jnp.stack([1.0 - p, p], axis=-1)
         if l.ndim == p.ndim and l.shape[-1] == p.shape[-1] and l.shape[-1] > 1:
             l = jnp.argmax(l, axis=-1)  # one-hot -> index
         l = l.reshape(l.shape[0], -1)[:, 0]

@@ -1,0 +1,352 @@
+"""Distribution tests: numpy/scipy-golden moments, log_prob vs scipy
+formulas, sampling statistics, KL closed forms vs Monte Carlo (modeled on
+reference test/distribution/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _mc_kl(p, q, n=200_000, seed=7):
+    paddle.seed(seed)
+    x = p.sample((n,))
+    return float(np.mean(_np(p.log_prob(x)) - _np(q.log_prob(x))))
+
+
+class TestNormal:
+    def test_log_prob_golden(self):
+        d = D.Normal(1.0, 2.0)
+        x = np.array([0.0, 1.0, 3.0], np.float32)
+        expect = -((x - 1.0) ** 2) / 8.0 - np.log(2.0) \
+            - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   expect, rtol=1e-5)
+
+    def test_moments_and_entropy(self):
+        d = D.Normal(np.array([0.0, 2.0], np.float32),
+                     np.array([1.0, 3.0], np.float32))
+        np.testing.assert_allclose(_np(d.mean), [0.0, 2.0])
+        np.testing.assert_allclose(_np(d.variance), [1.0, 9.0])
+        np.testing.assert_allclose(
+            _np(d.entropy()),
+            0.5 * np.log(2 * np.pi * np.e * np.array([1.0, 9.0])), rtol=1e-6)
+
+    def test_sample_stats(self):
+        paddle.seed(0)
+        d = D.Normal(3.0, 0.5)
+        s = _np(d.sample((20000,)))
+        assert s.shape == (20000,)
+        assert abs(s.mean() - 3.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+
+    def test_rsample_grad(self):
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        d = D.Normal(loc, 1.0)
+        paddle.seed(1)
+        s = d.rsample((256,))
+        s.sum().backward()
+        np.testing.assert_allclose(float(_np(loc.grad)), 256.0, rtol=1e-4)
+
+    def test_cdf(self):
+        d = D.Normal(0.0, 1.0)
+        np.testing.assert_allclose(
+            float(_np(d.cdf(paddle.to_tensor(np.float32(0.0))))), 0.5,
+            atol=1e-6)
+
+
+class TestUniformExpLaplace:
+    def test_uniform(self):
+        d = D.Uniform(1.0, 3.0)
+        assert abs(float(_np(d.entropy())) - np.log(2.0)) < 1e-6
+        lp = _np(d.log_prob(paddle.to_tensor(
+            np.array([0.0, 2.0], np.float32))))
+        assert lp[0] == -np.inf and abs(lp[1] + np.log(2.0)) < 1e-6
+
+    def test_exponential(self):
+        d = D.Exponential(2.0)
+        assert abs(float(_np(d.mean)) - 0.5) < 1e-6
+        assert abs(float(_np(d.entropy())) - (1 - np.log(2.0))) < 1e-6
+        paddle.seed(0)
+        s = _np(d.sample((20000,)))
+        assert abs(s.mean() - 0.5) < 0.02
+
+    def test_laplace(self):
+        d = D.Laplace(0.0, 1.0)
+        x = np.array([-1.0, 0.0, 2.0], np.float32)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   -np.abs(x) - np.log(2.0), rtol=1e-6)
+        assert abs(float(_np(d.entropy())) - (1 + np.log(2.0))) < 1e-6
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.0, 0.5)
+        assert abs(float(_np(d.mean)) - np.exp(0.125)) < 1e-5
+        paddle.seed(0)
+        s = _np(d.sample((50000,)))
+        assert abs(s.mean() - np.exp(0.125)) < 0.02
+
+    def test_cauchy_gumbel(self):
+        c = D.Cauchy(0.0, 1.0)
+        np.testing.assert_allclose(
+            float(_np(c.log_prob(paddle.to_tensor(np.float32(0.0))))),
+            -np.log(np.pi), rtol=1e-6)
+        assert abs(float(_np(c.entropy())) - np.log(4 * np.pi)) < 1e-5
+        g = D.Gumbel(0.0, 1.0)
+        paddle.seed(0)
+        s = _np(g.sample((50000,)))
+        assert abs(s.mean() - 0.5772156649) < 0.02
+
+
+class TestGammaBeta:
+    def test_gamma_log_prob(self):
+        from scipy import stats
+        d = D.Gamma(2.0, 3.0)
+        x = np.array([0.5, 1.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(x))),
+            stats.gamma.logpdf(x, a=2.0, scale=1 / 3.0), rtol=1e-5)
+        assert abs(float(_np(d.entropy()))
+                   - stats.gamma.entropy(a=2.0, scale=1 / 3.0)) < 1e-5
+
+    def test_gamma_sample_mean(self):
+        paddle.seed(0)
+        d = D.Gamma(2.0, 3.0)
+        s = _np(d.sample((20000,)))
+        assert abs(s.mean() - 2.0 / 3.0) < 0.02
+
+    def test_beta(self):
+        from scipy import stats
+        d = D.Beta(2.0, 5.0)
+        x = np.array([0.1, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(x))),
+            stats.beta.logpdf(x, 2.0, 5.0), rtol=1e-4)
+        assert abs(float(_np(d.mean)) - 2.0 / 7.0) < 1e-6
+        assert abs(float(_np(d.entropy())) - stats.beta.entropy(2.0, 5.0)) \
+            < 1e-5
+
+
+class TestDiscrete:
+    def test_bernoulli(self):
+        d = D.Bernoulli(0.3)
+        lp = _np(d.log_prob(paddle.to_tensor(
+            np.array([0.0, 1.0], np.float32))))
+        np.testing.assert_allclose(lp, [np.log(0.7), np.log(0.3)], rtol=1e-6)
+        ent = -(0.3 * np.log(0.3) + 0.7 * np.log(0.7))
+        assert abs(float(_np(d.entropy())) - ent) < 1e-6
+        paddle.seed(0)
+        s = _np(d.sample((20000,)))
+        assert abs(s.mean() - 0.3) < 0.02
+
+    def test_binomial(self):
+        from scipy import stats
+        d = D.Binomial(10.0, 0.4)
+        k = np.array([0.0, 3.0, 10.0], np.float32)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(k))),
+            stats.binom.logpmf(k, 10, 0.4), rtol=1e-4)
+        assert abs(float(_np(d.entropy()))
+                   - stats.binom.entropy(10, 0.4)) < 1e-4
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        d = D.Categorical(logits)
+        lp = _np(d.log_prob(paddle.to_tensor(np.array([2]))))
+        np.testing.assert_allclose(lp, [np.log(0.5)], rtol=1e-5)
+        ent = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+        assert abs(float(_np(d.entropy())) - ent) < 1e-5
+        paddle.seed(0)
+        s = _np(d.sample((10000,)))
+        freq = np.bincount(s.astype(int).ravel(), minlength=3) / s.size
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+
+    def test_geometric_poisson(self):
+        from scipy import stats
+        g = D.Geometric(0.25)
+        k = np.array([0.0, 1.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            _np(g.log_prob(paddle.to_tensor(k))),
+            stats.geom.logpmf(k + 1, 0.25), rtol=1e-5)
+        p = D.Poisson(4.0)
+        np.testing.assert_allclose(
+            _np(p.log_prob(paddle.to_tensor(k))),
+            stats.poisson.logpmf(k, 4.0), rtol=1e-4)
+        assert abs(float(_np(p.entropy()))
+                   - stats.poisson.entropy(4.0)) < 1e-3
+
+    def test_multinomial(self):
+        from scipy import stats
+        probs = np.array([0.2, 0.3, 0.5], np.float32)
+        d = D.Multinomial(8, probs)
+        v = np.array([2.0, 2.0, 4.0], np.float32)
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(paddle.to_tensor(v)))),
+            stats.multinomial.logpmf(v, 8, probs), rtol=1e-4)
+        paddle.seed(0)
+        s = _np(d.sample((500,)))
+        assert s.shape == (500, 3)
+        np.testing.assert_allclose(s.sum(-1), 8.0)
+        np.testing.assert_allclose(s.mean(0), 8 * probs, atol=0.3)
+
+    def test_continuous_bernoulli(self):
+        d = D.ContinuousBernoulli(0.3)
+        paddle.seed(0)
+        s = _np(d.sample((50000,)))
+        assert abs(s.mean() - float(_np(d.mean))) < 0.01
+        # log_prob integrates to ~1
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001, dtype=np.float32)
+        pdf = np.exp(_np(d.log_prob(paddle.to_tensor(xs))))
+        assert abs(np.trapezoid(pdf, xs) - 1.0) < 1e-3
+
+
+class TestMultivariate:
+    def test_dirichlet(self):
+        from scipy import stats
+        a = np.array([2.0, 3.0, 5.0], np.float32)
+        d = D.Dirichlet(a)
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(paddle.to_tensor(x)))),
+            stats.dirichlet.logpdf(x, a), rtol=1e-5)
+        assert abs(float(_np(d.entropy())) - stats.dirichlet.entropy(a)) \
+            < 1e-5
+        paddle.seed(0)
+        s = _np(d.sample((5000,)))
+        np.testing.assert_allclose(s.mean(0), a / a.sum(), atol=0.01)
+
+    def test_mvn(self):
+        from scipy import stats
+        mean = np.array([1.0, -1.0], np.float32)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        d = D.MultivariateNormal(mean, covariance_matrix=cov)
+        x = np.array([0.0, 0.0], np.float32)
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(paddle.to_tensor(x)))),
+            stats.multivariate_normal.logpdf(x, mean, cov), rtol=1e-5)
+        assert abs(float(_np(d.entropy()))
+                   - stats.multivariate_normal.entropy(mean, cov)) < 1e-5
+        paddle.seed(0)
+        s = _np(d.sample((20000,)))
+        np.testing.assert_allclose(s.mean(0), mean, atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.08)
+
+
+class TestKL:
+    def test_normal_kl_golden(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        expect = np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+        assert abs(float(_np(D.kl_divergence(p, q))) - expect) < 1e-6
+
+    @pytest.mark.parametrize("p,q", [
+        (D.Exponential(2.0), D.Exponential(3.0)),
+        (D.Gamma(2.0, 3.0), D.Gamma(3.0, 2.0)),
+        (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+        (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),
+        (D.Gumbel(0.0, 1.0), D.Gumbel(0.5, 1.5)),
+    ])
+    def test_kl_vs_monte_carlo(self, p, q):
+        closed = float(_np(D.kl_divergence(p, q)))
+        mc = _mc_kl(p, q)
+        assert abs(closed - mc) < 0.05, (closed, mc)
+
+    def test_discrete_kls(self):
+        pb = D.Bernoulli(0.3)
+        qb = D.Bernoulli(0.6)
+        expect = 0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4)
+        assert abs(float(_np(D.kl_divergence(pb, qb))) - expect) < 1e-5
+        pc = D.Categorical(np.log(np.array([0.2, 0.8], np.float32)))
+        qc = D.Categorical(np.log(np.array([0.5, 0.5], np.float32)))
+        expect = 0.2 * np.log(0.2 / 0.5) + 0.8 * np.log(0.8 / 0.5)
+        assert abs(float(_np(D.kl_divergence(pc, qc))) - expect) < 1e-5
+
+    def test_mvn_kl_vs_normal(self):
+        p = D.MultivariateNormal(np.zeros(2, np.float32),
+                                 covariance_matrix=np.eye(2, dtype=np.float32))
+        q = D.MultivariateNormal(np.ones(2, np.float32),
+                                 covariance_matrix=4 * np.eye(2,
+                                                              dtype=np.float32))
+        # = 2 * KL(N(0,1) || N(1,2))
+        expect = 2 * (np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5)
+        assert abs(float(_np(D.kl_divergence(p, q))) - expect) < 1e-5
+
+    def test_dispatch_unregistered(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))
+
+
+class TestTransforms:
+    def test_affine_roundtrip(self):
+        t = D.AffineTransform(2.0, 3.0)
+        x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(_np(y), [5.0, -1.0])
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x), rtol=1e-6)
+        np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)),
+                                   np.log(3.0) * np.ones(2), rtol=1e-6)
+
+    @pytest.mark.parametrize("t,x", [
+        (D.ExpTransform(), np.array([0.5, -0.3], np.float32)),
+        (D.SigmoidTransform(), np.array([0.5, -0.3], np.float32)),
+        (D.TanhTransform(), np.array([0.5, -0.3], np.float32)),
+        (D.PowerTransform(2.0), np.array([0.5, 1.3], np.float32)),
+    ])
+    def test_log_det_vs_numeric(self, t, x):
+        xt = paddle.to_tensor(x)
+        y = _np(t.forward(xt))
+        np.testing.assert_allclose(_np(t.inverse(paddle.to_tensor(y))), x,
+                                   rtol=1e-4, atol=1e-5)
+        eps = 1e-3
+        dy = (_np(t.forward(paddle.to_tensor(x + eps)))
+              - _np(t.forward(paddle.to_tensor(x - eps)))) / (2 * eps)
+        np.testing.assert_allclose(_np(t.forward_log_det_jacobian(xt)),
+                                   np.log(np.abs(dy)), atol=1e-3)
+
+    def test_chain(self):
+        t = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.ExpTransform()])
+        x = paddle.to_tensor(np.array([0.1, 0.7], np.float32))
+        np.testing.assert_allclose(_np(t.forward(x)), np.exp(2 * _np(x)),
+                                   rtol=1e-5)
+
+    def test_stick_breaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.3, -0.2, 0.5], np.float32))
+        y = t.forward(x)
+        assert abs(float(_np(y.sum())) - 1.0) < 1e-5
+        assert _np(y).shape == (4,)
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_reshape(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+        y = t.forward(x)
+        assert tuple(y.shape) == (2, 2, 2)
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x))
+
+
+class TestWrappers:
+    def test_transformed_lognormal_matches(self):
+        base = D.Normal(0.2, 0.8)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(0.2, 0.8)
+        x = np.array([0.5, 1.0, 2.5], np.float32)
+        np.testing.assert_allclose(
+            _np(td.log_prob(paddle.to_tensor(x))),
+            _np(ln.log_prob(paddle.to_tensor(x))), rtol=1e-5)
+
+    def test_independent(self):
+        d = D.Independent(D.Normal(np.zeros(3, np.float32),
+                                   np.ones(3, np.float32)), 1)
+        assert d.batch_shape == () and d.event_shape == (3,)
+        x = np.array([0.1, 0.2, 0.3], np.float32)
+        base = D.Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(paddle.to_tensor(x)))),
+            _np(base.log_prob(paddle.to_tensor(x))).sum(), rtol=1e-6)

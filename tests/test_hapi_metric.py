@@ -303,3 +303,84 @@ class TestReviewRegressions:
         x = paddle.to_tensor(np.random.randn(1, 2, 6, 8).astype(np.float32))
         out = pnn.AdaptiveAvgPool2D(output_size=[None, 4])(x)
         assert tuple(out.shape) == (1, 2, 6, 4)
+
+
+class TestReviewRegressions2:
+    def test_accuracy_1d_binary_pred(self):
+        m = Accuracy()
+        pred = np.array([0.9, 0.2, 0.7], np.float32)   # P(class 1)
+        label = np.array([1, 0, 0])
+        m.update(m.compute(paddle.to_tensor(pred), paddle.to_tensor(label)))
+        assert abs(m.accumulate() - 2.0 / 3.0) < 1e-6
+
+    def test_reduce_lr_keeps_scheduler_decay(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+        from paddle_tpu.optimizer.lr import StepDecay
+        sched = StepDecay(0.1, step_size=1, gamma=0.5)
+        net = _mlp()
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=net.parameters())
+        model = paddle.Model(net)
+        model._optimizer = opt
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.1, patience=0,
+                               verbose=0)
+        cb.set_model(model)
+        cb.on_eval_end({"loss": 1.0})   # sets best
+        cb.on_eval_end({"loss": 2.0})   # plateau -> reduce
+        lr_before_step = sched.last_lr
+        epoch = sched.last_epoch
+        sched.step()
+        # after reduction, one more decay step halves (not collapses) lr
+        assert abs(sched.last_lr - lr_before_step * 0.5
+                   * (0.5 ** (sched.last_epoch - epoch - 1))) < 1e-12
+
+    def test_eval_logs_epoch_mean_loss(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        losses = []
+
+        class Spy(paddle.callbacks.Callback):
+            def on_eval_batch_end(self, step, logs=None):
+                losses.append(logs["loss"])
+
+        logs = model.evaluate(_XorData(40), batch_size=16, verbose=0,
+                              callbacks=[Spy()])
+        assert abs(logs["loss"] - np.mean(losses)) < 1e-9
+
+    def test_predict_multi_input_network(self):
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, a, b):
+                return self.fc(a + b)
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return (np.ones(4, np.float32), np.ones(4, np.float32))
+
+            def __len__(self):
+                return 8
+
+        model = paddle.Model(TwoIn())
+        model.prepare()
+        outs = model.predict(DS(), batch_size=4, verbose=0,
+                             stack_outputs=True)
+        assert outs[0].shape == (8, 2)
+
+    def test_jit_amp_train(self):
+        net = _mlp()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), amp_configs="O1", jit=True)
+        x = np.random.randn(16, 4).astype(np.float32)
+        y = np.random.randint(0, 2, (16,))
+        l0 = model.train_batch([x], [y])
+        for _ in range(10):
+            l1 = model.train_batch([x], [y])
+        assert l1 < l0
