@@ -233,7 +233,25 @@ def set_amp_hook(fn):
     _amp_cast_hook = fn
 
 
+# Profiler integration: when a profiler is recording it installs a span
+# factory here (paddle_tpu/profiler); None keeps the hot path branch-cheap.
+_OP_SPAN_HOOK = None
+
+
+def set_op_span_hook(hook):
+    global _OP_SPAN_HOOK
+    _OP_SPAN_HOOK = hook
+
+
 def _dispatch(schema: OpSchema, arguments: Dict[str, Any]):
+    hook = _OP_SPAN_HOOK
+    if hook is not None:
+        with hook(schema.name):
+            return _dispatch_impl(schema, arguments)
+    return _dispatch_impl(schema, arguments)
+
+
+def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
     primals: List[jax.Array] = []
     in_tensors: List[Optional[Tensor]] = []
     present: List[bool] = []

@@ -1,0 +1,107 @@
+"""Profiler: spans, scheduler, chrome export, statistics, benchmark timer."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, ProfilerTarget,
+                                 RecordEvent, TracerEventType, benchmark,
+                                 export_chrome_tracing, load_profiler_result,
+                                 make_scheduler)
+
+
+class TestScheduler:
+    def test_make_scheduler_cycle(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=2)
+        states = [sched(i) for i in range(8)]
+        assert states[0] is ProfilerState.CLOSED
+        assert states[1] is ProfilerState.READY
+        assert states[2] is ProfilerState.RECORD
+        assert states[3] is ProfilerState.RECORD_AND_RETURN
+        assert states[4] is ProfilerState.CLOSED
+        # after `repeat` periods it stays closed
+        assert all(s is ProfilerState.CLOSED for s in (sched(8), sched(20)))
+
+    def test_skip_first(self):
+        sched = make_scheduler(closed=0, ready=0, record=1, skip_first=3)
+        assert sched(2) is ProfilerState.CLOSED
+        assert sched(3) is ProfilerState.RECORD_AND_RETURN
+
+
+class TestProfiler:
+    def test_records_ops_and_exports(self, tmp_path):
+        got = {}
+
+        def on_ready(prof):
+            got["result"] = prof.get_profiler_result()
+
+        p = Profiler(targets=[ProfilerTarget.CPU], on_trace_ready=on_ready,
+                     trace_dir=str(tmp_path))
+        p.start()
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y = paddle.matmul(x, x)
+        with RecordEvent("user_block", TracerEventType.UserDefined):
+            _ = paddle.add(y, x)
+        p.stop()
+
+        events = got["result"].events
+        names = [e.name for e in events]
+        assert "matmul" in names and "add" in names and "user_block" in names
+        # op hook must be uninstalled after stop
+        from paddle_tpu.ops import dispatcher
+        assert dispatcher._OP_SPAN_HOOK is None
+
+        path = str(tmp_path / "trace.json")
+        got["result"].save(path)
+        loaded = load_profiler_result(path)
+        assert "matmul" in [e.name for e in loaded.events]
+        payload = json.load(open(path))
+        assert payload["traceEvents"][0]["ph"] == "X"
+
+    def test_step_schedule_window(self, tmp_path):
+        fired = []
+        p = Profiler(targets=[ProfilerTarget.CPU], scheduler=(2, 4),
+                     on_trace_ready=lambda prof: fired.append(prof.step_num),
+                     trace_dir=str(tmp_path))
+        p.start()
+        for _ in range(6):
+            paddle.to_tensor([1.0]) + 1.0
+            p.step()
+        p.stop()
+        assert fired, "on_trace_ready never fired for the (2,4) window"
+
+    def test_summary_renders(self, tmp_path, capsys):
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     on_trace_ready=lambda prof: None,
+                     trace_dir=str(tmp_path))
+        with p:
+            x = paddle.to_tensor(np.ones((8, 8), np.float32))
+            for _ in range(3):
+                x = paddle.matmul(x, x)
+        p.summary()
+        out = capsys.readouterr().out
+        assert "matmul" in out and "Calls" in out
+
+    def test_export_chrome_tracing_callback(self, tmp_path):
+        cb = export_chrome_tracing(str(tmp_path), worker_name="w0")
+        with Profiler(targets=[ProfilerTarget.CPU], on_trace_ready=cb,
+                      trace_dir=str(tmp_path)):
+            paddle.to_tensor([2.0]) * 3.0
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+        assert files and files[0].startswith("w0")
+
+
+class TestBenchmarkTimer:
+    def test_ips(self):
+        bm = benchmark()
+        bm.begin()
+        for _ in range(6):
+            bm.step(num_samples=32)
+        bm.end()
+        rep = bm.report()
+        assert rep["steps"] == 6
+        assert bm.speed_average() >= 0
