@@ -1,0 +1,98 @@
+// Native process-wide flag registry.
+//
+// TPU-native rebuild of the reference's exported-flags system
+// (paddle/common/flags.cc:31 PHI_DEFINE_EXPORTED_*, with its self-hosted
+// gflags clone paddle/common/flags_native.cc): a C-ABI registry shared by
+// the C++ runtime pieces and the Python `paddle.set_flags` bridge
+// (paddle_tpu/flags.py loads this through ctypes when built).
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Flag {
+  std::string type;  // "bool" | "int" | "double" | "string"
+  std::string value;
+  std::string default_value;
+  std::string help;
+};
+
+std::map<std::string, Flag>& Registry() {
+  static std::map<std::string, Flag> r;
+  return r;
+}
+
+std::mutex& Mu() {
+  static std::mutex m;
+  return m;
+}
+
+thread_local std::string t_scratch;
+
+}  // namespace
+
+extern "C" {
+
+int PT_RegisterFlag(const char* name, const char* type,
+                    const char* default_value, const char* help) {
+  std::lock_guard<std::mutex> g(Mu());
+  auto& r = Registry();
+  if (r.count(name)) return -1;
+  Flag f{type, default_value, default_value, help ? help : ""};
+  // env override: FLAGS_<name>
+  std::string env_name = std::string("FLAGS_") + name;
+  if (const char* env = std::getenv(env_name.c_str())) f.value = env;
+  r.emplace(name, std::move(f));
+  return 0;
+}
+
+int PT_SetFlag(const char* name, const char* value) {
+  std::lock_guard<std::mutex> g(Mu());
+  auto it = Registry().find(name);
+  if (it == Registry().end()) return -1;
+  it->second.value = value;
+  return 0;
+}
+
+// Returns the value as a C string valid until this thread's next call.
+const char* PT_GetFlag(const char* name) {
+  std::lock_guard<std::mutex> g(Mu());
+  auto it = Registry().find(name);
+  if (it == Registry().end()) return nullptr;
+  t_scratch = it->second.value;
+  return t_scratch.c_str();
+}
+
+const char* PT_GetFlagType(const char* name) {
+  std::lock_guard<std::mutex> g(Mu());
+  auto it = Registry().find(name);
+  if (it == Registry().end()) return nullptr;
+  t_scratch = it->second.type;
+  return t_scratch.c_str();
+}
+
+int PT_HasFlag(const char* name) {
+  std::lock_guard<std::mutex> g(Mu());
+  return Registry().count(name) ? 1 : 0;
+}
+
+int PT_FlagCount() {
+  std::lock_guard<std::mutex> g(Mu());
+  return static_cast<int>(Registry().size());
+}
+
+const char* PT_FlagNameAt(int i) {
+  std::lock_guard<std::mutex> g(Mu());
+  if (i < 0 || i >= static_cast<int>(Registry().size())) return nullptr;
+  auto it = Registry().begin();
+  std::advance(it, i);
+  t_scratch = it->first;
+  return t_scratch.c_str();
+}
+
+}  // extern "C"

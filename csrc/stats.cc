@@ -1,0 +1,92 @@
+// Native memory/alloc stat registry with peak tracking.
+//
+// TPU-native analog of the reference's memory stats
+// (paddle/fluid/memory/stats.cc: per-device Allocated/Reserved counters with
+// peaks, HostMemoryStat*/DeviceMemoryStat* accessors). Device buffers live
+// inside PJRT/XLA here, so the framework tracks logical allocation events
+// (tensor materialisations, checkpoint buffers, dataloader slabs) through
+// this facade; peaks survive resets of the current value.
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Stat {
+  int64_t current = 0;
+  int64_t peak = 0;
+  int64_t total_alloc = 0;  // cumulative increments
+};
+
+std::map<std::string, Stat>& Registry() {
+  static std::map<std::string, Stat> r;
+  return r;
+}
+
+std::mutex& Mu() {
+  static std::mutex m;
+  return m;
+}
+
+thread_local std::string t_scratch;
+
+}  // namespace
+
+extern "C" {
+
+// delta may be negative (free). Returns the new current value.
+int64_t PT_StatUpdate(const char* name, int64_t delta) {
+  std::lock_guard<std::mutex> g(Mu());
+  Stat& s = Registry()[name];
+  s.current += delta;
+  if (delta > 0) s.total_alloc += delta;
+  if (s.current > s.peak) s.peak = s.current;
+  return s.current;
+}
+
+int64_t PT_StatCurrent(const char* name) {
+  std::lock_guard<std::mutex> g(Mu());
+  auto it = Registry().find(name);
+  return it == Registry().end() ? 0 : it->second.current;
+}
+
+int64_t PT_StatPeak(const char* name) {
+  std::lock_guard<std::mutex> g(Mu());
+  auto it = Registry().find(name);
+  return it == Registry().end() ? 0 : it->second.peak;
+}
+
+int64_t PT_StatTotal(const char* name) {
+  std::lock_guard<std::mutex> g(Mu());
+  auto it = Registry().find(name);
+  return it == Registry().end() ? 0 : it->second.total_alloc;
+}
+
+void PT_StatResetPeak(const char* name) {
+  std::lock_guard<std::mutex> g(Mu());
+  auto it = Registry().find(name);
+  if (it != Registry().end()) it->second.peak = it->second.current;
+}
+
+void PT_StatReset(const char* name) {
+  std::lock_guard<std::mutex> g(Mu());
+  Registry().erase(name);
+}
+
+int PT_StatCount() {
+  std::lock_guard<std::mutex> g(Mu());
+  return static_cast<int>(Registry().size());
+}
+
+const char* PT_StatNameAt(int i) {
+  std::lock_guard<std::mutex> g(Mu());
+  if (i < 0 || i >= static_cast<int>(Registry().size())) return nullptr;
+  auto it = Registry().begin();
+  std::advance(it, i);
+  t_scratch = it->first;
+  return t_scratch.c_str();
+}
+
+}  // extern "C"

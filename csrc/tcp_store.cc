@@ -1,0 +1,445 @@
+// Native TCPStore: key-value rendezvous for multi-host launch.
+//
+// TPU-native rebuild of the reference's bootstrap store
+// (paddle/phi/core/distributed/store/tcp_store.h:121 — the KV service every
+// ProcessGroup rendezvous and the launcher's master ride on). One process
+// (rank 0) runs the server thread; every rank connects a client and issues
+// SET / GET / ADD / WAIT / DELETE over a length-prefixed binary protocol.
+// ADD is atomic (returns the post-increment value) and WAIT blocks server-
+// side on a condition variable until the key exists or the timeout fires,
+// so barriers cost no client-side polling.
+//
+// Wire format, request:  u8 cmd | u32 key_len | key | i64 arg | payload
+//   SET(0):   arg = payload length, payload = value bytes
+//   GET(1):   arg unused
+//   ADD(2):   arg = delta (i64)
+//   WAIT(3):  arg = timeout in ms (<=0: wait forever)
+//   DEL(4):   arg unused
+//   COUNT(5): arg unused (key ignored)
+// Response: i64 status_or_len | payload
+//   status >= 0: payload length (GET/ADD) or success (SET/WAIT/DEL/COUNT)
+//   status  < 0: error (-1 missing key / timeout)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kDel = 4,
+                     kCount = 5 };
+
+bool ReadN(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteN(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Server
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    if (port_ == 0) {  // ephemeral port: report what the OS picked
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    stop_.store(true);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    cv_.notify_all();
+    // accept loop first: once it exits, no new Serve threads can appear
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> threads;
+    {
+      // unblock Serve threads parked in recv() on live client connections —
+      // without this, join() below waits for every client to disconnect.
+      // Joining happens OUTSIDE the lock: exiting Serve threads re-acquire
+      // threads_mu_ to erase their fd.
+      std::lock_guard<std::mutex> g(threads_mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+      threads.swap(conn_threads_);
+    }
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
+  }
+
+  int port() const { return port_; }
+
+  ~StoreServer() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(threads_mu_);
+      conn_fds_.insert(fd);
+      conn_threads_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    bool dead = false;
+    while (!stop_.load() && !dead) {
+      uint8_t cmd;
+      uint32_t key_len;
+      int64_t arg;
+      if (!ReadN(fd, &cmd, 1) || !ReadN(fd, &key_len, 4) ) break;
+      if (key_len > kMaxKeyLen) break;  // malformed frame: drop connection
+      std::string key(key_len, '\0');
+      if (key_len && !ReadN(fd, key.data(), key_len)) break;
+      if (!ReadN(fd, &arg, 8)) break;
+
+      int64_t status = 0;
+      std::string payload;
+      switch (cmd) {
+        case kSet: {
+          if (arg < 0 || arg > kMaxValueLen) {  // unvalidated wire length
+            dead = true;                        // would throw std::length_error
+            break;
+          }
+          payload.resize(static_cast<size_t>(arg));
+          if (arg && !ReadN(fd, payload.data(), payload.size())) {
+            dead = true;  // fall through to the close below (no fd leak)
+            break;
+          }
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            data_[key] = payload;
+          }
+          cv_.notify_all();
+          payload.clear();
+          status = 0;
+          break;
+        }
+        case kGet: {
+          std::lock_guard<std::mutex> g(mu_);
+          auto it = data_.find(key);
+          if (it == data_.end()) {
+            status = -1;
+          } else {
+            payload = it->second;
+            status = static_cast<int64_t>(payload.size());
+          }
+          break;
+        }
+        case kAdd: {
+          int64_t v;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            std::string& cur = data_[key];
+            v = cur.empty() ? 0 : std::strtoll(cur.c_str(), nullptr, 10);
+            v += arg;
+            cur = std::to_string(v);
+          }
+          cv_.notify_all();
+          payload.assign(reinterpret_cast<char*>(&v), 8);
+          status = 8;
+          break;
+        }
+        case kWait: {
+          std::unique_lock<std::mutex> lk(mu_);
+          auto pred = [&] { return stop_.load() || data_.count(key) > 0; };
+          bool ok;
+          if (arg > 0) {
+            ok = cv_.wait_for(lk, std::chrono::milliseconds(arg), pred);
+          } else {
+            cv_.wait(lk, pred);
+            ok = true;
+          }
+          status = (ok && data_.count(key)) ? 0 : -1;
+          break;
+        }
+        case kDel: {
+          std::lock_guard<std::mutex> g(mu_);
+          status = data_.erase(key) ? 1 : 0;
+          break;
+        }
+        case kCount: {
+          std::lock_guard<std::mutex> g(mu_);
+          status = static_cast<int64_t>(data_.size());
+          break;
+        }
+        default:
+          status = -2;
+      }
+      if (dead) break;
+      if (!WriteN(fd, &status, 8)) break;
+      if (status > 0 && !payload.empty() &&
+          !WriteN(fd, payload.data(), payload.size()))
+        break;
+    }
+    {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      conn_fds_.erase(fd);
+    }
+    ::close(fd);
+  }
+
+  static constexpr uint32_t kMaxKeyLen = 1u << 16;
+  static constexpr int64_t kMaxValueLen = int64_t{1} << 30;
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stop_{false};
+  std::thread accept_thread_;
+  std::mutex threads_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::set<int> conn_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+// ---------------------------------------------------------------------------
+// Client
+
+class StoreClient {
+ public:
+  StoreClient(const std::string& host, int port) : host_(host), port_(port) {}
+
+  bool Connect(int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    do {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd_ < 0) return false;
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port_));
+      if (::inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1) {
+        // allow "localhost"
+        if (host_ == "localhost") {
+          ::inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+        } else {
+          ::close(fd_);
+          return false;
+        }
+      }
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      fd_ = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    } while (std::chrono::steady_clock::now() < deadline);
+    return false;
+  }
+
+  // Returns status; fills out (GET/ADD payload).
+  int64_t Request(uint8_t cmd, const std::string& key, int64_t arg,
+                  const std::string& value, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (fd_ < 0) return -3;
+    uint32_t key_len = static_cast<uint32_t>(key.size());
+    if (!WriteN(fd_, &cmd, 1) || !WriteN(fd_, &key_len, 4) ||
+        (key_len && !WriteN(fd_, key.data(), key_len)) ||
+        !WriteN(fd_, &arg, 8))
+      return -3;
+    if (cmd == kSet && !value.empty() &&
+        !WriteN(fd_, value.data(), value.size()))
+      return -3;
+    int64_t status;
+    if (!ReadN(fd_, &status, 8)) return -3;
+    if (status > 0 && (cmd == kGet || cmd == kAdd)) {
+      out->resize(static_cast<size_t>(status));
+      if (!ReadN(fd_, out->data(), out->size())) return -3;
+    }
+    return status;
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  std::string host_;
+  int port_;
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+std::mutex g_handles_mu;
+std::map<int64_t, StoreServer*> g_servers;
+std::map<int64_t, StoreClient*> g_clients;
+int64_t g_next_handle = 1;
+
+thread_local std::string t_payload;
+
+}  // namespace
+
+extern "C" {
+
+// Returns a handle (>0) or 0 on failure.
+int64_t PT_TCPStoreServerStart(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->Start()) {
+    delete s;
+    return 0;
+  }
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  int64_t h = g_next_handle++;
+  g_servers[h] = s;
+  return h;
+}
+
+int PT_TCPStoreServerPort(int64_t h) {
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  auto it = g_servers.find(h);
+  return it == g_servers.end() ? -1 : it->second->port();
+}
+
+void PT_TCPStoreServerStop(int64_t h) {
+  StoreServer* s = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_handles_mu);
+    auto it = g_servers.find(h);
+    if (it == g_servers.end()) return;
+    s = it->second;
+    g_servers.erase(it);
+  }
+  delete s;  // ~StoreServer stops threads
+}
+
+int64_t PT_TCPStoreClientNew(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient(host, port);
+  if (!c->Connect(timeout_ms)) {
+    delete c;
+    return 0;
+  }
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  int64_t h = g_next_handle++;
+  g_clients[h] = c;
+  return h;
+}
+
+void PT_TCPStoreClientFree(int64_t h) {
+  StoreClient* c = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_handles_mu);
+    auto it = g_clients.find(h);
+    if (it == g_clients.end()) return;
+    c = it->second;
+    g_clients.erase(it);
+  }
+  delete c;
+}
+
+static StoreClient* Client(int64_t h) {
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+int64_t PT_TCPStoreSet(int64_t h, const char* key, const char* data,
+                       int64_t len) {
+  StoreClient* c = Client(h);
+  if (!c) return -3;
+  return c->Request(kSet, key, len, std::string(data, len), nullptr);
+}
+
+// Returns payload length (>=0) or <0; payload readable via PT_TCPStoreData.
+int64_t PT_TCPStoreGet(int64_t h, const char* key) {
+  StoreClient* c = Client(h);
+  if (!c) return -3;
+  return c->Request(kGet, key, 0, "", &t_payload);
+}
+
+const char* PT_TCPStoreData() { return t_payload.data(); }
+
+int64_t PT_TCPStoreAdd(int64_t h, const char* key, int64_t delta) {
+  StoreClient* c = Client(h);
+  if (!c) return -3;
+  std::string out;
+  int64_t status = c->Request(kAdd, key, delta, "", &out);
+  if (status != 8) return INT64_MIN;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+int64_t PT_TCPStoreWait(int64_t h, const char* key, int64_t timeout_ms) {
+  StoreClient* c = Client(h);
+  if (!c) return -3;
+  return c->Request(kWait, key, timeout_ms, "", nullptr);
+}
+
+int64_t PT_TCPStoreDelete(int64_t h, const char* key) {
+  StoreClient* c = Client(h);
+  if (!c) return -3;
+  return c->Request(kDel, key, 0, "", nullptr);
+}
+
+int64_t PT_TCPStoreNumKeys(int64_t h) {
+  StoreClient* c = Client(h);
+  if (!c) return -3;
+  return c->Request(kCount, "", 0, "", nullptr);
+}
+
+}  // extern "C"

@@ -4,9 +4,11 @@ TPU-native analog of the reference's exported-flags system
 (paddle/common/flags.cc:31 `PHI_DEFINE_EXPORTED_*`, ~135 flags with `FLAGS_*`
 env override, surfaced to Python via `paddle.set_flags`/`get_flags`).
 
-Here the registry is pure Python (the native runtime reads flags through the
-same dict); flags may be seeded from the environment (`FLAGS_<name>=...`) and
-mutated at runtime via :func:`set_flags`.
+The registry is dual-homed: the Python dict is authoritative for the eager
+layer, and every definition/mutation is mirrored into the native C++ registry
+(csrc/flags.cc, bound via paddle_tpu.native) once that library loads, so C++
+runtime components read the same flags. Flags may be seeded from the
+environment (`FLAGS_<name>=...`) and mutated at runtime via :func:`set_flags`.
 """
 
 from __future__ import annotations
@@ -26,6 +28,22 @@ class _Flag:
 
 
 _REGISTRY: Dict[str, _Flag] = {}
+_NATIVE = None  # ctypes lib once paddle_tpu.native loads
+
+
+def _mirror_one(lib, f: "_Flag") -> None:
+    ctype_name = {bool: "bool", int: "int", float: "double"}.get(
+        f.ctype, "string")
+    lib.PT_RegisterFlag(f.name.encode(), ctype_name.encode(),
+                        str(f.default).encode(), f.help.encode())
+    lib.PT_SetFlag(f.name.encode(), str(f.value).encode())
+
+
+def _mirror_native(lib):
+    global _NATIVE
+    _NATIVE = lib
+    for f in _REGISTRY.values():
+        _mirror_one(lib, f)
 
 
 def _parse_env(raw: str, ctype: type) -> Any:
@@ -42,6 +60,8 @@ def define_flag(name: str, default: Any, help: str = "") -> None:
     if env is not None:
         value = _parse_env(env, ctype)
     _REGISTRY[name] = _Flag(name, default, help, ctype, value)
+    if _NATIVE is not None:
+        _mirror_one(_NATIVE, _REGISTRY[name])
 
 
 def get_flags(names) -> Dict[str, Any]:
@@ -66,7 +86,14 @@ def set_flags(flags: Dict[str, Any]) -> None:
         if k not in _REGISTRY:
             raise ValueError(f"unknown flag: {k}")
         f = _REGISTRY[k]
-        f.value = f.ctype(v) if not isinstance(v, f.ctype) else v
+        if isinstance(v, f.ctype):
+            f.value = v
+        elif isinstance(v, str):
+            f.value = _parse_env(v, f.ctype)  # 'false'/'0' must not read True
+        else:
+            f.value = f.ctype(v)
+        if _NATIVE is not None:
+            _NATIVE.PT_SetFlag(k.encode(), str(f.value).encode())
 
 
 # -- Core flags (subset mirroring paddle/common/flags.cc) ---------------------
@@ -77,3 +104,10 @@ define_flag("benchmark", False, "block on every op for accurate timing")
 define_flag("low_precision_op_list", 0, "log ops run in low precision under AMP")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")
+
+
+# Mirror into the native C++ registry (csrc/flags.cc) once it loads; until
+# then the Python dict is the sole home (no toolchain required to import).
+from .native import on_load as _native_on_load  # noqa: E402
+
+_native_on_load(_mirror_native)

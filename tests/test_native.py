@@ -1,0 +1,140 @@
+"""Native C++ layer: flags registry, stats, TCPStore (native + py fallback).
+
+Reference analogs: paddle/common/flags.cc, paddle/fluid/memory/stats.cc,
+paddle/phi/core/distributed/store/tcp_store.h.
+"""
+
+import multiprocessing as mp
+import sys
+
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.native import stats
+from paddle_tpu.native.tcp_store import TCPStore, _PyStoreClient, _PyStoreServer
+
+
+class TestNativeLib:
+    def test_builds_and_loads(self):
+        assert native.available(), "csrc should compile with the baked g++"
+
+    def test_flags_mirrored(self):
+        import paddle_tpu as paddle
+        lib = native.load()
+        assert lib.PT_HasFlag(b"check_nan_inf") == 1
+        paddle.set_flags({"FLAGS_benchmark": True})
+        assert lib.PT_GetFlag(b"benchmark") == b"True"
+        paddle.set_flags({"FLAGS_benchmark": False})
+        assert lib.PT_GetFlag(b"benchmark") == b"False"
+        # python view agrees
+        assert paddle.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is False
+
+    def test_stats_peak_tracking(self):
+        stats.reset("t/alloc")
+        stats.update("t/alloc", 100)
+        stats.update("t/alloc", 200)
+        stats.update("t/alloc", -150)
+        assert stats.current("t/alloc") == 150
+        assert stats.peak("t/alloc") == 300
+        assert stats.total("t/alloc") == 300
+        stats.reset_peak("t/alloc")
+        assert stats.peak("t/alloc") == 150
+        assert "t/alloc" in stats.all_stats()
+
+
+def _store_worker(rank, port, q):
+    st = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+    st.set(f"k{rank}", f"v{rank}")
+    n = st.add("cnt", 1)
+    st.barrier("b", 2)
+    q.put((rank, n, st.get("k0").decode()))
+    st.close()
+
+
+class TestTCPStore:
+    def test_single_process_ops(self):
+        st = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        st.set("a", b"xyz")
+        assert st.get("a") == b"xyz"
+        assert st.add("c", 5) == 5
+        assert st.add("c", 2) == 7
+        assert st.wait("a", 1000) == 0
+        assert st.wait("missing", 50) == -1
+        assert st.delete("a") is True
+        assert st.delete("a") is False
+        st.barrier("solo", 1)
+        st.close()
+
+    def test_multiprocess_rendezvous(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_store_worker, args=(1, master.port, q))
+        p.start()
+        master.set("k0", "v0")
+        n0 = master.add("cnt", 1)
+        master.barrier("b", 2)
+        rank, n1, got = q.get(timeout=60)
+        p.join(timeout=30)
+        assert sorted([n0, n1]) == [1, 2]
+        assert got == "v0"
+        assert master.get("k1") == b"v1"
+        master.close()
+
+    def test_python_fallback_protocol(self):
+        # exercise the pure-python server/client pair directly (used when the
+        # native toolchain is absent) — same wire protocol.
+        srv = _PyStoreServer(0)
+        cli = _PyStoreClient("127.0.0.1", srv.port, timeout_s=10)
+        assert cli.request(0, "k", 3, b"abc")[0] == 0          # SET
+        assert cli.request(1, "k")[1] == b"abc"                 # GET
+        assert cli.request(2, "n", 4)[1][:1] == b"\x04"         # ADD
+        assert cli.request(3, "k", 1000)[0] == 0                # WAIT
+        assert cli.request(5, "")[0] == 2                       # COUNT
+        cli.close()
+        srv.stop()
+
+
+class TestServerRobustness:
+    def test_malformed_set_frame_does_not_crash_server(self):
+        """A negative SET length from a stray connection must drop that
+        connection only, not std::terminate the process."""
+        import socket
+        import struct
+        st = TCPStore("127.0.0.1", 0, is_master=True)
+        s = socket.create_connection(("127.0.0.1", st.port), timeout=5)
+        s.sendall(struct.pack("<BI", 0, 1) + b"x" + struct.pack("<q", -1))
+        s.close()
+        # server still serves the healthy client
+        st.set("alive", b"1")
+        assert st.get("alive") == b"1"
+        st.close()
+
+    def test_close_with_live_second_client_returns(self):
+        """Stop() must shut down parked connection threads, not wait for
+        every client to disconnect."""
+        import threading
+        st = TCPStore("127.0.0.1", 0, is_master=True)
+        other = TCPStore("127.0.0.1", st.port, is_master=False)
+        done = threading.Event()
+
+        def closer():
+            st.close()
+            done.set()
+
+        t = threading.Thread(target=closer)
+        t.start()
+        assert done.wait(timeout=10), "close() hung with a live client"
+        t.join()
+        other._py_client and other._py_client.close()
+
+    def test_add_raises_on_dead_server(self):
+        st = TCPStore("127.0.0.1", 0, is_master=True)
+        port = st.port
+        client = TCPStore("127.0.0.1", port, is_master=False)
+        st.close()
+        import pytest as _pytest
+        with _pytest.raises((ConnectionError, OSError)):
+            for _ in range(3):  # first call may still see buffered socket
+                client.add("k", 1)
+        client.close()
