@@ -6,6 +6,7 @@ PP schedules, SEP ring attention, MoE a2a) are re-expressed as sharding
 annotations + shard_map. See SURVEY.md §2.5 / §7 for the full mapping table.
 """
 
+from . import checkpoint  # noqa: F401
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401
 from .api import (  # noqa: F401
@@ -46,3 +47,5 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     """reference distributed/spawn.py — single-controller runtime drives all
     local devices in-process, so spawn degenerates to a direct call."""
     return func(*args)
+from . import watchdog  # noqa: E402,F401
+from .watchdog import comm_watchdog  # noqa: E402,F401

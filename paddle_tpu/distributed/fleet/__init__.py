@@ -152,3 +152,5 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     (HybridParallelOptimizer:254) is vacuous; global-norm clip already spans
     the mesh via psum. ZeRO state sharding: see shard_optimizer."""
     return optimizer
+
+from .elastic import ElasticManager, ElasticStatus  # noqa: E402,F401

@@ -1,0 +1,118 @@
+"""Elastic training manager (reference fleet/elastic/manager.py:126).
+
+The reference registers nodes in etcd with TTL leases (:221-256) and watches
+membership to decide scale-in/out between --elastic_level bounds. No etcd in
+this stack: nodes heartbeat timestamped keys into the job's TCPStore and
+membership is derived from heartbeat freshness — same TTL-lease semantics,
+one fewer external service.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ...native.tcp_store import TCPStore
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"       # waiting for nodes
+    RESTART = "restart"  # membership changed -> relaunch
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store: TCPStore, node_id: str,
+                 np_min: int, np_max: Optional[int] = None,
+                 ttl: float = 10.0, job_id: str = "default"):
+        self.store = store
+        self.node_id = node_id
+        self.np_min = np_min
+        self.np_max = np_max or np_min
+        self.ttl = ttl
+        self.prefix = f"elastic/{job_id}"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_members: Optional[List[str]] = None
+        self.enabled = np_min > 0
+
+    # -- lease emulation -----------------------------------------------------
+    def register(self):
+        """Announce this node (membership index + first heartbeat) and start
+        the heartbeat lease."""
+        self.store.set(f"{self.prefix}/nodes/{self.node_id}", self.node_id)
+        self._register_index()
+        self._beat()
+        self._thread = threading.Thread(target=self._beat_loop, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        self.store.set(f"{self.prefix}/beat/{self.node_id}",
+                       repr(time.time()))
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.ttl / 3):
+            try:
+                self._beat()
+            except Exception:
+                return
+
+    # -- membership ----------------------------------------------------------
+    def _known_nodes(self) -> List[str]:
+        count = self.store.get(f"{self.prefix}/index_count", wait=False)
+        n = int(count) if count else 0
+        nodes = []
+        for i in range(1, n + 1):
+            raw = self.store.get(f"{self.prefix}/index/{i}", wait=False)
+            if raw:
+                nodes.append(raw.decode())
+        return nodes
+
+    def _register_index(self):
+        """Atomic membership registration: claim a slot via the store's
+        atomic add, then publish this node's id into it (no lost updates
+        under concurrent joins)."""
+        if self.node_id in self._known_nodes():
+            return
+        slot = self.store.add(f"{self.prefix}/index_count", 1)
+        self.store.set(f"{self.prefix}/index/{slot}", self.node_id)
+
+    def alive_nodes(self) -> List[str]:
+        """Nodes whose lease (heartbeat) is fresh within TTL."""
+        now = time.time()
+        alive = []
+        for n in self._known_nodes():
+            raw = self.store.get(f"{self.prefix}/beat/{n}", wait=False)
+            if raw is not None and now - float(raw) < self.ttl:
+                alive.append(n)
+        return alive
+
+    def pod_status(self) -> str:
+        alive = self.alive_nodes()
+        n = len(alive)
+        if n < self.np_min:
+            return ElasticStatus.HOLD
+        if self._last_members is not None and alive != self._last_members:
+            self._last_members = alive
+            return ElasticStatus.RESTART
+        self._last_members = alive
+        return ElasticStatus.COMPLETED
+
+    def wait_for_np(self, timeout: float = 60.0) -> bool:
+        """Block until at least np_min nodes hold fresh leases."""
+        self._register_index()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.alive_nodes()) >= self.np_min:
+                self._last_members = self.alive_nodes()
+                return True
+            time.sleep(min(1.0, self.ttl / 5))
+        return False
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

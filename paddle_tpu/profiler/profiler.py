@@ -131,6 +131,10 @@ def make_scheduler(*, closed: int, ready: int, record: int,
                    repeat: int = 0, skip_first: int = 0
                    ) -> Callable[[int], ProfilerState]:
     """Cyclic state schedule (reference profiler.py:126)."""
+    if closed < 0 or ready < 0 or record < 1:
+        raise ValueError(
+            f"make_scheduler needs closed>=0, ready>=0, record>=1; got "
+            f"closed={closed}, ready={ready}, record={record}")
     num_steps = closed + ready + record
 
     def schedule(step: int) -> ProfilerState:
@@ -241,6 +245,10 @@ class Profiler:
             self._scheduler = scheduler
         else:  # (start, end) tuple
             start, end = scheduler
+            if end <= start or start < 0:
+                raise ValueError(
+                    f"scheduler window needs 0 <= start < end; got "
+                    f"({start}, {end})")
             self._scheduler = make_scheduler(
                 closed=max(start - 1, 0), ready=1 if start > 0 else 0,
                 record=end - start, repeat=1)
@@ -264,6 +272,8 @@ class Profiler:
         return False
 
     def start(self):
+        from .timer import benchmark
+        benchmark().begin()
         if self.timer_only:
             return
         self.current_state = self._scheduler(self.step_num)
@@ -273,6 +283,8 @@ class Profiler:
         self._begin_step_span()
 
     def stop(self):
+        from .timer import benchmark
+        benchmark().end()
         if self.timer_only:
             return
         self._end_step_span()
@@ -328,7 +340,8 @@ class Profiler:
         from ..ops import dispatcher
         dispatcher.set_op_span_hook(None)
         events = _recorder.stop()
-        if self._device_tracing:
+        had_device_trace = self._device_tracing
+        if had_device_trace:
             try:
                 import jax
                 jax.profiler.stop_trace()
@@ -336,7 +349,7 @@ class Profiler:
                 pass
             self._device_tracing = False
         self._result = ProfilerResult(
-            events, self.trace_dir if self._device_tracing else None)
+            events, self.trace_dir if had_device_trace else None)
 
     def _begin_step_span(self):
         self._step_span = RecordEvent(

@@ -65,8 +65,9 @@ def gen_summary(events, sorted_by=None, time_unit: str = "ms",
         SortedKeys.CPUMax: lambda s: s.max_ns,
         SortedKeys.CPUMin: lambda s: s.min_ns or 0,
     }.get(sorted_by, lambda s: s.total_ns)
+    # ratio denominator spans ALL collected events, not just displayed rows
+    total = sum(s.total_ns for s in table.values()) or 1
     rows = sorted(table.values(), key=key, reverse=True)[:row_limit]
-    total = sum(s.total_ns for s in rows) or 1
 
     name_w = max([len("Name")] + [min(len(s.name), 48) for s in rows]) + 2
     hdr = (f"{'Name':<{name_w}}{'Calls':>8}{'Total(' + time_unit + ')':>14}"

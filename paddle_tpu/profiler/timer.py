@@ -1,8 +1,9 @@
 """Throughput benchmark timer (reference python/paddle/profiler/timer.py).
 
 Tracks per-step wall time and samples/sec with warmup discard; surfaced via
-`paddle.profiler.benchmark()` and used by Profiler.step(num_samples) and the
-hapi fit loop.
+`paddle.profiler.benchmark()`. Profiler.start()/stop() begin/end it and
+Profiler.step(num_samples) feeds it, so `Profiler(timer_only=True)` is a
+zero-overhead throughput meter.
 """
 
 from __future__ import annotations

@@ -1,0 +1,187 @@
+"""Launcher CLI, elastic manager, comm watchdog.
+
+Model: the reference's single-host multi-process harness
+(test/legacy_test/test_parallel_dygraph_dataparallel.py — start_local_trainers
+with PADDLE_TRAINER_* envs) and elastic manager tests.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.launch import (CollectiveController, Container,
+                                           Context, Master, Pod)
+from paddle_tpu.distributed.fleet import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.watchdog import CommTaskManager
+from paddle_tpu.native.tcp_store import TCPStore
+
+
+@pytest.fixture
+def train_script(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        out = sys.argv[1]
+        info = {k: os.environ[k] for k in (
+            "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_LOCAL_RANK",
+            "PADDLE_TRAINER_ENDPOINTS", "PADDLE_DIST_COORDINATOR")}
+        with open(os.path.join(out, os.environ["PADDLE_TRAINER_ID"] + ".json"),
+                  "w") as f:
+            json.dump(info, f)
+    """))
+    return str(script)
+
+
+class TestLauncher:
+    def test_single_node_two_procs(self, tmp_path, train_script):
+        out = tmp_path / "out"
+        out.mkdir()
+        ctx = Context(["--nproc_per_node", "2", "--log_dir",
+                       str(tmp_path / "log"), train_script, str(out)])
+        ctl = CollectiveController(ctx)
+        assert ctl.run() == 0
+        ranks = sorted(os.listdir(out))
+        assert ranks == ["0.json", "1.json"]
+        info0 = json.load(open(out / "0.json"))
+        assert info0["PADDLE_TRAINERS_NUM"] == "2"
+        assert len(info0["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 2
+
+    def test_failed_child_propagates(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import sys; sys.exit(3)")
+        ctx = Context(["--nproc_per_node", "2", "--log_dir",
+                       str(tmp_path / "log"), str(bad)])
+        ctl = CollectiveController(ctx)
+        assert ctl.run() == 1
+
+    def test_multinode_rank_assignment(self, tmp_path, train_script):
+        """Two 'nodes' on one host rendezvous through one TCPStore master."""
+        import threading
+        from paddle_tpu.distributed.launch.context import free_port
+        port = free_port()
+        outs = [tmp_path / "n0", tmp_path / "n1"]
+        [o.mkdir() for o in outs]
+        rets = {}
+
+        def run_node(rank):
+            ctx = Context(["--nnodes", "2", "--node_rank", str(rank),
+                           "--master", f"127.0.0.1:{port}",
+                           "--nproc_per_node", "2",
+                           "--log_dir", str(tmp_path / f"log{rank}"),
+                           train_script, str(outs[rank])])
+            ctl = CollectiveController(ctx)
+            rets[rank] = ctl.run()
+            ctl.stop()
+
+        t1 = threading.Thread(target=run_node, args=(1,))
+        t1.start()
+        run_node(0)
+        t1.join(timeout=120)
+        assert rets == {0: 0, 1: 0}
+        # node 0 got global ranks 0,1; node 1 got 2,3; world=4 everywhere
+        assert sorted(os.listdir(outs[0])) == ["0.json", "1.json"]
+        assert sorted(os.listdir(outs[1])) == ["2.json", "3.json"]
+        info3 = json.load(open(outs[1] / "3.json"))
+        assert info3["PADDLE_TRAINERS_NUM"] == "4"
+        assert info3["PADDLE_LOCAL_RANK"] == "1"
+
+
+class TestElastic:
+    def test_membership_and_ttl(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        m1 = ElasticManager(store, "node1", np_min=2, ttl=1.0, job_id="j")
+        m2 = ElasticManager(store, "node2", np_min=2, ttl=1.0, job_id="j")
+        m1.register(); m1._register_index()
+        m2.register(); m2._register_index()
+        assert m1.wait_for_np(timeout=10)
+        assert sorted(m1.alive_nodes()) == ["node1", "node2"]
+        assert m1.pod_status() == ElasticStatus.COMPLETED
+        # kill node2's lease: its heartbeats stop, TTL expires
+        m2.stop()
+        time.sleep(1.5)
+        assert m1.alive_nodes() == ["node1"]
+        assert m1.pod_status() in (ElasticStatus.RESTART, ElasticStatus.HOLD)
+        m1.stop()
+        store.close()
+
+
+class TestWatchdog:
+    def test_timeout_detection_and_handler(self):
+        mgr = CommTaskManager(scan_interval=0.05)
+        fired = []
+        mgr.add_handler(lambda t: fired.append(t.name))
+        t = mgr.start_task("allreduce/dp", timeout_s=0.1)
+        time.sleep(0.5)
+        assert "allreduce/dp" in fired
+        assert any(x.name == "allreduce/dp" for x in mgr.timed_out_tasks())
+        mgr.shutdown()
+
+    def test_finished_task_not_flagged(self):
+        mgr = CommTaskManager(scan_interval=0.05)
+        fired = []
+        mgr.add_handler(lambda t: fired.append(t.name))
+        with mgr.start_task("barrier/pp", timeout_s=0.2):
+            pass
+        time.sleep(0.4)
+        assert fired == []
+        mgr.shutdown()
+
+    def test_store_error_propagation(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        mgr = CommTaskManager(scan_interval=0.05)
+        mgr.attach_store(store, rank=3)
+        mgr.start_task("p2p/send", timeout_s=0.1)
+        time.sleep(0.5)
+        err = store.get("comm_error/3/p2p/send", wait=False)
+        assert err is not None and b"timeout" in err
+        mgr.shutdown()
+        store.close()
+
+
+class TestReviewRegressions:
+    def test_barrier_reusable_same_name(self):
+        st = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        st.barrier("x", 1)
+        st.barrier("x", 1)  # round 2 must not be satisfied by round 1's key
+        assert st._barrier_rounds["x"] == 2
+        st.close()
+
+    def test_set_flags_string_false(self):
+        import paddle_tpu as paddle
+        paddle.set_flags({"FLAGS_check_nan_inf": "false"})
+        assert paddle.get_flags("check_nan_inf")["FLAGS_check_nan_inf"] is False
+        paddle.set_flags({"FLAGS_check_nan_inf": "true"})
+        assert paddle.get_flags("check_nan_inf")["FLAGS_check_nan_inf"] is True
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_elastic_concurrent_registration_no_lost_update(self):
+        import threading
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        mgrs = [ElasticManager(store, f"n{i}", np_min=4, ttl=5.0, job_id="c")
+                for i in range(4)]
+
+        def reg(m):
+            m.register()
+            m._register_index()
+
+        ts = [threading.Thread(target=reg, args=(m,)) for m in mgrs]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert sorted(mgrs[0].alive_nodes()) == ["n0", "n1", "n2", "n3"]
+        [m.stop() for m in mgrs]
+        store.close()
+
+    def test_py_fallback_add_on_non_numeric(self):
+        from paddle_tpu.native.tcp_store import _PyStoreClient, _PyStoreServer
+        srv = _PyStoreServer(0)
+        cli = _PyStoreClient("127.0.0.1", srv.port, timeout_s=10)
+        cli.request(0, "k", 3, b"abc")
+        st, payload = cli.request(2, "k", 5)  # ADD over non-numeric: base 0
+        assert st == 8
+        import struct
+        assert struct.unpack("<q", payload)[0] == 5
+        cli.close(); srv.stop()

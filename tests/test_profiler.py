@@ -105,3 +105,37 @@ class TestBenchmarkTimer:
         rep = bm.report()
         assert rep["steps"] == 6
         assert bm.speed_average() >= 0
+
+
+class TestTimerOnly:
+    def test_timer_only_profiler_measures_ips(self):
+        import time as _time
+        p = Profiler(timer_only=True)
+        p.start()
+        for _ in range(5):
+            _time.sleep(0.01)
+            p.step(num_samples=16)
+        p.stop()
+        assert benchmark().report()["steps"] == 5
+        assert benchmark().speed_average() > 0
+
+
+class TestSchedulerValidation:
+    def test_zero_record_raises(self):
+        with pytest.raises(ValueError):
+            make_scheduler(closed=0, ready=0, record=0)
+
+    def test_bad_window_raises(self):
+        with pytest.raises(ValueError):
+            Profiler(scheduler=(3, 3))
+        with pytest.raises(ValueError):
+            Profiler(scheduler=(4, 2))
+
+    def test_ratio_uses_all_events(self):
+        from paddle_tpu.profiler.profiler import _HostEvent
+        from paddle_tpu.profiler.profiler_statistic import gen_summary
+        evs = [_HostEvent(f"op{i}", 0, 100, 0, TracerEventType.Operator)
+               for i in range(4)]
+        out = gen_summary(evs, row_limit=2)
+        # each op is 25% of the total even though only 2 rows display
+        assert "25.00" in out
