@@ -49,3 +49,5 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     return func(*args)
 from . import watchdog  # noqa: E402,F401
 from .watchdog import comm_watchdog  # noqa: E402,F401
+from . import spmd_rules  # noqa: E402,F401
+from .spmd_rules import get_spmd_rule, DistTensorSpec  # noqa: E402,F401

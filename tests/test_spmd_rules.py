@@ -1,0 +1,230 @@
+"""SPMD rules as device-free pure functions (model:
+test/auto_parallel/spmd_rules/test_matmul_rule.py:26-61 — build specs, call
+infer_forward, assert dims_mappings) + reshard plan selection."""
+
+import pytest
+
+from paddle_tpu.distributed.placements import Partial, Replicate, Shard
+from paddle_tpu.distributed.spmd_rules import (DistTensorSpec, get_spmd_rule,
+                                               has_spmd_rule, plan_reshard)
+
+
+def spec(shape, mapping, partial=()):
+    return DistTensorSpec(shape, mapping, set(partial))
+
+
+class TestMatmulRule:
+    def test_mk_times_kn_row_parallel(self):
+        # x[m,k] sharded on m (axis 0); y replicated → out sharded on m
+        info = get_spmd_rule("matmul").infer_forward(
+            spec((64, 32), [0, -1]), spec((32, 48), [-1, -1]))
+        assert info.output_specs[0].dims_mapping == [0, -1]
+        assert info.output_specs[0].partial_on == set()
+
+    def test_contraction_produces_partial(self):
+        # k sharded in both x and y on mesh axis 1 → out partial on 1
+        info = get_spmd_rule("matmul").infer_forward(
+            spec((64, 32), [-1, 1]), spec((32, 48), [1, -1]))
+        assert info.output_specs[0].dims_mapping == [-1, -1]
+        assert info.output_specs[0].partial_on == {1}
+        # required inputs keep the k-axis sharding
+        assert info.input_specs[0].dims_mapping == [-1, 1]
+        assert info.input_specs[1].dims_mapping == [1, -1]
+
+    def test_column_parallel(self):
+        info = get_spmd_rule("matmul").infer_forward(
+            spec((64, 32), [-1, -1]), spec((32, 48), [-1, 0]))
+        assert info.output_specs[0].dims_mapping == [-1, 0]
+
+    def test_transpose_y(self):
+        # y[n,k] with trans_y: n sharded on 0 → out[m,n] sharded on (.,0)
+        info = get_spmd_rule("matmul").infer_forward(
+            spec((64, 32), [-1, -1]), spec((48, 32), [0, -1]), trans_y=True)
+        assert info.output_specs[0].dims_mapping == [-1, 0]
+
+    def test_batched_matmul_merges_batch_dims(self):
+        info = get_spmd_rule("matmul").infer_forward(
+            spec((8, 64, 32), [0, -1, -1]), spec((8, 32, 48), [-1, -1, 1]))
+        out = info.output_specs[0]
+        assert out.shape == (8, 64, 48)
+        assert out.dims_mapping == [0, -1, 1]
+
+    def test_conflict_same_axis_two_dims_dedups(self):
+        # m and k both claim axis 0 → only the first keeps it
+        info = get_spmd_rule("matmul").infer_forward(
+            spec((64, 32), [0, 0], ), spec((32, 48), [-1, -1]))
+        out = info.output_specs[0]
+        assert out.dims_mapping[0] == 0
+        assert 0 not in out.dims_mapping[1:] or out.dims_mapping[1] == -1
+
+
+class TestElementwiseRule:
+    def test_broadcast(self):
+        info = get_spmd_rule("elementwise").infer_forward(
+            spec((8, 64, 128), [0, 1, -1]), spec((128,), [-1]))
+        out = info.output_specs[0]
+        assert out.shape == (8, 64, 128)
+        assert out.dims_mapping == [0, 1, -1]
+        # bias stays replicated
+        assert info.input_specs[1].dims_mapping == [-1]
+
+    def test_merge_prefers_sharded(self):
+        info = get_spmd_rule("elementwise").infer_forward(
+            spec((8, 64), [-1, 1]), spec((8, 64), [0, -1]))
+        assert info.output_specs[0].dims_mapping == [0, 1]
+
+
+class TestReductionRule:
+    def test_reduce_sharded_axis_partial(self):
+        info = get_spmd_rule("reduction").infer_forward(
+            spec((8, 64), [0, 1]), axis=1)
+        out = info.output_specs[0]
+        assert out.shape == (8,)
+        assert out.dims_mapping == [0]
+        assert out.partial_on == {1}
+
+    def test_keepdim(self):
+        info = get_spmd_rule("reduction").infer_forward(
+            spec((8, 64), [0, -1]), axis=1, keepdim=True)
+        assert info.output_specs[0].shape == (8, 1)
+        assert info.output_specs[0].dims_mapping == [0, -1]
+
+
+class TestEmbeddingRule:
+    def test_vocab_parallel_partial(self):
+        # table rows (vocab) sharded on mesh axis 1 → out partial on 1
+        info = get_spmd_rule("embedding").infer_forward(
+            spec((50000, 512), [1, -1]), spec((8, 128), [0, -1]))
+        out = info.output_specs[0]
+        assert out.shape == (8, 128, 512)
+        assert out.dims_mapping == [0, -1, -1]
+        assert out.partial_on == {1}
+
+
+class TestNormRules:
+    def test_layer_norm_clears_feature_sharding(self):
+        info = get_spmd_rule("layer_norm").infer_forward(
+            spec((8, 128, 512), [0, 2, 1]), spec((512,), [-1]),
+            spec((512,), [-1]), begin_norm_axis=2)
+        out, mean, var = info.output_specs
+        assert out.dims_mapping == [0, 2, -1]
+        assert mean.shape == (8, 128) and mean.dims_mapping == [0, 2]
+
+    def test_rms_norm(self):
+        info = get_spmd_rule("rms_norm").infer_forward(
+            spec((8, 128, 512), [0, -1, 1]), spec((512,), [-1]))
+        assert info.output_specs[0].dims_mapping == [0, -1, -1]
+
+
+class TestAttentionRules:
+    def test_flash_attention_head_parallel(self):
+        # [b, s, h, d]: heads sharded on axis 1 (TP)
+        q = spec((2, 1024, 16, 64), [0, -1, 1, -1])
+        info = get_spmd_rule("flash_attention").infer_forward(q, q.copy(),
+                                                              q.copy())
+        out = info.output_specs[0]
+        assert out.dims_mapping == [0, -1, 1, -1]
+
+    def test_flash_attention_sequence_parallel(self):
+        # q seq sharded (ring attention) while kv seq sharded too
+        q = spec((2, 8192, 16, 64), [-1, 2, 1, -1])
+        info = get_spmd_rule("flash_attention").infer_forward(q, q.copy(),
+                                                              q.copy())
+        assert info.output_specs[0].dims_mapping == [-1, 2, 1, -1]
+        assert info.input_specs[1].dims_mapping == [-1, 2, 1, -1]
+
+    def test_softmax_axis_unsharded(self):
+        info = get_spmd_rule("softmax").infer_forward(
+            spec((8, 128), [0, 1]), axis=-1)
+        assert info.input_specs[0].dims_mapping == [0, -1]
+
+
+class TestCrossEntropyRule:
+    def test_parallel_cross_entropy_partial_loss(self):
+        info = get_spmd_rule("cross_entropy_with_softmax").infer_forward(
+            spec((8, 50000), [0, 1]), spec((8,), [0]))
+        softmax, loss = info.output_specs
+        assert loss.partial_on == {1}
+        assert loss.dims_mapping == [0]
+
+
+class TestShapeRules:
+    def test_transpose(self):
+        info = get_spmd_rule("transpose").infer_forward(
+            spec((8, 16, 32), [0, -1, 1]), perm=[2, 0, 1])
+        assert info.output_specs[0].shape == (32, 8, 16)
+        assert info.output_specs[0].dims_mapping == [1, 0, -1]
+
+    def test_reshape_preserves_leading(self):
+        info = get_spmd_rule("reshape").infer_forward(
+            spec((8, 16, 32), [0, -1, -1]), shape=[8, 512])
+        assert info.output_specs[0].dims_mapping == [0, -1]
+
+    def test_reshape_minus_one(self):
+        info = get_spmd_rule("reshape").infer_forward(
+            spec((8, 16, 32), [0, -1, -1]), shape=[-1, 32])
+        assert info.output_specs[0].shape == (128, 32)
+
+    def test_concat_axis_whole(self):
+        info = get_spmd_rule("concat").infer_forward(
+            spec((8, 16), [0, 1]), spec((8, 16), [0, 1]), axis=1)
+        assert info.output_specs[0].shape == (8, 32)
+        assert info.output_specs[0].dims_mapping == [0, -1]
+
+    def test_split(self):
+        info = get_spmd_rule("split").infer_forward(
+            spec((8, 32), [0, 1]), num_or_sections=4, axis=1)
+        assert len(info.output_specs) == 4
+        assert all(o.shape == (8, 8) for o in info.output_specs)
+        assert all(o.dims_mapping == [0, -1] for o in info.output_specs)
+
+
+class TestFallbackAndRegistry:
+    def test_unknown_op_falls_back_replicated(self):
+        assert not has_spmd_rule("no_such_op")
+        info = get_spmd_rule("no_such_op").infer_forward(
+            spec((4, 4), [0, 1]))
+        assert info.input_specs[0].dims_mapping == [-1, -1]
+
+    def test_known_rules_registered(self):
+        for name in ("matmul", "elementwise", "reduction", "embedding",
+                     "layer_norm", "rms_norm", "softmax", "flash_attention",
+                     "cross_entropy_with_softmax", "transpose", "reshape",
+                     "concat", "split", "fused_rope"):
+            assert has_spmd_rule(name), name
+
+
+class TestReshardPlan:
+    def test_pairwise_plans(self):
+        assert plan_reshard([Shard(0)], [Replicate()]) == \
+            ["all_gather(axis=0, dim=0)"]
+        assert plan_reshard([Replicate()], [Shard(1)]) == \
+            ["slice(axis=0, dim=1)"]
+        assert plan_reshard([Partial()], [Replicate()]) == \
+            ["all_reduce(axis=0)"]
+        assert plan_reshard([Partial()], [Shard(0)]) == \
+            ["reduce_scatter(axis=0, dim=0)"]
+        assert plan_reshard([Shard(0)], [Shard(1)]) == \
+            ["all_to_all(axis=0, from_dim=0, to_dim=1)"]
+
+    def test_multi_axis_plan(self):
+        src = [Shard(0), Partial()]
+        dst = [Replicate(), Replicate()]
+        assert plan_reshard(src, dst) == \
+            ["all_gather(axis=0, dim=0)", "all_reduce(axis=1)"]
+
+    def test_noop(self):
+        assert plan_reshard([Shard(0), Replicate()],
+                            [Shard(0), Replicate()]) == []
+
+
+class TestReviewRegressions:
+    def test_ce_hard_label_trailing_one_unsharded(self):
+        info = get_spmd_rule("cross_entropy_with_softmax").infer_forward(
+            spec((8, 128, 50000), [0, -1, 1]), spec((8, 128, 1), [0, -1, -1]))
+        assert info.input_specs[1].dims_mapping == [0, -1, -1]
+
+    def test_matmul_batch_broadcast_shape(self):
+        info = get_spmd_rule("matmul").infer_forward(
+            spec((1, 64, 32), [-1, -1, -1]), spec((5, 32, 48), [0, -1, -1]))
+        assert info.output_specs[0].shape == (5, 64, 48)
