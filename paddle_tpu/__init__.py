@@ -78,5 +78,9 @@ from .hapi import Model, summary  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401
 from .nn.layer_base import Parameter  # noqa: E402,F401
 from . import ops  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from .static import enable_static, disable_static  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
 
 __version__ = "0.1.0"

@@ -237,13 +237,27 @@ def set_amp_hook(fn):
 # factory here (paddle_tpu/profiler); None keeps the hot path branch-cheap.
 _OP_SPAN_HOOK = None
 
+# Static-graph integration: paddle_tpu.static.graph installs its
+# in_static_mode() here on import; ops on symbolic Variables then record
+# into the current Program instead of executing.
+_STATIC_MODE_FN = None
+
 
 def set_op_span_hook(hook):
     global _OP_SPAN_HOOK
     _OP_SPAN_HOOK = hook
 
 
+def set_static_hook(fn):
+    global _STATIC_MODE_FN
+    _STATIC_MODE_FN = fn
+
+
 def _dispatch(schema: OpSchema, arguments: Dict[str, Any]):
+    if _STATIC_MODE_FN is not None and _STATIC_MODE_FN():
+        from ..static.graph import involves_symbolic, record
+        if involves_symbolic(arguments):
+            return record(schema, arguments)
     hook = _OP_SPAN_HOOK
     if hook is not None:
         with hook(schema.name):

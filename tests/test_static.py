@@ -1,0 +1,169 @@
+"""Static graph mode: program recording, Executor, gradients, save/load.
+
+Reference model: test/legacy_test static-mode OpTest variants + Executor
+tests (python/paddle/base/executor.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    yield
+    static.disable_static()
+
+
+class TestProgramRecording:
+    def test_ops_record_not_execute(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8])
+            y = paddle.matmul(x, paddle.transpose(x, perm=[1, 0]))
+            z = paddle.add(y, y)
+        assert isinstance(y, static.Variable)
+        assert y.shape == (4, 4)
+        assert z.shape == (4, 4)
+        assert len(prog.global_block.ops) == 3
+        assert [op.type for op in prog.global_block.ops] == \
+            ["transpose", "matmul", "add"]
+
+    def test_shape_inference_matches_eval_shape(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3, 5])
+            s = paddle.sum(x, axis=1)
+            r = paddle.reshape(x, shape=[6, 5])
+        assert s.shape == (2, 5)
+        assert r.shape == (6, 5)
+
+    def test_variable_sugar(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4])
+            y = (x + 1.0) * 2.0 - x
+        assert isinstance(y, static.Variable)
+
+    def test_eager_unaffected_outside_guard(self):
+        t = paddle.to_tensor(np.ones((2, 2), np.float32))
+        out = paddle.add(t, t)
+        assert not isinstance(out, static.Variable)
+        assert float(out.numpy().sum()) == 8.0
+
+
+class TestExecutor:
+    def test_run_feed_fetch(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8])
+            y = static.data("y", [8, 2])
+            out = paddle.matmul(x, y)
+        exe = static.Executor()
+        xv = np.random.rand(4, 8).astype(np.float32)
+        yv = np.random.rand(8, 2).astype(np.float32)
+        (got,) = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[out])
+        np.testing.assert_allclose(got, xv @ yv, rtol=1e-5)
+
+    def test_executable_cache_reused(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4])
+            out = x * 3.0
+        exe = static.Executor()
+        exe.run(prog, feed={"x": np.ones(4, np.float32)}, fetch_list=[out])
+        n = len(exe._cache)
+        exe.run(prog, feed={"x": np.zeros(4, np.float32)}, fetch_list=[out])
+        assert len(exe._cache) == n  # same shapes -> same executable
+
+    def test_parameters_persist_in_scope(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            w = static.create_parameter([4, 3], name="w")
+            out = paddle.matmul(x, w)
+        exe = static.Executor()
+        (a,) = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[out])
+        (b,) = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[out])
+        np.testing.assert_array_equal(a, b)
+        assert exe.scope.var("w") is not None
+
+
+class TestGradients:
+    def test_static_gradients(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3])
+            w = static.create_parameter([3], name="w1")
+            loss = paddle.sum(x * w * w)
+            (gw,) = static.gradients([loss], [w])
+        exe = static.Executor()
+        xv = np.array([1.0, 2.0, 3.0], np.float32)
+        exe.scope.set_var("w1", np.array([2.0, 2.0, 2.0], np.float32))
+        (g,) = exe.run(prog, feed={"x": xv}, fetch_list=[gw])
+        np.testing.assert_allclose(g, 2 * 2.0 * xv, rtol=1e-5)  # d/dw x*w^2
+
+    def test_append_backward(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3])
+            w = static.create_parameter([3, 1], name="w2")
+            loss = paddle.mean(paddle.matmul(x, w))
+            pairs = static.append_backward(loss)
+        assert len(pairs) == 1
+        exe = static.Executor()
+        exe.scope.set_var("w2", np.zeros((3, 1), np.float32))
+        xv = np.random.rand(2, 3).astype(np.float32)
+        (g,) = exe.run(prog, feed={"x": xv}, fetch_list=[pairs[0][1]])
+        np.testing.assert_allclose(g[:, 0], xv.mean(axis=0) / 1.0, rtol=1e-5)
+
+
+class TestInferenceModel:
+    def test_save_load_roundtrip(self, tmp_path):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            w = static.create_parameter([4, 2], name="w3")
+            out = paddle.matmul(x, w)
+        exe = static.Executor()
+        xv = np.random.rand(2, 4).astype(np.float32)
+        (want,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+        static.save_inference_model(str(tmp_path / "model"), [x], [out], exe,
+                                    program=prog)
+
+        exe2 = static.Executor()
+        prog2, feeds, fetches = static.load_inference_model(
+            str(tmp_path / "model"), exe2)
+        (got,) = exe2.run(prog2, feed={feeds[0]: xv}, fetch_list=fetches)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestRandomOps:
+    def test_random_op_records_and_runs(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [128, 64])
+            y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+        exe = static.Executor()
+        (got,) = exe.run(prog, feed={"x": np.ones((128, 64), np.float32)},
+                         fetch_list=[y])
+        frac = (got == 0).mean()
+        assert 0.3 < frac < 0.7
+
+
+class TestBackwardPickle:
+    def test_program_with_grad_ops_pickles(self, tmp_path):
+        import pickle
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3])
+            w = static.create_parameter([3], name="wp")
+            loss = paddle.sum(x * w)
+            static.append_backward(loss)
+        blob = pickle.dumps(prog)
+        prog2 = pickle.loads(blob)
+        assert any(op.type == "grad" for op in prog2.global_block.ops)
