@@ -122,7 +122,6 @@ class CollectiveController:
                     self.pod.stop()
                 return status
             if self.master is not None:
-                self.master.heartbeat()
                 if self.master.current_generation() != self._generation:
                     return "gen_changed"
             time.sleep(0.5)

@@ -40,6 +40,8 @@ class Master:
         return peers
 
     def heartbeat(self, ttl_info: Optional[str] = None):
+        """Publish a liveness timestamp. Not called on the controller's hot
+        poll loop — monitors (ElasticManager-style) own the cadence."""
         self.store.set(f"{self.prefix}/beat/{self.node_rank}",
                        ttl_info or str(time.time()))
 

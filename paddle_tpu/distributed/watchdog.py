@@ -42,7 +42,9 @@ class CommTask:
         return self
 
     def __exit__(self, *exc):
-        comm_watchdog().finish_task(self)
+        # finish on the manager that created this task (set by start_task),
+        # not the global singleton
+        self._mgr.finish_task(self)
         return False
 
 
@@ -67,6 +69,7 @@ class CommTaskManager:
     def start_task(self, name: str, timeout_s: float = 600.0,
                    rank: int = 0) -> CommTask:
         t = CommTask(name, timeout_s, rank)
+        t._mgr = self
         with self._lock:
             self._tasks[t.task_id] = t
             self._ensure_thread()

@@ -185,3 +185,10 @@ class TestReviewRegressions:
         import struct
         assert struct.unpack("<q", payload)[0] == 5
         cli.close(); srv.stop()
+
+    def test_watchdog_task_finishes_on_owning_manager(self):
+        mgr = CommTaskManager(scan_interval=0.05)
+        with mgr.start_task("x", timeout_s=5.0) as t:
+            assert t.task_id in mgr._tasks
+        assert t.task_id not in mgr._tasks
+        mgr.shutdown()

@@ -1,0 +1,153 @@
+"""Sparse COO/CSR tensors + composite ops vs dense numpy goldens."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def rand_coo(m=8, n=6, nnz=10, seed=0):
+    rng = np.random.RandomState(seed)
+    lin = rng.choice(m * n, size=nnz, replace=False)
+    rows, cols = lin // n, lin % n
+    vals = rng.randn(nnz).astype(np.float32)
+    dense = np.zeros((m, n), np.float32)
+    dense[rows, cols] = vals
+    return np.stack([rows, cols]), vals, dense
+
+
+class TestCooBasics:
+    def test_construct_and_to_dense(self):
+        idx, vals, dense = rand_coo()
+        st = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        assert st.nnz() == 10
+        np.testing.assert_allclose(st.to_dense().numpy(), dense)
+
+    def test_infer_shape(self):
+        st = sparse.sparse_coo_tensor([[0, 2], [1, 3]], [1.0, 2.0])
+        assert st.shape == (3, 4)
+
+    def test_coalesce_merges_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 2]])
+        st = sparse.sparse_coo_tensor(idx, [1.0, 2.0, 5.0], (2, 4))
+        c = st.coalesce()
+        assert c.nnz() == 2
+        d = c.to_dense().numpy()
+        assert d[0, 1] == 3.0 and d[1, 2] == 5.0
+
+    def test_coo_csr_roundtrip(self):
+        idx, vals, dense = rand_coo(seed=3)
+        coo = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        csr = coo.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+
+class TestCsrBasics:
+    def test_construct_and_to_dense(self):
+        # [[0,2,0],[1,0,3]]
+        csr = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [2.0, 1.0, 3.0],
+                                       (2, 3))
+        want = np.array([[0, 2, 0], [1, 0, 3]], np.float32)
+        np.testing.assert_allclose(csr.to_dense().numpy(), want)
+        assert csr.nnz() == 3
+
+
+class TestSparseOps:
+    def test_spmm_coo(self):
+        idx, vals, dense = rand_coo(seed=1)
+        st = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        y = np.random.RandomState(1).randn(6, 4).astype(np.float32)
+        out = sparse.matmul(st, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_spmm_csr(self):
+        idx, vals, dense = rand_coo(seed=2)
+        csr = sparse.sparse_coo_tensor(idx, vals, dense.shape).to_sparse_csr()
+        y = np.random.RandomState(2).randn(6, 3).astype(np.float32)
+        out = sparse.matmul(csr, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_masked_matmul_sddmm(self):
+        idx, _, dense = rand_coo(seed=4)
+        mask = sparse.sparse_coo_tensor(idx, np.ones(10, np.float32),
+                                        dense.shape)
+        x = np.random.RandomState(4).randn(8, 5).astype(np.float32)
+        y = np.random.RandomState(5).randn(5, 6).astype(np.float32)
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        full = x @ y
+        want = np.zeros_like(dense)
+        want[idx[0], idx[1]] = full[idx[0], idx[1]]
+        np.testing.assert_allclose(out.to_dense().numpy(), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_add_subtract(self):
+        ia, va, da = rand_coo(seed=6)
+        ib, vb, db = rand_coo(seed=7)
+        a = sparse.sparse_coo_tensor(ia, va, da.shape)
+        b = sparse.sparse_coo_tensor(ib, vb, db.shape)
+        np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(),
+                                   da + db, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(sparse.subtract(a, b).to_dense().numpy(),
+                                   da - db, rtol=1e-5, atol=1e-6)
+
+    def test_multiply_intersection(self):
+        ia, va, da = rand_coo(seed=8)
+        ib, vb, db = rand_coo(seed=9)
+        a = sparse.sparse_coo_tensor(ia, va, da.shape)
+        b = sparse.sparse_coo_tensor(ib, vb, db.shape)
+        np.testing.assert_allclose(sparse.multiply(a, b).to_dense().numpy(),
+                                   da * db, rtol=1e-5, atol=1e-6)
+
+    def test_transpose_and_sum(self):
+        idx, vals, dense = rand_coo(seed=10)
+        st = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        np.testing.assert_allclose(
+            sparse.transpose(st, [1, 0]).to_dense().numpy(), dense.T)
+        np.testing.assert_allclose(sparse.sum(st).numpy(), dense.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(sparse.sum(st, axis=1).numpy(),
+                                   dense.sum(axis=1), rtol=1e-5)
+
+    def test_sparse_relu(self):
+        idx, vals, dense = rand_coo(seed=11)
+        st = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+        out = sparse.nn.relu(st)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   np.maximum(dense, 0))
+
+
+class TestJitCompat:
+    def test_add_and_spmm_jit(self):
+        import jax
+        import jax.numpy as jnp
+        ia, va, da = rand_coo(seed=20)
+        ib, vb, db = rand_coo(seed=21)
+
+        @jax.jit
+        def fused(va_, vb_, y):
+            a = sparse.sparse_coo_tensor(ia, va_, da.shape)
+            b = sparse.sparse_coo_tensor(ib, vb_, db.shape)
+            return sparse.matmul(sparse.add(a, b), y)._data
+
+        y = np.random.RandomState(0).randn(6, 3).astype(np.float32)
+        out = fused(jnp.asarray(va), jnp.asarray(vb), jnp.asarray(y))
+        np.testing.assert_allclose(np.asarray(out), (da + db) @ y,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_coalesce_under_jit_raises(self):
+        import jax
+        import jax.numpy as jnp
+        ia, va, da = rand_coo(seed=22)
+
+        @jax.jit
+        def bad(v):
+            return sparse.sparse_coo_tensor(ia, v, da.shape).coalesce()
+
+        with pytest.raises(RuntimeError, match="coalesce"):
+            bad(jnp.asarray(va))
