@@ -11,3 +11,4 @@ from . import math  # noqa: F401
 from . import manipulation  # noqa: F401
 from . import nn  # noqa: F401
 from . import random  # noqa: F401
+from . import linalg_fft  # noqa: F401

@@ -1,0 +1,188 @@
+"""linalg / fft / signal namespaces vs numpy goldens (CPU-exact f32)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, linalg, signal
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestLinalg:
+    def test_svd_reconstruction(self):
+        x = np.random.RandomState(0).rand(6, 4).astype(np.float32)
+        u, s, vh = linalg.svd(t(x))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(rec, x, atol=1e-5)
+
+    def test_qr(self):
+        x = np.random.RandomState(1).rand(5, 3).astype(np.float32)
+        q, r = linalg.qr(t(x))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), x, atol=1e-5)
+        np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(3),
+                                   atol=1e-5)
+
+    def test_eigh(self):
+        a = np.random.RandomState(2).rand(4, 4).astype(np.float32)
+        sym = (a + a.T) / 2
+        w, v = linalg.eigh(t(sym))
+        rec = v.numpy() @ np.diag(w.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, sym, atol=1e-5)
+
+    def test_det_slogdet_solve(self):
+        a = np.random.RandomState(3).rand(4, 4).astype(np.float32) + \
+            np.eye(4, dtype=np.float32) * 4
+        b = np.random.RandomState(4).rand(4, 2).astype(np.float32)
+        np.testing.assert_allclose(linalg.det(t(a)).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+        sign, logdet = linalg.slogdet(t(a))
+        np.testing.assert_allclose(float(sign) * np.exp(float(logdet)),
+                                   np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(linalg.solve(t(a), t(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_pinv_matrix_rank_power(self):
+        x = np.random.RandomState(5).rand(5, 3).astype(np.float32)
+        np.testing.assert_allclose(linalg.pinv(t(x)).numpy(),
+                                   np.linalg.pinv(x), atol=1e-4)
+        low = x[:, :2] @ np.ones((2, 3), np.float32)  # rank <= 2
+        assert int(linalg.matrix_rank(t(low)).numpy()) <= 2
+        a = np.random.RandomState(6).rand(3, 3).astype(np.float32)
+        np.testing.assert_allclose(linalg.matrix_power(t(a), 3).numpy(),
+                                   np.linalg.matrix_power(a, 3), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_multi_dot_and_grad(self):
+        a = np.random.RandomState(7).rand(3, 4).astype(np.float32)
+        b = np.random.RandomState(8).rand(4, 5).astype(np.float32)
+        c = np.random.RandomState(9).rand(5, 2).astype(np.float32)
+        out = linalg.multi_dot([t(a), t(b), t(c)])
+        np.testing.assert_allclose(out.numpy(), a @ b @ c, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_svd_differentiable(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(10).rand(4, 4).astype(np.float32),
+            stop_gradient=False)
+        u, s, vh = linalg.svd(x)
+        loss = paddle.sum(s)
+        loss.backward()
+        assert x.grad is not None
+        # d(sum singvals)/dx = u @ vh for distinct singular values
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   u.numpy() @ vh.numpy(), atol=1e-4)
+
+    def test_lstsq_and_cond(self):
+        a = np.random.RandomState(11).rand(6, 3).astype(np.float32)
+        b = np.random.RandomState(12).rand(6, 1).astype(np.float32)
+        sol = linalg.lstsq(t(a), t(b))[0]
+        want = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(sol.numpy(), want, atol=1e-4)
+        c = float(linalg.cond(t(np.eye(3, dtype=np.float32))).numpy())
+        assert abs(c - 1.0) < 1e-5
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.RandomState(0).rand(64).astype(np.float32)
+        X = fft.fft(t(x))
+        back = fft.ifft(X)
+        np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.RandomState(1).rand(128).astype(np.float32)
+        np.testing.assert_allclose(fft.rfft(t(x)).numpy(),
+                                   np.fft.rfft(x).astype(np.complex64),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(2).rand(8, 8).astype(np.float32)
+        np.testing.assert_allclose(fft.fft2(t(x)).numpy(),
+                                   np.fft.fft2(x).astype(np.complex64),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(fft.fftshift(t(x)).numpy(),
+                                   np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5).astype(np.float32))
+
+    def test_norm_modes(self):
+        x = np.random.RandomState(3).rand(32).astype(np.float32)
+        np.testing.assert_allclose(
+            fft.fft(t(x), norm="ortho").numpy(),
+            np.fft.fft(x, norm="ortho").astype(np.complex64),
+            rtol=1e-4, atol=1e-4)
+
+
+class TestSignal:
+    def test_frame(self):
+        x = np.arange(16, dtype=np.float32)
+        framed = signal.frame(t(x), frame_length=4, hop_length=2)
+        assert tuple(framed.shape) == (4, 7)  # [frame_length, num_frames]
+        np.testing.assert_allclose(framed.numpy()[:, 1], x[2:6])
+
+    def test_stft_istft_roundtrip(self):
+        x = np.sin(np.linspace(0, 100, 2048)).astype(np.float32)
+        win = t(np.hanning(256).astype(np.float32))
+        S = signal.stft(t(x), 256, window=win)
+        assert S.shape[0] == 129  # onesided bins
+        back = signal.istft(S, 256, window=win, length=2048)
+        np.testing.assert_allclose(back.numpy()[128:-128], x[128:-128],
+                                   atol=1e-4)
+
+    def test_stft_magnitude_peak(self):
+        # pure tone → energy concentrated at its bin
+        n, f = 1024, 64
+        x = np.cos(2 * np.pi * f * np.arange(n) / n).astype(np.float32)
+        win = t(np.ones(256, np.float32))
+        S = signal.stft(t(x), 256, hop_length=64, window=win, center=False)
+        mag = np.abs(S.numpy())
+        assert mag.mean(axis=1).argmax() == f * 256 // n
+
+
+class TestReviewRegressions:
+    def test_cov_basic_and_weights(self):
+        x = np.random.RandomState(0).rand(3, 20).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.cov(t(x)).numpy(),
+                                   np.cov(x).astype(np.float32), rtol=1e-4)
+        f = np.random.RandomState(1).randint(1, 4, size=20)
+        got = paddle.linalg.cov(t(x), fweights=paddle.to_tensor(f))
+        np.testing.assert_allclose(got.numpy(),
+                                   np.cov(x, fweights=f).astype(np.float32),
+                                   rtol=1e-4)
+
+    def test_eig_runs_on_any_backend(self):
+        a = np.diag([1.0, 2.0, 3.0]).astype(np.float32)
+        w, v = paddle.linalg.eig(t(a))
+        np.testing.assert_allclose(np.sort(w.numpy().real), [1, 2, 3],
+                                   atol=1e-5)
+
+    def test_frame_axis0_layout(self):
+        x = np.arange(16, dtype=np.float32)
+        f = signal.frame(t(x), frame_length=4, hop_length=2, axis=0)
+        assert tuple(f.shape) == (7, 4)
+        np.testing.assert_allclose(f.numpy()[1], x[2:6])
+        # N-D time-major input
+        x2 = np.arange(32, dtype=np.float32).reshape(16, 2)
+        f2 = signal.frame(t(x2), frame_length=4, hop_length=2, axis=0)
+        assert tuple(f2.shape) == (7, 4, 2)
+        np.testing.assert_allclose(f2.numpy()[0, :, 0], x2[:4, 0])
+
+    def test_stft_reference_signature(self):
+        x = np.sin(np.linspace(0, 50, 1024)).astype(np.float32)
+        S = signal.stft(t(x), 256)  # paddle-style positional n_fft
+        assert S.shape[0] == 129
+        back = signal.istft(S, 256, length=1024)
+        np.testing.assert_allclose(back.numpy()[128:-128], x[128:-128],
+                                   atol=1e-4)
+
+    def test_stft_win_length_padding(self):
+        x = np.random.RandomState(2).rand(512).astype(np.float32)
+        win = t(np.hanning(128).astype(np.float32))
+        S = signal.stft(t(x), 256, win_length=128, window=win)
+        assert S.shape[0] == 129
