@@ -1,0 +1,154 @@
+"""Incubate (fused layers, ASP, LookAhead, autotune) + inference predictor."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu import inference
+from paddle_tpu.incubate import LookAhead, ModelAverage, asp, autotune
+from paddle_tpu.incubate.nn import (FusedFeedForward, FusedMultiHeadAttention,
+                                    FusedMultiTransformer,
+                                    FusedTransformerEncoderLayer,
+                                    memory_efficient_attention)
+
+
+class TestFusedLayers:
+    def test_encoder_layer_shapes_and_grads(self):
+        layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.rand(2, 8, 32).astype(np.float32),
+                             stop_gradient=False)
+        out = layer(x)
+        assert tuple(out.shape) == (2, 8, 32)
+        loss = paddle.mean(out * out)
+        loss.backward()
+        assert layer.fused_attn.qkv_weight.grad is not None
+        assert layer.ffn.linear1_weight.grad is not None
+
+    def test_pre_ln_variant(self):
+        layer = FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0,
+                                        normalize_before=True)
+        x = paddle.to_tensor(np.random.rand(1, 4, 16).astype(np.float32))
+        assert tuple(layer(x).shape) == (1, 4, 16)
+
+    def test_multi_transformer_stacks(self):
+        mt = FusedMultiTransformer(16, 2, 32, num_layers=3)
+        x = paddle.to_tensor(np.random.rand(1, 6, 16).astype(np.float32))
+        assert tuple(mt(x).shape) == (1, 6, 16)
+        # per block: attn(qkv w/b, out w/b, pre_ln w/b, ln w/b) + ffn(l1 w/b,
+        # l2 w/b, ln w/b) = 14
+        assert len(mt.parameters()) == 3 * 14
+        # mask path: padded tokens masked out changes logits
+        mask = np.zeros((1, 1, 6, 6), np.float32)
+        mask[..., 4:] = -1e9
+        masked = mt(x, attn_mask=paddle.to_tensor(mask))
+        assert not np.allclose(masked.numpy(), mt(x).numpy())
+
+    def test_memory_efficient_attention_matches_sdpa(self):
+        q = paddle.to_tensor(np.random.rand(1, 8, 2, 4).astype(np.float32))
+        out = memory_efficient_attention(q, q, q, training=False)
+        want = paddle.scaled_dot_product_attention(q, q, q)
+        np.testing.assert_allclose(out.numpy(), want.numpy(), atol=2e-2)
+
+
+class TestASP:
+    def test_prune_and_stay_sparse_through_training(self):
+        lin = paddle.nn.Linear(16, 8)
+        report = asp.prune_model(lin)
+        assert report["weight"] == pytest.approx(0.5)
+        assert asp.check_sparsity(lin.weight.numpy())
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=lin.parameters()))
+        for _ in range(3):
+            loss = paddle.mean(
+                lin(paddle.to_tensor(np.ones((4, 16), np.float32))) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert asp.check_sparsity(lin.weight.numpy())
+
+    def test_nm_mask_pattern(self):
+        w = np.arange(8, dtype=np.float32).reshape(2, 4)
+        mask = asp.compute_nm_mask(w)
+        assert mask.sum(axis=1).tolist() == [2, 2]
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_converges(self):
+        lin = paddle.nn.Linear(4, 2)
+        la = LookAhead(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=lin.parameters()), k=2)
+        losses = []
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(6):
+            loss = paddle.mean(lin(x) ** 2)
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_model_average_apply_restore(self):
+        lin = paddle.nn.Linear(3, 2)
+        ma = ModelAverage(parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        for _ in range(4):
+            ma.step()
+        ma.apply()
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-6)
+        ma.restore()
+        np.testing.assert_allclose(lin.weight.numpy(), w0)
+
+    def test_autotune_config(self):
+        autotune.set_config({"kernel": {"enable": False}})
+        assert not paddle.get_flags(
+            "use_pallas_kernels")["FLAGS_use_pallas_kernels"]
+        autotune.set_config({"kernel": {"enable": True}})
+        with pytest.raises(ValueError):
+            autotune.set_config({"bogus": {}})
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4])
+        w = static.create_parameter([4, 3], name="pw")
+        out = paddle.nn.functional.relu(paddle.matmul(x, w))
+    exe = static.Executor()
+    xv = np.random.rand(2, 4).astype(np.float32)
+    (want,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, [x], [out], exe, program=prog)
+    static.disable_static()
+    return prefix, xv, want
+
+
+class TestPredictor:
+    def test_zero_copy_run(self, saved_model):
+        prefix, xv, want = saved_model
+        config = inference.Config(prefix)
+        pred = inference.create_predictor(config)
+        assert pred.get_input_names() == ["x"]
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(xv)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_direct_run_and_cache(self, saved_model):
+        prefix, xv, want = saved_model
+        pred = inference.create_predictor(inference.Config(prefix))
+        (o1,) = pred.run([xv])
+        (o2,) = pred.run([xv * 2])
+        np.testing.assert_allclose(o1, want, rtol=1e-5)
+        assert len(pred._compiled) == 1  # same signature -> one executable
+
+    def test_aot_export_roundtrip(self, saved_model, tmp_path):
+        prefix, xv, want = saved_model
+        pred = inference.create_predictor(inference.Config(prefix))
+        path = pred.export_compiled(str(tmp_path / "model.aot"), [xv])
+        runner = inference.Predictor.load_compiled(path)
+        (got,) = runner([xv])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
