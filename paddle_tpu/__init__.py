@@ -35,7 +35,14 @@ _ops = _dispatcher.build_ops(_OPS_YAML)
 _RENAMES = {"shape_op": "shape", "neg": "neg", "getitem": None, "einsum_impl": None,
             "cross_entropy_mean": None, "batch_norm_infer": None,
             "batch_norm_train": None, "interpolate_nearest": None,
-            "interpolate_bilinear": None}
+            "interpolate_bilinear": None,
+            # namespaced-only ops (paddle.fft / paddle.signal modules —
+            # top-level names would shadow the submodules)
+            "fft": None, "ifft": None, "rfft": None, "irfft": None,
+            "hfft": None, "ihfft": None, "fft2": None, "ifft2": None,
+            "rfft2": None, "irfft2": None, "fftn": None, "ifftn": None,
+            "fftshift": None, "ifftshift": None, "fftfreq": None,
+            "rfftfreq": None, "frame": None, "stft": None, "istft": None}
 
 for _name, _fn in _ops.items():
     _public = _RENAMES.get(_name, _name)
@@ -82,5 +89,10 @@ from . import profiler  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from .static import enable_static, disable_static  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
 
 __version__ = "0.1.0"
