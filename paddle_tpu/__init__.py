@@ -19,8 +19,9 @@ from .core.dtype import (  # noqa: F401
 )
 from .core.device import (  # noqa: F401
     CPUPlace, TPUPlace, Place, set_device, get_device, device_count,
-    is_compiled_with_tpu,
+    is_compiled_with_tpu, synchronize,
 )
+from .core import device  # noqa: F401
 from .core.generator import seed, default_generator  # noqa: F401
 from .core.tensor import Tensor, to_tensor  # noqa: F401
 from .autograd.engine import no_grad, enable_grad, grad, is_grad_enabled  # noqa: F401
@@ -94,5 +95,9 @@ from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
 
 __version__ = "0.1.0"

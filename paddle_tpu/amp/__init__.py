@@ -185,3 +185,5 @@ class GradScaler:
         self._scale = sd["scale"]
         self._good_steps = sd["good"]
         self._bad_steps = sd["bad"]
+
+from . import debugging  # noqa: E402,F401

@@ -92,3 +92,95 @@ def device_count() -> int:
 
 def is_compiled_with_tpu() -> bool:
     return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+# -- memory stats & synchronization (reference paddle.device.cuda.* —
+# memory_allocated/max_memory_allocated, synchronize; stats from the PJRT
+# device where available, else the native stat registry csrc/stats.cc) ------
+
+def synchronize(device=None) -> None:
+    """Block until all queued device work finishes (XLA orders execution, so
+    this is a fence: round-trip a tiny computation)."""
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+def _device_memory_stats(device=None) -> dict:
+    dev = (device.device if isinstance(device, Place) else
+           get_device().device)
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return stats or {}
+
+
+def _live_bytes() -> int:
+    """Fallback when PJRT exposes no memory_stats: sum live jax buffers and
+    record into the native stat registry (keeps a running peak)."""
+    import jax as _jax
+    from ..native import stats as nstats
+    cur = sum(int(getattr(a, "nbytes", 0)) for a in _jax.live_arrays())
+    nstats.update("Allocated:device", cur - nstats.current("Allocated:device"))
+    return cur
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently held by live buffers on the device."""
+    stats = _device_memory_stats(device)
+    if "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    return _live_bytes()
+
+
+def max_memory_allocated(device=None) -> int:
+    stats = _device_memory_stats(device)
+    if "peak_bytes_in_use" in stats:
+        return int(stats["peak_bytes_in_use"])
+    _live_bytes()  # refresh the running peak
+    from ..native import stats as nstats
+    return nstats.peak("Allocated:device")
+
+
+def memory_reserved(device=None) -> int:
+    # PJRT exposes bytes_reserved on some platforms; bytes_limit is CAPACITY,
+    # not reservation — falling back to allocated is the honest number
+    stats = _device_memory_stats(device)
+    if "bytes_reserved" in stats:
+        return int(stats["bytes_reserved"])
+    return memory_allocated(device)
+
+
+def max_memory_reserved(device=None) -> int:
+    return max(memory_reserved(device), max_memory_allocated(device))
+
+
+def empty_cache() -> None:
+    """Reference paddle.device.cuda.empty_cache; XLA owns the buffer pool —
+    no-op kept for API parity."""
+
+
+class Stream:
+    """No-op stream (reference paddle.device.Stream): XLA schedules; kept so
+    stream-annotated code ports cleanly."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+
+class Event:
+    """No-op event (reference paddle.device.Event)."""
+
+    def __init__(self, enable_timing=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end: "Event") -> float:
+        return (end._t - self._t) * 1e3 if self._t and end._t else 0.0

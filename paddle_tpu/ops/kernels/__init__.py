@@ -12,3 +12,4 @@ from . import manipulation  # noqa: F401
 from . import nn  # noqa: F401
 from . import random  # noqa: F401
 from . import linalg_fft  # noqa: F401
+from . import quant  # noqa: F401

@@ -1,0 +1,299 @@
+"""Audio, text (viterbi), quantization, auto-tuner, amp debugging, dlpack,
+custom ops, device stats."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+from paddle_tpu.audio import functional as AF
+
+
+class TestAudio:
+    def test_hz_mel_roundtrip(self):
+        for f in (60.0, 440.0, 4000.0):
+            assert abs(AF.mel_to_hz(AF.hz_to_mel(f)) - f) < 1e-2
+        assert abs(AF.hz_to_mel(1000.0) - 15.0) < 0.1  # Slaney knee
+
+    def test_fbank_matrix_shape_and_norm(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert tuple(fb.shape) == (40, 257)
+        assert float(fb.numpy().min()) >= 0.0
+
+    def test_windows(self):
+        for name in ("hann", "hamming", "blackman", "rect", "bartlett"):
+            w = AF.get_window(name, 64)
+            assert tuple(w.shape) == (64,)
+        w = AF.get_window(("kaiser", 8.0), 32)
+        assert tuple(w.shape) == (32,)
+        with pytest.raises(ValueError):
+            AF.get_window("nope", 8)
+
+    def test_feature_layers(self):
+        x = paddle.to_tensor(
+            np.sin(np.linspace(0, 400, 4000)).astype(np.float32))
+        spec = Spectrogram(n_fft=256)(x)
+        assert spec.shape[0] == 129
+        mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[0] == 32
+        logmel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert float(logmel.numpy().max()) <= 80.0 + float(
+            logmel.numpy().min()) + 160  # db-ranged
+        mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+        assert mfcc.shape[0] == 13
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        from paddle_tpu.text import viterbi_decode
+        rng = np.random.RandomState(0)
+        B, S, N = 2, 5, 4
+        pot = rng.rand(B, S, N).astype(np.float32)
+        trans = rng.rand(N, N).astype(np.float32)
+        scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                       paddle.to_tensor(trans),
+                                       include_bos_eos_tag=False)
+        for b in range(B):
+            best, bp = -1e9, None
+            for seq in itertools.product(range(N), repeat=S):
+                s = pot[b, 0, seq[0]] + sum(
+                    pot[b, t, seq[t]] + trans[seq[t - 1], seq[t]]
+                    for t in range(1, S))
+                if s > best:
+                    best, bp = s, seq
+            assert abs(float(scores.numpy()[b]) - best) < 1e-4
+            assert paths.numpy()[b].tolist() == list(bp)
+
+    def test_decoder_layer_and_vocab(self):
+        from paddle_tpu.text import ViterbiDecoder, Vocab
+        trans = paddle.to_tensor(np.zeros((5, 5), np.float32))
+        dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pot = paddle.to_tensor(np.random.rand(1, 4, 5).astype(np.float32))
+        scores, paths = dec(pot)
+        assert tuple(paths.shape) == (1, 4)
+        v = Vocab.build_from_corpus([["a", "b", "a"], ["c"]])
+        assert v.to_indices(["a", "zzz"])[1] == v.unk_id
+        assert v.to_tokens(v.to_indices(["a", "b"])) == ["a", "b"]
+
+
+class TestQuantization:
+    def test_qat_ste_gradients(self):
+        from paddle_tpu.quantization import QAT
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                     paddle.nn.ReLU(),
+                                     paddle.nn.Linear(16, 4))
+        q = QAT().quantize(model)
+        x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+        loss = paddle.mean(q(x) ** 2)
+        loss.backward()
+        g = q._sub_layers["0"].inner.weight.grad
+        assert g is not None and float(np.abs(g.numpy()).sum()) > 0
+
+    def test_ptq_calibrate_convert(self):
+        from paddle_tpu.quantization import PTQ
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+        ptq = PTQ()
+        mq = ptq.quantize(model)
+        x = paddle.to_tensor(np.random.rand(16, 8).astype(np.float32))
+        for _ in range(3):
+            mq(x)
+        mc = ptq.convert(mq)
+        inner = mq._sub_layers["0"].inner
+        ref = x.numpy() @ inner.weight.numpy() + inner.bias.numpy()
+        err = np.abs(mc(x).numpy() - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05
+        assert mq._sub_layers["0"].int8_weight.dtype == np.int8
+
+    def test_fake_quantize_op_levels(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 101).astype(np.float32))
+        from paddle_tpu.quantization import fake_quant
+        y = fake_quant(x, scale=1.0, bit_length=4)
+        assert len(np.unique(y.numpy())) <= 16
+
+
+class TestAutoTuner:
+    def test_search_valid_and_ranked(self):
+        from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerConfig
+        cfg = TunerConfig(num_devices=8, chip="v5p", global_batch_size=64,
+                          seq_length=2048, hidden_size=1024, num_layers=8,
+                          num_attention_heads=16, vocab_size=32000)
+        tuner = AutoTuner(cfg)
+        top = tuner.search(top_k=4)
+        assert top
+        for c in top:
+            assert c.dp_degree * c.mp_degree * c.pp_degree == 8
+            assert cfg.num_attention_heads % c.mp_degree == 0
+            assert c.estimated_memory_gb <= 95
+        times = [c.estimated_step_time for c in tuner.history]
+        assert times == sorted(times)
+
+    def test_memory_prune(self):
+        from paddle_tpu.distributed.auto_tuner import (Candidate, TunerConfig,
+                                                       prune_candidates)
+        cfg = TunerConfig(num_devices=1, chip="v5e", hidden_size=8192,
+                          num_layers=80, num_attention_heads=64,
+                          global_batch_size=1, micro_batch_size=[1])
+        # 70B-ish on one v5e chip must prune on memory
+        alive = prune_candidates([Candidate(1, 1, 1, 1, 1)], cfg)
+        assert not alive
+
+    def test_history_save(self, tmp_path):
+        from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerConfig
+        t = AutoTuner(TunerConfig(num_devices=4, global_batch_size=16,
+                                  num_attention_heads=8, num_layers=4,
+                                  hidden_size=512, vocab_size=3200))
+        t.search()
+        t.save_history(str(tmp_path / "h.json"))
+        import json
+        assert json.load(open(tmp_path / "h.json"))
+
+
+class TestAmpDebugging:
+    def test_operator_stats(self):
+        from paddle_tpu.amp import debugging as dbg
+        with dbg.collect_operator_stats():
+            x = paddle.to_tensor(np.ones((2, 2), np.float32))
+            paddle.matmul(x, x)
+            paddle.matmul(x, x)
+        # collection hook uninstalled
+        from paddle_tpu.ops import dispatcher
+        assert dispatcher._OP_SPAN_HOOK is None
+
+    def test_tensor_checker(self):
+        from paddle_tpu.amp import debugging as dbg
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(enable=True))
+        try:
+            with pytest.raises(FloatingPointError):
+                paddle.log(paddle.to_tensor(
+                    np.array([-1.0], np.float32))) * 2
+        finally:
+            dbg.disable_tensor_checker()
+
+    def test_check_numerics_and_compare(self, tmp_path):
+        from paddle_tpu.amp import debugging as dbg
+        t = paddle.to_tensor(np.ones(3, np.float32))
+        assert dbg.check_numerics(t) == (0, 0)
+        np.savez(tmp_path / "a.npz", w=np.ones(4, np.float32))
+        np.savez(tmp_path / "b.npz", w=np.ones(4, np.float32) * 1.01)
+        rows = dbg.compare_accuracy(str(tmp_path / "a.npz"),
+                                    str(tmp_path / "b.npz"),
+                                    str(tmp_path / "report.json"))
+        assert rows[0]["max_abs_diff"] == pytest.approx(0.01, rel=1e-3)
+
+
+class TestInterop:
+    def test_dlpack_roundtrip_and_torch(self):
+        from paddle_tpu.utils import dlpack
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        back = dlpack.from_dlpack(dlpack.to_dlpack(t))
+        np.testing.assert_array_equal(back.numpy(), t.numpy())
+        torch = pytest.importorskip("torch")
+        tt = torch.from_dlpack(dlpack.to_dlpack(t))
+        assert tt.sum().item() == 15.0
+        back2 = dlpack.from_dlpack(torch.arange(4.0))
+        assert float(back2.numpy().sum()) == 6.0
+        cap = torch.utils.dlpack.to_dlpack(torch.ones(3))
+        assert float(dlpack.from_dlpack(cap).numpy().sum()) == 3.0
+
+    def test_custom_op_autograd_and_method(self):
+        from paddle_tpu.utils import register_op
+        import jax
+        fn = register_op("test_gelu2x",
+                         lambda x, scale=2.0: scale * jax.nn.gelu(x),
+                         attrs={"scale": 2.0})
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        out = fn(x)
+        paddle.sum(out).backward()
+        assert x.grad is not None
+        with pytest.raises(ValueError):
+            register_op("test_gelu2x", lambda x: x)
+
+    def test_device_stats_and_events(self):
+        paddle.synchronize()
+        assert paddle.device.memory_allocated() >= 0
+        assert paddle.device.max_memory_reserved() >= 0
+        e1, e2 = paddle.device.Event(), paddle.device.Event()
+        e1.record()
+        paddle.to_tensor(np.ones(8, np.float32)) * 2
+        e2.record()
+        assert e2.elapsed_time(e2) >= 0.0
+
+
+class TestTextDatasets:
+    def test_uci_housing_local_file(self, tmp_path):
+        from paddle_tpu.text import UCIHousing
+        data = np.random.rand(50, 14)
+        np.savetxt(tmp_path / "housing.data", data)
+        train = UCIHousing(str(tmp_path / "housing.data"), mode="train")
+        test = UCIHousing(str(tmp_path / "housing.data"), mode="test")
+        assert len(train) == 40 and len(test) == 10
+        feats, label = train[0]
+        assert feats.shape == (13,) and label.shape == (1,)
+        assert feats.max() <= 1.0 + 1e-6
+
+    def test_missing_file_raises(self):
+        from paddle_tpu.text import Imdb, UCIHousing
+        with pytest.raises(FileNotFoundError):
+            UCIHousing("/nonexistent/file")
+        with pytest.raises(FileNotFoundError):
+            Imdb("/nonexistent/file.tar.gz")
+
+
+class TestReviewRegressions2:
+    def test_fake_quant_no_recompile_per_scale(self):
+        """Observer scale changes must not trigger new XLA compiles."""
+        from paddle_tpu.quantization import QAT
+        from paddle_tpu.ops import dispatcher
+        model = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+        q = QAT().quantize(model)
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        q(x)
+        info0 = dispatcher._get_exec.cache_info()
+        for i in range(4):
+            # different data -> different observed scales each step
+            q(paddle.to_tensor((np.random.rand(2, 4) * (i + 2)).astype(
+                np.float32)))
+        info1 = dispatcher._get_exec.cache_info()
+        assert info1.misses == info0.misses, \
+            f"scale changes recompiled: {info0} -> {info1}"
+
+    def test_qat_wraps_conv2d(self):
+        from paddle_tpu.quantization import QAT, QuantedConv2D
+        model = paddle.nn.Sequential(
+            paddle.nn.Conv2D(3, 8, 3, padding=1), paddle.nn.ReLU())
+        q = QAT().quantize(model)
+        assert isinstance(q._sub_layers["0"], QuantedConv2D)
+        x = paddle.to_tensor(np.random.rand(1, 3, 8, 8).astype(np.float32))
+        assert tuple(q(x).shape) == (1, 8, 8, 8)
+
+    def test_qat_inplace_false_preserves_original(self):
+        from paddle_tpu.quantization import QAT, QuantedLinear
+        model = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+        q = QAT().quantize(model, inplace=False)
+        assert isinstance(q._sub_layers["0"], QuantedLinear)
+        assert not isinstance(model._sub_layers["0"], QuantedLinear)
+
+    def test_operator_stats_restores_profiler_hook(self):
+        from paddle_tpu.amp import debugging as dbg
+        from paddle_tpu.ops import dispatcher
+
+        def my_hook(name):
+            import contextlib
+            return contextlib.nullcontext()
+
+        dispatcher.set_op_span_hook(my_hook)
+        try:
+            with dbg.collect_operator_stats():
+                paddle.to_tensor([1.0]) + 1.0
+            assert dispatcher._OP_SPAN_HOOK is my_hook
+        finally:
+            dispatcher.set_op_span_hook(None)
+
+    def test_memory_allocated_nonzero_fallback(self):
+        big = paddle.to_tensor(np.ones((256, 256), np.float32))
+        assert paddle.device.memory_allocated() > 0
+        assert paddle.device.max_memory_allocated() > 0
+        del big
