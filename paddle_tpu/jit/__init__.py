@@ -14,5 +14,7 @@ program — the steady-state training path that replaces the reference's
 executor pipeline (new_executor) for throughput.
 """
 
-from .api import to_static, TrainStep, not_to_static  # noqa: F401
-from ..framework import save, load  # noqa: F401
+from .api import (to_static, TrainStep, not_to_static,  # noqa: F401
+                  TranslatedLayer)
+from .api import save, load  # noqa: F401
+

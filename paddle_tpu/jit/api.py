@@ -362,3 +362,83 @@ class TrainStep:
         self._accum = None
         self._micro = 0
         return Tensor(loss)
+
+
+# -- jit.save / jit.load ------------------------------------------------------
+
+def save(layer, path: str, input_spec=None, **configs):
+    """paddle.jit.save (reference jit/api.py save + translated_layer.py):
+    trace the layer/function over `input_spec` placeholders, recording the
+    op graph with parameters baked in as constants, and serialize it as the
+    .pdmodel/.pdiparams inference artifact pair.
+
+    input_spec: list of static.InputSpec (or Tensors, whose shape/dtype are
+    used).
+    """
+    from .. import static as static_mod
+    from ..core.tensor import Tensor as _Tensor
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes/dtypes of "
+                         "the exported entry's inputs)")
+    fn = layer.forward if isinstance(layer, Layer) else layer
+    was_training = isinstance(layer, Layer) and layer.training
+    if was_training:
+        layer.eval()
+
+    try:
+        prog = static_mod.Program()
+        with static_mod.program_guard(prog):
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                shape, dtype = tuple(spec.shape), spec.dtype
+                if any(d is None or (isinstance(d, int) and d < 0)
+                       for d in shape):
+                    raise ValueError(
+                        f"jit.save: input_spec[{i}] has a dynamic dim "
+                        f"{shape} — XLA traces static shapes; export one "
+                        f"program per bucketed shape instead")
+                name = getattr(spec, "name", None) or f"x{i}"
+                feeds.append(static_mod.data(name, shape, dtype))
+            out = fn(*feeds)
+        fetches = list(out) if isinstance(out, (list, tuple)) else [out]
+
+        exe = static_mod.Executor()
+        static_mod.save_inference_model(path, feeds, fetches, exe,
+                                        program=prog)
+    finally:
+        if was_training:
+            layer.train()
+
+
+class TranslatedLayer(Layer):
+    """Runtime for a jit.save artifact (reference
+    jit/translated_layer.py:TranslatedLayer): callable like the original
+    layer, executing the recorded program through the jitted Executor."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        from .. import static as static_mod
+        self._exe = static_mod.Executor()
+        self._program, self._feed_names, self._fetch_names = \
+            static_mod.load_inference_model(path, self._exe)
+
+    def forward(self, *args):
+        from ..core.tensor import Tensor as _Tensor
+        if len(args) != len(self._feed_names):
+            raise TypeError(
+                f"TranslatedLayer expects {len(self._feed_names)} inputs "
+                f"({self._feed_names}), got {len(args)}")
+        feed = {}
+        for name, a in zip(self._feed_names, args):
+            feed[name] = a._data if isinstance(a, _Tensor) else a
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             return_numpy=False)
+        outs = [_Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def load(path: str) -> TranslatedLayer:
+    """paddle.jit.load — returns a TranslatedLayer over the saved program."""
+    return TranslatedLayer(path)

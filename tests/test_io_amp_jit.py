@@ -267,3 +267,58 @@ class TestReviewRegressions2:
         bn._mean = paddle.zeros([4])
         assert "_mean" in dict(bn.named_buffers())
         assert "_mean" in bn.state_dict()
+
+
+class TestJitSaveLoad:
+    def test_roundtrip_layer(self, tmp_path):
+        import os
+        from paddle_tpu.static import InputSpec
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+        want = net(x).numpy()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(net, prefix, input_spec=[InputSpec([3, 4])])
+        assert os.path.exists(prefix + ".pdmodel")
+        assert os.path.exists(prefix + ".pdiparams")
+        loaded = paddle.jit.load(prefix)
+        np.testing.assert_allclose(loaded(x).numpy(), want, rtol=1e-5)
+
+    def test_save_requires_input_spec(self, tmp_path):
+        net = paddle.nn.Linear(2, 2)
+        with pytest.raises(ValueError):
+            paddle.jit.save(net, str(tmp_path / "m"))
+
+    def test_save_restores_training_mode(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        net = paddle.nn.Sequential(paddle.nn.Linear(2, 2),
+                                   paddle.nn.Dropout(0.5))
+        net.train()
+        paddle.jit.save(net, str(tmp_path / "m"),
+                        input_spec=[InputSpec([1, 2])])
+        assert net.training
+
+    def test_dynamic_dim_raises_clearly(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        net = paddle.nn.Linear(4, 2)
+        with pytest.raises(ValueError, match="dynamic dim"):
+            paddle.jit.save(net, str(tmp_path / "m"),
+                            input_spec=[InputSpec([None, 4])])
+        # failed export must not leave the layer in eval mode
+        net.train()
+        with pytest.raises(ValueError):
+            paddle.jit.save(net, str(tmp_path / "m"),
+                            input_spec=[InputSpec([None, 4])])
+        assert net.training
+
+    def test_translated_layer_arity_check(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        net = paddle.nn.Linear(4, 2)
+        paddle.jit.save(net, str(tmp_path / "m"),
+                        input_spec=[InputSpec([2, 4])])
+        loaded = paddle.jit.load(str(tmp_path / "m"))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with pytest.raises(TypeError, match="expects 1 inputs"):
+            loaded(x, x)
+        with pytest.raises(TypeError):
+            loaded()
