@@ -22,3 +22,5 @@ from .loss import (  # noqa: F401
     BCEWithLogitsLoss, KLDivLoss,
 )
 from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401
+from .rnn import (LSTM, GRU, SimpleRNN, LSTMCell, GRUCell,  # noqa: E402,F401
+                  SimpleRNNCell)

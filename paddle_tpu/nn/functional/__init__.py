@@ -116,3 +116,19 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
     maxlen = maxlen or int(lengths.max().item())
     row = paddle.arange(maxlen)
     return (row.unsqueeze(0) < lengths.unsqueeze(-1)).astype(dtype)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """reference python/paddle/nn/functional/loss.py ctc_loss (warpctc);
+    here the XLA-composite scan kernel. log_probs: [T, B, C] (logits are
+    log-softmaxed here), labels [B, L] padded."""
+    lp = _call_op("log_softmax", log_probs, axis=-1)
+    loss = _call_op("ctc_loss", lp, labels, input_lengths, label_lengths,
+                    blank=blank, norm_by_times=norm_by_times)
+    if reduction == "mean":
+        # paddle semantics: per-sample loss divided by label length, then mean
+        return _call_op("mean", loss / label_lengths.astype(loss.dtype))
+    if reduction == "sum":
+        return _call_op("sum", loss)
+    return loss

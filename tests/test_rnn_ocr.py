@@ -1,0 +1,182 @@
+"""RNN layers (vs torch goldens), CTC loss (vs torch), OCR det+rec models."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+class TestLSTMParity:
+    def test_bidirectional_two_layer_matches_torch(self):
+        T, B, I, H = 5, 3, 4, 6
+        x = np.random.RandomState(0).rand(B, T, I).astype(np.float32)
+        pl = paddle.nn.LSTM(I, H, num_layers=2, direction="bidirect")
+        tl = torch.nn.LSTM(I, H, num_layers=2, bidirectional=True,
+                           batch_first=True)
+        with torch.no_grad():
+            for layer in range(2):
+                for suf in ("", "_reverse"):
+                    for name in ("weight_ih", "weight_hh", "bias_ih",
+                                 "bias_hh"):
+                        src = getattr(pl, f"{name}_l{layer}{suf}").numpy()
+                        getattr(tl, f"{name}_l{layer}{suf}").copy_(
+                            torch.from_numpy(src.copy()))
+        out_p, (h_p, c_p) = pl(paddle.to_tensor(x))
+        out_t, (h_t, c_t) = tl(torch.from_numpy(x))
+        np.testing.assert_allclose(out_p.numpy(), out_t.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(h_p.numpy(), h_t.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(c_p.numpy(), c_t.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_gru_and_simplernn_match_torch(self):
+        T, B, I, H = 4, 2, 3, 5
+        x = np.random.RandomState(1).rand(B, T, I).astype(np.float32)
+        pg = paddle.nn.GRU(I, H)
+        tg = torch.nn.GRU(I, H, batch_first=True)
+        ps = paddle.nn.SimpleRNN(I, H)
+        ts = torch.nn.RNN(I, H, batch_first=True)
+        with torch.no_grad():
+            for pm, tm in ((pg, tg), (ps, ts)):
+                for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                    getattr(tm, f"{name}_l0").copy_(torch.from_numpy(
+                        getattr(pm, f"{name}_l0").numpy().copy()))
+        np.testing.assert_allclose(
+            pg(paddle.to_tensor(x))[0].numpy(),
+            tg(torch.from_numpy(x))[0].detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(
+            ps(paddle.to_tensor(x))[0].numpy(),
+            ts(torch.from_numpy(x))[0].detach().numpy(), atol=1e-5)
+
+    def test_cells(self):
+        cell = paddle.nn.LSTMCell(4, 6)
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        h, (h2, c2) = cell(x)
+        assert tuple(h.shape) == (2, 6) and tuple(c2.shape) == (2, 6)
+        g = paddle.nn.GRUCell(4, 6)
+        h, _ = g(x)
+        assert tuple(h.shape) == (2, 6)
+
+    def test_lstm_gradients_flow(self):
+        lstm = paddle.nn.LSTM(3, 4)
+        x = paddle.to_tensor(np.random.rand(2, 5, 3).astype(np.float32),
+                             stop_gradient=False)
+        out, _ = lstm(x)
+        paddle.mean(out * out).backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+
+class TestCTC:
+    def test_matches_torch(self):
+        T, B, C, L = 12, 2, 7, 4
+        rng = np.random.RandomState(0)
+        logits = rng.rand(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, size=(B, L)).astype(np.int32)
+        in_lens = np.array([12, 10], np.int32)
+        lb_lens = np.array([4, 3], np.int32)
+        loss_p = F.ctc_loss(paddle.to_tensor(logits),
+                            paddle.to_tensor(labels),
+                            paddle.to_tensor(in_lens),
+                            paddle.to_tensor(lb_lens), reduction="none")
+        loss_t = torch.nn.functional.ctc_loss(
+            torch.from_numpy(logits).log_softmax(-1),
+            torch.from_numpy(labels.astype(np.int64)),
+            torch.from_numpy(in_lens.astype(np.int64)),
+            torch.from_numpy(lb_lens.astype(np.int64)),
+            blank=0, reduction="none")
+        np.testing.assert_allclose(loss_p.numpy(), loss_t.numpy(), rtol=1e-4)
+
+    def test_training_reduces_loss(self):
+        """CTC-train a tiny linear model to emit a fixed label sequence."""
+        T, B, C = 10, 1, 5
+        x = paddle.to_tensor(np.random.RandomState(0).rand(T, B, 8)
+                             .astype(np.float32))
+        lin = paddle.nn.Linear(8, C)
+        labels = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+        in_lens = paddle.to_tensor(np.array([T], np.int32))
+        lb_lens = paddle.to_tensor(np.array([3], np.int32))
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=lin.parameters())
+        first = None
+        for _ in range(30):
+            loss = F.ctc_loss(lin(x), labels, in_lens, lb_lens)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first / 3
+
+
+class TestOCRModels:
+    def test_dbnet_forward_and_loss_step(self):
+        from paddle_tpu.models import DBLoss, DBNet
+        det = DBNet(scale=0.25, fpn_channels=32)
+        x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
+        out = det(x)
+        assert tuple(out["maps"].shape) == (1, 3, 64, 64)
+        assert float(out["prob"].numpy().min()) >= 0.0
+        assert float(out["prob"].numpy().max()) <= 1.0
+        gt = paddle.to_tensor(
+            (np.random.rand(1, 1, 64, 64) > 0.7).astype(np.float32))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=det.parameters())
+        first = None
+        for _ in range(3):
+            loss = DBLoss()(det(x), gt, gt, gt)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first  # optimizing
+
+    def test_crnn_ctc_pipeline(self):
+        from paddle_tpu.models import CRNN, CTCHeadLoss
+        rec = CRNN(num_classes=11, hidden_size=32)
+        img = paddle.to_tensor(np.random.rand(2, 3, 32, 48).astype(np.float32))
+        logits = rec(img)
+        assert logits.shape[1] == 2 and logits.shape[2] == 11
+        labels = paddle.to_tensor(
+            np.random.randint(1, 11, size=(2, 4)).astype(np.int32))
+        lens = paddle.to_tensor(np.array([4, 3], np.int32))
+        loss = CTCHeadLoss()(logits, labels, lens)
+        loss.backward()
+        assert np.isfinite(float(loss))
+        assert rec.fc.weight.grad is not None
+
+
+class TestVariableLength:
+    def test_bidirectional_lstm_respects_sequence_length(self):
+        """vs torch pack_padded_sequence: reverse pass must start at each
+        sample's true last step, not at padding."""
+        T, B, I, H = 6, 3, 4, 5
+        rng = np.random.RandomState(2)
+        x = rng.rand(B, T, I).astype(np.float32)
+        lens = np.array([6, 4, 2], np.int64)
+        for b, l in enumerate(lens):
+            x[b, l:] = 0.0
+        pl = paddle.nn.LSTM(I, H, direction="bidirect")
+        tl = torch.nn.LSTM(I, H, bidirectional=True, batch_first=True)
+        with torch.no_grad():
+            for suf in ("", "_reverse"):
+                for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                    getattr(tl, f"{name}_l0{suf}").copy_(torch.from_numpy(
+                        getattr(pl, f"{name}_l0{suf}").numpy().copy()))
+        out_p, (h_p, _) = pl(paddle.to_tensor(x),
+                             sequence_length=paddle.to_tensor(
+                                 lens.astype(np.int32)))
+        packed = torch.nn.utils.rnn.pack_padded_sequence(
+            torch.from_numpy(x), torch.from_numpy(lens), batch_first=True)
+        out_t_packed, (h_t, _) = tl(packed)
+        out_t, _ = torch.nn.utils.rnn.pad_packed_sequence(
+            out_t_packed, batch_first=True, total_length=T)
+        np.testing.assert_allclose(out_p.numpy(), out_t.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(h_p.numpy(), h_t.detach().numpy(),
+                                   atol=1e-5)
