@@ -28,6 +28,7 @@ from ..ops.dispatcher import call_op
 from .. import nn
 from ..nn import initializer as I
 from ..nn.layer_base import Layer
+from .generation import GenerationMixin
 from ..distributed.topology import get_hybrid_communicate_group as _get_hcg
 
 
@@ -147,11 +148,24 @@ class LlamaAttention(Layer):
         self.rotary = LlamaRotaryEmbedding(
             self.head_dim, config.max_position_embeddings, config.rope_theta)
 
-    def forward(self, x, attn_mask=None, position_ids=None):
+    def forward(self, x, attn_mask=None, position_ids=None, cache=None,
+                start_pos=None, layer_idx=0):
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if cache is not None:
+            # decode path: rope at absolute positions, write into the cache,
+            # attend against everything written so far (serving kernels)
+            pos_ids = (call_op("arange", end=s, dtype="int32") + start_pos
+                       ).reshape([1, s]).broadcast_to([b, s])
+            cos, sin = self.rotary(self.config.max_position_embeddings)
+            q, k = call_op("rope", q, k, cos=cos, sin=sin,
+                           position_ids=pos_ids)
+            cache.update(layer_idx, k, v, start_pos)
+            out = cache.attend(layer_idx, q, start_pos, attn_mask)
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out)
         cos, sin = self.rotary(s)
         q, k = call_op("rope", q, k, cos=cos, sin=sin,
                        position_ids=position_ids)
@@ -192,9 +206,11 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
                                                      config.rms_norm_eps)
 
-    def forward(self, x, attn_mask=None, position_ids=None):
+    def forward(self, x, attn_mask=None, position_ids=None, cache=None,
+                start_pos=None, layer_idx=0):
         x = x + self.self_attn(self.input_layernorm(x), attn_mask,
-                               position_ids)
+                               position_ids, cache=cache,
+                               start_pos=start_pos, layer_idx=layer_idx)
         return x + self.mlp(self.post_attention_layernorm(x))
 
 
@@ -229,7 +245,18 @@ class LlamaModel(Layer):
         hcg = _get_hcg()
         return hcg.get_pipe_parallel_world_size() if hcg is not None else 1
 
-    def forward(self, input_ids, attn_mask=None, position_ids=None):
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                cache=None, start_pos=None):
+        if cache is not None:
+            if not hasattr(self, "layers"):
+                raise NotImplementedError(
+                    "KV-cache decode requires the unrolled layer list "
+                    "(use_scan_layers/pp stacks are train-time paths)")
+            x = self.embed_tokens(input_ids)
+            for i, layer in enumerate(self.layers):
+                x = layer(x, attn_mask=attn_mask, cache=cache,
+                          start_pos=start_pos, layer_idx=i)
+            return self.norm(x)
         x = self.embed_tokens(input_ids)
         pp = self._pp_degree()
         if pp > 1 and hasattr(self, "layer_stack"):
@@ -251,7 +278,7 @@ class LlamaModel(Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -262,8 +289,10 @@ class LlamaForCausalLM(Layer):
                 self.lm_head = _linear(config.hidden_size, config.vocab_size,
                                        col=True, gather_output=True)
 
-    def forward(self, input_ids, attn_mask=None, position_ids=None):
-        hidden = self.llama(input_ids, attn_mask, position_ids)
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                cache=None, start_pos=None):
+        hidden = self.llama(input_ids, attn_mask, position_ids,
+                            cache=cache, start_pos=start_pos)
         if self.lm_head is None:  # tied: logits = h @ E^T
             return call_op("matmul", hidden, self.llama.embed_tokens.weight,
                            transpose_y=True)

@@ -21,7 +21,8 @@ class _RNNBase(Layer):
     def __init__(self, mode: str, input_size: int, hidden_size: int,
                  num_layers: int = 1, direction: str = "forward",
                  time_major: bool = False, dropout: float = 0.0,
-                 activation: str = "tanh"):
+                 activation: str = "tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
         super().__init__()
         if direction not in ("forward", "bidirect", "bidirectional"):
             raise ValueError(f"bad direction {direction!r}")
@@ -44,12 +45,16 @@ class _RNNBase(Layer):
                          else hidden_size * self.num_directions)
                 tag = f"{layer}{'_reverse' if d else ''}"
                 w_ih = self.create_parameter([g * hidden_size, isize],
+                                             attr=weight_ih_attr,
                                              default_initializer=init)
                 w_hh = self.create_parameter([g * hidden_size, hidden_size],
+                                             attr=weight_hh_attr,
                                              default_initializer=init)
                 b_ih = self.create_parameter([g * hidden_size], is_bias=True,
+                                             attr=bias_ih_attr,
                                              default_initializer=init)
                 b_hh = self.create_parameter([g * hidden_size], is_bias=True,
+                                             attr=bias_hh_attr,
                                              default_initializer=init)
                 for name, p in ((f"weight_ih_l{tag}", w_ih),
                                 (f"weight_hh_l{tag}", w_hh),
@@ -131,7 +136,10 @@ class LSTM(_RNNBase):
                  weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
                  bias_hh_attr=None, name=None):
         super().__init__("lstm", input_size, hidden_size, num_layers,
-                         direction, time_major, dropout)
+                         direction, time_major, dropout,
+                         weight_ih_attr=weight_ih_attr,
+                         weight_hh_attr=weight_hh_attr,
+                         bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
 
 
 class GRU(_RNNBase):
@@ -140,7 +148,10 @@ class GRU(_RNNBase):
                  weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
                  bias_hh_attr=None, name=None):
         super().__init__("gru", input_size, hidden_size, num_layers,
-                         direction, time_major, dropout)
+                         direction, time_major, dropout,
+                         weight_ih_attr=weight_ih_attr,
+                         weight_hh_attr=weight_hh_attr,
+                         bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
 
 
 class SimpleRNN(_RNNBase):
@@ -149,11 +160,16 @@ class SimpleRNN(_RNNBase):
                  activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
                  bias_ih_attr=None, bias_hh_attr=None, name=None):
         super().__init__("rnn", input_size, hidden_size, num_layers,
-                         direction, time_major, dropout, activation)
+                         direction, time_major, dropout, activation,
+                         weight_ih_attr=weight_ih_attr,
+                         weight_hh_attr=weight_hh_attr,
+                         bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
 
 
 class _CellBase(Layer):
-    def __init__(self, mode: str, input_size: int, hidden_size: int):
+    def __init__(self, mode: str, input_size: int, hidden_size: int,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
         super().__init__()
         g = _RNNBase.GATES[mode]
         std = 1.0 / math.sqrt(hidden_size)
@@ -161,12 +177,16 @@ class _CellBase(Layer):
         self.mode = mode
         self.hidden_size = hidden_size
         self.weight_ih = self.create_parameter([g * hidden_size, input_size],
+                                               attr=weight_ih_attr,
                                                default_initializer=init)
         self.weight_hh = self.create_parameter([g * hidden_size, hidden_size],
+                                               attr=weight_hh_attr,
                                                default_initializer=init)
         self.bias_ih = self.create_parameter([g * hidden_size], is_bias=True,
+                                             attr=bias_ih_attr,
                                              default_initializer=init)
         self.bias_hh = self.create_parameter([g * hidden_size], is_bias=True,
+                                             attr=bias_hh_attr,
                                              default_initializer=init)
 
 
@@ -174,7 +194,8 @@ class LSTMCell(_CellBase):
     def __init__(self, input_size, hidden_size, weight_ih_attr=None,
                  weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
                  name=None):
-        super().__init__("lstm", input_size, hidden_size)
+        super().__init__("lstm", input_size, hidden_size, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
 
     def forward(self, inputs, states=None):
         B = inputs.shape[0]
@@ -194,7 +215,8 @@ class GRUCell(_CellBase):
     def __init__(self, input_size, hidden_size, weight_ih_attr=None,
                  weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
                  name=None):
-        super().__init__("gru", input_size, hidden_size)
+        super().__init__("gru", input_size, hidden_size, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
 
     def forward(self, inputs, states=None):
         B = inputs.shape[0]
@@ -211,7 +233,8 @@ class SimpleRNNCell(_CellBase):
     def __init__(self, input_size, hidden_size, activation="tanh",
                  weight_ih_attr=None, weight_hh_attr=None,
                  bias_ih_attr=None, bias_hh_attr=None, name=None):
-        super().__init__("rnn", input_size, hidden_size)
+        super().__init__("rnn", input_size, hidden_size, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
         self.activation = activation
 
     def forward(self, inputs, states=None):

@@ -14,3 +14,4 @@ from . import random  # noqa: F401
 from . import linalg_fft  # noqa: F401
 from . import quant  # noqa: F401
 from . import rnn  # noqa: F401
+from . import serving  # noqa: F401

@@ -1,0 +1,228 @@
+"""Serving path: KV caches, cache/paged attention, generate loop."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd.engine import no_grad
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import KVCache, PagedKVCache
+from paddle_tpu.ops.dispatcher import call_op
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return cfg, m
+
+
+class TestKVCacheDecode:
+    def test_prefill_matches_full_forward(self, tiny_llama):
+        cfg, m = tiny_llama
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (2, 6)).astype(np.int32))
+        with no_grad():
+            full = m(ids).numpy()
+            cache = KVCache(2, 2, 16, cfg.num_key_value_heads, 8)
+            pre = m(ids, cache=cache,
+                    start_pos=Tensor(jnp.asarray(0, jnp.int32))).numpy()
+        np.testing.assert_allclose(pre, full, atol=2e-4)
+
+    def test_token_by_token_matches(self, tiny_llama):
+        cfg, m = tiny_llama
+        ids_np = np.random.RandomState(1).randint(0, 128, (1, 5)).astype(
+            np.int32)
+        with no_grad():
+            full = m(paddle.to_tensor(ids_np)).numpy()
+            cache = KVCache(2, 1, 8, cfg.num_key_value_heads, 8)
+            outs = []
+            for t in range(5):
+                lg = m(paddle.to_tensor(ids_np[:, t:t + 1]), cache=cache,
+                       start_pos=Tensor(jnp.asarray(t, jnp.int32)))
+                outs.append(lg.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
+                                   atol=3e-4)
+
+    def test_generate_greedy_deterministic(self, tiny_llama):
+        cfg, m = tiny_llama
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 128, (2, 4)).astype(np.int32))
+        a = m.generate(ids, max_new_tokens=6, temperature=0.0).numpy()
+        b = m.generate(ids, max_new_tokens=6, temperature=0.0).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (2, 10)
+        np.testing.assert_array_equal(a[:, :4], ids.numpy())
+
+    def test_generate_sampling_shapes(self, tiny_llama):
+        cfg, m = tiny_llama
+        ids = paddle.to_tensor(np.zeros((1, 3), np.int32))
+        out = m.generate(ids, max_new_tokens=4, temperature=0.9, top_k=20,
+                         top_p=0.9)
+        assert tuple(out.shape) == (1, 7)
+
+
+class TestPagedCache:
+    def test_paged_matches_contiguous_attention(self):
+        """paged_attention over scattered blocks == cache_attention over a
+        contiguous buffer with the same contents."""
+        B, T, KV, D, H = 2, 12, 2, 8, 4
+        BS = 4  # block size
+        rng = np.random.RandomState(0)
+        q = Tensor(rng.rand(B, 1, H, D).astype(np.float32))
+        kv_data = rng.rand(2, B, T, KV, D).astype(np.float32)
+        lens = np.array([10, 7], np.int32)
+
+        # contiguous reference
+        kc = Tensor(np.where(
+            np.arange(T)[None, :, None, None] < lens[:, None, None, None],
+            kv_data[0], 0.0).astype(np.float32))
+        vc = Tensor(np.where(
+            np.arange(T)[None, :, None, None] < lens[:, None, None, None],
+            kv_data[1], 0.0).astype(np.float32))
+        # cache_attention masks by pos: q position = len-1
+        outs_ref = []
+        for b in range(B):
+            o = call_op("cache_attention",
+                        Tensor(q.numpy()[b:b + 1]),
+                        Tensor(kc.numpy()[b:b + 1]),
+                        Tensor(vc.numpy()[b:b + 1]),
+                        Tensor(jnp.asarray(int(lens[b]) - 1, jnp.int32)))
+            outs_ref.append(o.numpy())
+        ref = np.concatenate(outs_ref, axis=0)
+
+        # paged: scatter the same tokens into a shuffled block pool
+        cache = PagedKVCache(1, B, num_blocks=8, block_size=BS,
+                             num_kv_heads=KV, head_dim=D,
+                             max_blocks_per_seq=3)
+        for t in range(int(lens.max())):
+            active = t < lens
+            pos_write = np.where(active, t, 0)
+            # finished sequences re-write position 0 with position-0 data
+            # (identity rewrite) so their cache contents stay correct
+            rows_k = kv_data[0][np.arange(B), pos_write][:, None]
+            rows_v = kv_data[1][np.arange(B), pos_write][:, None]
+            cache.write_token(0, pos_write, Tensor(rows_k), Tensor(rows_v))
+        out = cache.attend(0, q).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_allocator_reuse(self):
+        cache = PagedKVCache(1, 1, num_blocks=4, block_size=2,
+                             num_kv_heads=1, head_dim=4,
+                             max_blocks_per_seq=4)
+        k = Tensor(np.ones((1, 1, 1, 4), np.float32))
+        for t in range(6):
+            cache.write_token(0, np.array([t]), k, k)
+        assert cache.context_lens[0] == 6
+        used_before = len(cache._free)
+        cache.release(0)
+        assert len(cache._free) == used_before + 3
+        # pool exhausted raises
+        cache2 = PagedKVCache(1, 1, num_blocks=1, block_size=2,
+                              num_kv_heads=1, head_dim=4,
+                              max_blocks_per_seq=2)
+        cache2.write_token(0, np.array([0]), k, k)
+        cache2.write_token(0, np.array([1]), k, k)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            cache2.write_token(0, np.array([2]), k, k)
+
+
+class TestSampling:
+    def test_greedy_is_argmax(self):
+        logits = Tensor(np.array([[0.1, 5.0, 0.2], [3.0, 0.0, 0.1]],
+                                 np.float32))
+        tok = call_op("sample_logits", logits, temperature=0.0)
+        np.testing.assert_array_equal(tok.numpy(), [1, 0])
+
+    def test_top_k_restricts_support(self):
+        logits = Tensor(np.array([[10.0, 9.0, -50.0, -50.0]] * 8,
+                                 np.float32))
+        for _ in range(5):
+            tok = call_op("sample_logits", logits, temperature=1.0, top_k=2)
+            assert set(np.asarray(tok.numpy()).tolist()) <= {0, 1}
+
+    def test_top_p_keeps_mass(self):
+        # one dominant token with p > top_p → always selected
+        logits = Tensor(np.array([[20.0, 1.0, 1.0, 1.0]] * 4, np.float32))
+        tok = call_op("sample_logits", logits, temperature=1.0, top_p=0.5)
+        np.testing.assert_array_equal(tok.numpy(), [0, 0, 0, 0])
+
+
+class TestReviewRegressions:
+    def test_paged_cache_multilayer(self):
+        """Layer writes share ONE block table; layer>0 must not re-allocate."""
+        cache = PagedKVCache(2, 1, num_blocks=4, block_size=2,
+                             num_kv_heads=1, head_dim=4,
+                             max_blocks_per_seq=2)
+        k0 = Tensor(np.full((1, 1, 1, 4), 1.0, np.float32))
+        k1 = Tensor(np.full((1, 1, 1, 4), 2.0, np.float32))
+        cache.write_token(0, np.array([0]), k0, k0)
+        cache.write_token(1, np.array([0]), k1, k1)
+        assert len(cache._free) == 3  # exactly one block allocated
+        q = Tensor(np.ones((1, 1, 2, 4), np.float32))
+        out0 = cache.attend(0, q).numpy()
+        out1 = cache.attend(1, q).numpy()
+        np.testing.assert_allclose(out0, 1.0)  # layer-0 data reachable
+        np.testing.assert_allclose(out1, 2.0)
+
+    def test_generate_capacity_validation(self, tiny_llama):
+        cfg, m = tiny_llama
+        ids = paddle.to_tensor(np.zeros((1, 10), np.int32))
+        with pytest.raises(ValueError, match="max_cache_len"):
+            m.generate(ids, max_new_tokens=100, max_cache_len=16)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            m.generate(ids, max_new_tokens=1000)
+
+    def test_eos_pads_finished_rows(self, tiny_llama):
+        cfg, m = tiny_llama
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, 128, (2, 3)).astype(np.int32))
+        # greedy with an eos id that will be hit quickly for at least one row
+        out = m.generate(ids, max_new_tokens=8, temperature=0.0,
+                         eos_token_id=int(np.argmax(np.random.RandomState(3)
+                                                    .rand(128))))
+        gen = out.numpy()[:, 3:]
+        for row in gen:
+            seen_eos = False
+            for tok in row:
+                if seen_eos:
+                    assert tok == row[list(row).index(tok)]  # stays eos after
+            # structural check: after first eos, all tokens equal eos
+        # direct structural assertion
+        eos = int(np.argmax(np.random.RandomState(3).rand(128)))
+        for row in gen:
+            idx = np.where(row == eos)[0]
+            if len(idx):
+                assert (row[idx[0]:] == eos).all()
+
+    def test_cache_prefill_honors_attn_mask(self, tiny_llama):
+        cfg, m = tiny_llama
+        ids = paddle.to_tensor(
+            np.random.RandomState(4).randint(0, 128, (1, 6)).astype(np.int32))
+        # mask out the FIRST two positions (left padding) — the causal mask
+        # alone would still let later queries attend to them
+        mask = np.ones((1, 1, 6, 6), bool)
+        mask[..., :2] = False
+        with no_grad():
+            cache = KVCache(2, 1, 6, cfg.num_key_value_heads, 8)
+            masked = m(ids, attn_mask=paddle.to_tensor(mask), cache=cache,
+                       start_pos=Tensor(jnp.asarray(0, jnp.int32))).numpy()
+            cache2 = KVCache(2, 1, 6, cfg.num_key_value_heads, 8)
+            unmasked = m(ids, cache=cache2,
+                         start_pos=Tensor(jnp.asarray(0, jnp.int32))).numpy()
+        assert not np.allclose(masked[:, 2:], unmasked[:, 2:])
+
+    def test_rnn_attr_initializer_honored(self):
+        import paddle_tpu.nn.initializer as I
+
+        class Attr:
+            initializer = I.Constant(0.25)
+            trainable = True
+
+        lstm = paddle.nn.LSTM(3, 4, weight_ih_attr=Attr())
+        np.testing.assert_allclose(lstm.weight_ih_l0.numpy(), 0.25)
