@@ -154,3 +154,8 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     return optimizer
 
 from .elastic import ElasticManager, ElasticStatus  # noqa: E402,F401
+from . import sequence_parallel_utils  # noqa: E402,F401
+from .sequence_parallel_utils import (  # noqa: E402,F401
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,
+    GatherOp, AllGatherOp, ReduceScatterOp,
+    mark_as_sequence_parallel_parameter)

@@ -171,3 +171,5 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
     return call_op("flash_attention", query, key, value,
                    dropout_p=p if training else 0.0, is_causal=False,
                    attn_mask=attn_bias)
+
+from . import functional  # noqa: E402,F401

@@ -75,6 +75,10 @@ class PagedKVCache:
     # -- host-side allocator -------------------------------------------------
     def _ensure_block(self, seq: int, pos: int) -> int:
         blk_idx = pos // self.block_size
+        if blk_idx >= self.block_tables.shape[1]:
+            raise RuntimeError(
+                f"PagedKVCache: position {pos} needs block {blk_idx} but "
+                f"max_blocks_per_seq={self.block_tables.shape[1]}")
         while self._allocated[seq] <= blk_idx:
             if not self._free:
                 raise RuntimeError("PagedKVCache: block pool exhausted")
@@ -101,7 +105,10 @@ class PagedKVCache:
                                 slot_ids)
         self.v[layer] = call_op("paged_cache_write", self.v[layer], v_new,
                                 slot_ids)
-        if layer == self.num_layers - 1:
+        # advance lengths at the FIRST layer's write: forward order is
+        # write(i) → attend(i) → write(i+1)..., so every layer (including
+        # layer 0) must already see the just-written token in its mask
+        if layer == 0:
             for b, pos in enumerate(seq_positions):
                 self.context_lens[b] = max(self.context_lens[b],
                                            int(pos) + 1)

@@ -665,10 +665,23 @@ def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=Tru
     """fused rotary embedding (reference phi/kernels/fusion/gpu/fused_rope*).
 
     q/k: [batch, seq, heads, head_dim]; cos/sin: [seq, head_dim] or
-    [1, seq, 1, head_dim]."""
+    [1, seq, 1, head_dim]. rotate_half_style=True is the neox convention
+    (halves rotated, matching the half-concat cos/sin tables);
+    False is GPT-J interleaved pairs (tables re-laid to repeat per pair)."""
     def rot(x):
-        x1, x2 = jnp.split(x, 2, axis=-1)
-        return jnp.concatenate([-x2, x1], axis=-1)
+        if rotate_half_style:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([-x2, x1], axis=-1)
+        x1 = x[..., ::2]
+        x2 = x[..., 1::2]
+        return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+    def relayout(t):
+        if rotate_half_style:
+            return t
+        # half-concat [f0..f_{d/2-1}, f0..] -> interleaved [f0,f0,f1,f1,..]
+        half = t[..., : t.shape[-1] // 2]
+        return jnp.repeat(half, 2, axis=-1)
 
     def bshape(t, like):
         if t.ndim == 2:  # [seq, dim]
@@ -678,11 +691,11 @@ def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=Tru
     if position_ids is not None:
         cos = jnp.take(cos.reshape(cos.shape[-2], cos.shape[-1]), position_ids, axis=0)
         sin = jnp.take(sin.reshape(sin.shape[-2], sin.shape[-1]), position_ids, axis=0)
-        cos = cos[:, :, None, :].astype(q.dtype)
-        sin = sin[:, :, None, :].astype(q.dtype)
+        cos = relayout(cos)[:, :, None, :].astype(q.dtype)
+        sin = relayout(sin)[:, :, None, :].astype(q.dtype)
     else:
-        cos = bshape(cos, q)
-        sin = bshape(sin, q)
+        cos = bshape(relayout(cos), q)
+        sin = bshape(relayout(sin), q)
     out_q = q * cos + rot(q) * sin
     if k is not None:
         out_k = k * cos + rot(k) * sin

@@ -42,7 +42,15 @@ def cache_attention_kernel(q, k_cache, v_cache, pos, attn_mask=None,
     mask = (jnp.arange(T, dtype=jnp.int32)[None, None, None, :]
             <= qpos[None, None, :, None])
     if attn_mask is not None:
-        mask = mask & attn_mask.astype(bool)
+        if attn_mask.dtype == jnp.bool_:
+            mask = mask & attn_mask
+        else:
+            # additive float mask (0 keep / -inf drop), same convention as
+            # the non-cache sdpa path: fold the causal mask into the bias
+            bias = jnp.where(mask, 0.0, -jnp.inf) + attn_mask.astype(
+                jnp.float32)
+            return scaled_dot_product_attention(q, k_cache, v_cache,
+                                                attn_mask=bias, scale=scale)
     return scaled_dot_product_attention(q, k_cache, v_cache, attn_mask=mask,
                                         scale=scale)
 

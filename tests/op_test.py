@@ -12,8 +12,12 @@ import numpy as np
 import paddle_tpu as paddle
 
 
-def check_output(op_name, inputs, attrs, numpy_ref, rtol=1e-5, atol=1e-6):
-    """Run op eagerly, compare against a numpy reference implementation."""
+def check_output(op_name, inputs, attrs, numpy_ref, rtol=1e-5, atol=1e-6,
+                 check_static=True):
+    """Run op eagerly, compare against a numpy reference implementation;
+    with check_static, ALSO record+execute the op in static-graph mode and
+    cross-check (reference op_test.py check_output(..., check_pir=True)
+    toggles IRs the same way)."""
     op = paddle.ops.dispatcher.get_op(op_name)
     tensors = {k: paddle.to_tensor(v) for k, v in inputs.items()}
     out = op(**tensors, **attrs)
@@ -24,6 +28,23 @@ def check_output(op_name, inputs, attrs, numpy_ref, rtol=1e-5, atol=1e-6):
     for o, r in zip(outs, refs):
         np.testing.assert_allclose(o.numpy(), np.asarray(r), rtol=rtol, atol=atol,
                                    err_msg=f"op {op_name} forward mismatch")
+    if check_static:
+        import paddle_tpu.static as static
+        prog = static.Program()
+        try:
+            with static.program_guard(prog):
+                feeds = {k: static.data(k, v.shape, str(v.dtype))
+                         for k, v in inputs.items()}
+                s_out = op(**feeds, **attrs)
+            s_outs = s_out if isinstance(s_out, (list, tuple)) else [s_out]
+            exe = static.Executor()
+            got = exe.run(prog, feed=dict(inputs), fetch_list=list(s_outs))
+        finally:
+            static.disable_static()
+        for g, r in zip(got, refs):
+            np.testing.assert_allclose(
+                g, np.asarray(r), rtol=rtol, atol=atol,
+                err_msg=f"op {op_name} static-mode mismatch vs numpy ref")
     return outs
 
 

@@ -152,3 +152,44 @@ class TestPredictor:
         runner = inference.Predictor.load_compiled(path)
         (got,) = runner([xv])
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+class TestIncubateFunctional:
+    def test_fused_norms_match_layers(self):
+        from paddle_tpu.incubate.nn import functional as FF
+        x = paddle.to_tensor(np.random.rand(2, 6, 16).astype(np.float32))
+        w = paddle.to_tensor(np.random.rand(16).astype(np.float32))
+        b = paddle.to_tensor(np.random.rand(16).astype(np.float32))
+        ln = paddle.nn.LayerNorm(16)
+        ln.weight._set_data(w._data)
+        ln.bias._set_data(b._data)
+        np.testing.assert_allclose(
+            FF.fused_layer_norm(x, w, b).numpy(), ln(x).numpy(), rtol=1e-5,
+            atol=1e-6)
+        rms = paddle.nn.RMSNorm(16) if hasattr(paddle.nn, "RMSNorm") else None
+        out = FF.fused_rms_norm(x, w)
+        ref = (x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True)
+                                   + 1e-6)) * w.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_swiglu_and_bias_act(self):
+        from paddle_tpu.incubate.nn import functional as FF
+        x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+        full = FF.swiglu(x)
+        a, b = np.split(x.numpy(), 2, axis=-1)
+        ref = a / (1 + np.exp(-a)) * b
+        np.testing.assert_allclose(full.numpy(), ref, rtol=1e-4, atol=1e-5)
+        out = FF.fused_bias_act(x, act_method="relu")
+        np.testing.assert_allclose(out.numpy(), np.maximum(x.numpy(), 0))
+
+    def test_fused_rope_and_dropout_add(self):
+        from paddle_tpu.incubate.nn import functional as FF
+        q = paddle.to_tensor(np.random.rand(1, 4, 2, 8).astype(np.float32))
+        cos = paddle.to_tensor(np.ones((4, 8), np.float32))
+        sin = paddle.to_tensor(np.zeros((4, 8), np.float32))
+        qo, ko, vo = FF.fused_rotary_position_embedding(q, q, None,
+                                                        sin=sin, cos=cos)
+        np.testing.assert_allclose(qo.numpy(), q.numpy(), rtol=1e-6)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out = FF.fused_dropout_add(x, x, p=0.0)
+        np.testing.assert_allclose(out.numpy(), 2.0)
