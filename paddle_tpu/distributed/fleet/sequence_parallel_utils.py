@@ -157,7 +157,4 @@ def register_sequence_parallel_allreduce_hooks(model: Layer,
                                                fuse: bool = False) -> None:
     """reference :155-191 installs fused allreduce hooks for SP params; the
     GSPMD gradient transposition already inserts the equivalent collectives,
-    so this is API parity only."""
-    for _, p in model.named_parameters():
-        if getattr(p, "sequence_parallel", False):
-            pass  # grads handled by the partitioner
+    so this is API parity only — no hooks to install."""

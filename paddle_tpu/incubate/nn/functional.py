@@ -19,24 +19,40 @@ __all__ = [
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
-                     begin_norm_axis=-1, **kwargs):
+                     residual_alpha=1.0, begin_norm_axis=1, **kwargs):
+    """Signature order matches the reference fused_layer_norm (..., epsilon,
+    residual_alpha, begin_norm_axis) so positionally-ported calls bind
+    correctly; residual_alpha only matters with the residual input the
+    reference fuses (not modeled here — XLA fuses the add anyway)."""
     return call_op("layer_norm", x, norm_weight, norm_bias, epsilon=epsilon,
                    begin_norm_axis=begin_norm_axis)
 
 
-def fused_rms_norm(x, norm_weight, epsilon=1e-6, **kwargs):
-    return call_op("rms_norm", x, norm_weight, epsilon=epsilon)
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """Reference signature (x, norm_weight, norm_bias, epsilon, ...); rms
+    norm has no centering, so norm_bias (when given) adds after scaling,
+    as in the reference kernel."""
+    out = call_op("rms_norm", x, norm_weight, epsilon=epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None,
                                     use_neox_rotary_style=True):
-    """reference fused_rope: applies rotary embedding to q (and k). v passes
-    through untouched (kept in the signature for parity)."""
+    """reference fused_rope: applies rotary embedding to each of q/k/v that
+    is passed (the reference rotates v too when given)."""
     out = call_op("rope", q, k, cos=cos, sin=sin, position_ids=position_ids,
                   rotate_half_style=use_neox_rotary_style)
     q_out, k_out = out if isinstance(out, (list, tuple)) else (out, None)
-    return q_out, k_out, v
+    v_out = None
+    if v is not None:
+        v_out = call_op("rope", v, None, cos=cos, sin=sin,
+                        position_ids=position_ids,
+                        rotate_half_style=use_neox_rotary_style)
+    return q_out, k_out, v_out
 
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):

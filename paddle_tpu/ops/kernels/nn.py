@@ -689,8 +689,9 @@ def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=Tru
         return t.astype(like.dtype)
 
     if position_ids is not None:
-        cos = jnp.take(cos.reshape(cos.shape[-2], cos.shape[-1]), position_ids, axis=0)
-        sin = jnp.take(sin.reshape(sin.shape[-2], sin.shape[-1]), position_ids, axis=0)
+        # accept [seq, dim] or [1, seq, 1, dim] tables
+        cos = jnp.take(cos.reshape(-1, cos.shape[-1]), position_ids, axis=0)
+        sin = jnp.take(sin.reshape(-1, sin.shape[-1]), position_ids, axis=0)
         cos = relayout(cos)[:, :, None, :].astype(q.dtype)
         sin = relayout(sin)[:, :, None, :].astype(q.dtype)
     else:

@@ -164,8 +164,8 @@ class TestIncubateFunctional:
         ln.weight._set_data(w._data)
         ln.bias._set_data(b._data)
         np.testing.assert_allclose(
-            FF.fused_layer_norm(x, w, b).numpy(), ln(x).numpy(), rtol=1e-5,
-            atol=1e-6)
+            FF.fused_layer_norm(x, w, b, begin_norm_axis=2).numpy(),
+            ln(x).numpy(), rtol=1e-5, atol=1e-6)
         rms = paddle.nn.RMSNorm(16) if hasattr(paddle.nn, "RMSNorm") else None
         out = FF.fused_rms_norm(x, w)
         ref = (x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True)
@@ -187,9 +187,18 @@ class TestIncubateFunctional:
         q = paddle.to_tensor(np.random.rand(1, 4, 2, 8).astype(np.float32))
         cos = paddle.to_tensor(np.ones((4, 8), np.float32))
         sin = paddle.to_tensor(np.zeros((4, 8), np.float32))
-        qo, ko, vo = FF.fused_rotary_position_embedding(q, q, None,
+        qo, ko, vo = FF.fused_rotary_position_embedding(q, q, q,
                                                         sin=sin, cos=cos)
         np.testing.assert_allclose(qo.numpy(), q.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(vo.numpy(), q.numpy(), rtol=1e-6)
+        # positional reference-order call binds correctly
+        w16 = paddle.to_tensor(np.ones(16, np.float32))
+        b16 = paddle.to_tensor(np.zeros(16, np.float32))
+        x3 = paddle.to_tensor(np.random.rand(2, 4, 16).astype(np.float32))
+        out = FF.fused_rms_norm(x3, w16, b16, 1e-6)
+        assert tuple(out.shape) == (2, 4, 16)
+        out2 = FF.fused_layer_norm(x3, w16, b16, 1e-5, 1.0, 2)
+        assert tuple(out2.shape) == (2, 4, 16)
         x = paddle.to_tensor(np.ones((2, 4), np.float32))
         out = FF.fused_dropout_add(x, x, p=0.0)
         np.testing.assert_allclose(out.numpy(), 2.0)
