@@ -30,13 +30,10 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, **kwargs):
-    """Reference signature (x, norm_weight, norm_bias, epsilon, ...); rms
-    norm has no centering, so norm_bias (when given) adds after scaling,
-    as in the reference kernel."""
-    out = call_op("rms_norm", x, norm_weight, epsilon=epsilon)
-    if norm_bias is not None:
-        out = out + norm_bias
-    return out
+    """Reference signature (x, norm_weight, norm_bias, epsilon,
+    begin_norm_axis, ...) — all forwarded to the rms_norm kernel."""
+    return call_op("rms_norm", x, norm_weight, norm_bias, epsilon=epsilon,
+                   begin_norm_axis=begin_norm_axis)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,

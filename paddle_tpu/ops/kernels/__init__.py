@@ -15,3 +15,4 @@ from . import linalg_fft  # noqa: F401
 from . import quant  # noqa: F401
 from . import rnn  # noqa: F401
 from . import serving  # noqa: F401
+from . import math_ext  # noqa: F401

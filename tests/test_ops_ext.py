@@ -1,0 +1,199 @@
+"""Extended op tranche vs numpy goldens (eager + static cross-check via the
+OpTest harness)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output
+
+
+def r(*shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+class TestStatOps:
+    def test_quantile(self):
+        check_output("quantile", {"x": r(5, 8)}, {"q": 0.3, "axis": 1},
+                     lambda x, q, axis: np.quantile(x, q, axis=axis)
+                     .astype(np.float32), rtol=1e-4)
+
+    def test_kthvalue(self):
+        x = r(4, 6, seed=1)
+        v, i = paddle.kthvalue(paddle.to_tensor(x), k=2, axis=1)
+        want = np.sort(x, axis=1)[:, 1]
+        np.testing.assert_allclose(v.numpy(), want, rtol=1e-6)
+        np.testing.assert_array_equal(np.take_along_axis(
+            x, i.numpy()[:, None], axis=1)[:, 0], want)
+
+    def test_mode(self):
+        x = np.array([[1, 2, 2, 3], [5, 5, 5, 1]], np.float32)
+        v, i = paddle.mode(paddle.to_tensor(x))
+        np.testing.assert_array_equal(v.numpy(), [2, 5])
+
+    def test_count_nonzero_and_nan_to_num(self):
+        x = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+        assert int(paddle.count_nonzero(paddle.to_tensor(x))) == 3
+        y = np.array([np.nan, np.inf, -np.inf, 1.0], np.float32)
+        out = paddle.nan_to_num(paddle.to_tensor(y), nan=9.0)
+        assert out.numpy()[0] == 9.0 and np.isfinite(out.numpy()).all()
+
+
+class TestMathOps:
+    def test_logcumsumexp(self):
+        check_output("logcumsumexp", {"x": r(3, 7, seed=2)}, {"axis": 1},
+                     lambda x, axis: np.log(np.cumsum(np.exp(x), axis=axis))
+                     .astype(np.float32), rtol=1e-4)
+
+    def test_diff_vander_heaviside(self):
+        check_output("diff", {"x": r(4, 6, seed=3)}, {},
+                     lambda x, **k: np.diff(x), rtol=1e-6)
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.vander(paddle.to_tensor(x)).numpy(),
+                                   np.vander(x), rtol=1e-5)
+        check_output("heaviside", {"x": np.array([-1.0, 0.0, 2.0], np.float32),
+                                   "y": np.array([0.5, 0.5, 0.5], np.float32)},
+                     {}, lambda x, y: np.heaviside(x, y))
+
+    def test_angle_conversions_and_logit(self):
+        x = np.array([0.0, 90.0, 180.0], np.float32)
+        np.testing.assert_allclose(paddle.deg2rad(paddle.to_tensor(x)).numpy(),
+                                   np.deg2rad(x), rtol=1e-6)
+        p = np.array([0.2, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(paddle.logit(paddle.to_tensor(p)).numpy(),
+                                   np.log(p / (1 - p)), rtol=1e-5)
+
+    def test_bessel(self):
+        import scipy.special as sp
+        x = r(10, seed=4) * 3
+        np.testing.assert_allclose(paddle.i0(paddle.to_tensor(x)).numpy(),
+                                   sp.i0(x).astype(np.float32), rtol=1e-4)
+        np.testing.assert_allclose(paddle.i1e(paddle.to_tensor(x)).numpy(),
+                                   sp.i1e(x).astype(np.float32), rtol=1e-4)
+
+    def test_renorm_caps_rows(self):
+        x = r(4, 8, seed=5) * 10
+        out = paddle.renorm(paddle.to_tensor(x), p=2.0, axis=0, max_norm=1.0)
+        norms = np.linalg.norm(out.numpy(), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+
+
+class TestSearchOps:
+    def test_take_modes(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([0, 5, -1], np.int32)
+        np.testing.assert_array_equal(
+            paddle.take(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(),
+            [0, 5, 11])
+        idx2 = np.array([13, 25], np.int32)
+        np.testing.assert_array_equal(
+            paddle.take(paddle.to_tensor(x), paddle.to_tensor(idx2),
+                        mode="wrap").numpy(), [1, 1])
+
+    def test_bucketize(self):
+        edges = np.array([1.0, 3.0, 5.0], np.float32)
+        x = np.array([0.5, 2.0, 3.0, 6.0], np.float32)
+        out = paddle.bucketize(paddle.to_tensor(x), paddle.to_tensor(edges))
+        np.testing.assert_array_equal(out.numpy(), [0, 1, 1, 3])
+
+    def test_cdist(self):
+        a, b = r(3, 4, seed=6), r(5, 4, seed=7)
+        out = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b))
+        want = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_index_fill_and_masked_scatter(self):
+        x = np.zeros((3, 4), np.float32)
+        out = paddle.index_fill(paddle.to_tensor(x),
+                                paddle.to_tensor(np.array([0, 2], np.int32)),
+                                axis=0, value=7.0)
+        assert (out.numpy()[[0, 2]] == 7).all() and (out.numpy()[1] == 0).all()
+        mask = np.array([[True, False], [False, True]])
+        vals = np.array([9.0, 8.0], np.float32)
+        out = paddle.masked_scatter(
+            paddle.to_tensor(np.zeros((2, 2), np.float32)),
+            paddle.to_tensor(mask), paddle.to_tensor(vals))
+        np.testing.assert_array_equal(out.numpy(), [[9, 0], [0, 8]])
+
+
+class TestManipulationOps:
+    def test_stacks_and_splits(self):
+        a, b = r(3, 2, seed=8), r(3, 2, seed=9)
+        np.testing.assert_allclose(
+            paddle.hstack([paddle.to_tensor(a), paddle.to_tensor(b)]).numpy(),
+            np.hstack([a, b]))
+        np.testing.assert_allclose(
+            paddle.vstack([paddle.to_tensor(a), paddle.to_tensor(b)]).numpy(),
+            np.vstack([a, b]))
+        parts = paddle.tensor_split(paddle.to_tensor(np.arange(7.0)), 3)
+        assert [len(p) for p in parts] == [3, 2, 2]
+
+    def test_rot90_unflatten_expand_as(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_array_equal(
+            paddle.rot90(paddle.to_tensor(x)).numpy(), np.rot90(x))
+        u = paddle.unflatten(paddle.to_tensor(np.arange(12.0)), axis=0,
+                             shape=[3, 4])
+        assert tuple(u.shape) == (3, 4)
+        e = paddle.expand_as(paddle.to_tensor(np.ones((1, 3), np.float32)),
+                             paddle.to_tensor(np.zeros((4, 3), np.float32)))
+        assert tuple(e.shape) == (4, 3)
+
+    def test_block_diag_and_diag_embed(self):
+        a = np.ones((2, 2), np.float32)
+        b = np.full((1, 3), 2.0, np.float32)
+        out = paddle.block_diag([paddle.to_tensor(a), paddle.to_tensor(b)])
+        assert tuple(out.shape) == (3, 5)
+        assert out.numpy()[2, 2:].tolist() == [2, 2, 2]
+        d = paddle.diag_embed(paddle.to_tensor(np.array([1.0, 2.0],
+                                                        np.float32)))
+        np.testing.assert_array_equal(d.numpy(), np.diag([1.0, 2.0]))
+
+    def test_fill_diagonal(self):
+        x = np.zeros((3, 3), np.float32)
+        out = paddle.fill_diagonal(paddle.to_tensor(x), value=5.0)
+        np.testing.assert_array_equal(np.diag(out.numpy()), [5, 5, 5])
+
+    def test_gather_tree(self):
+        # T=3, B=1, beam=2 toy beam search
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+        out = paddle.gather_tree(paddle.to_tensor(ids),
+                                 paddle.to_tensor(parents))
+        assert tuple(out.shape) == (3, 1, 2)
+
+
+class TestReviewRegressions:
+    def test_gather_tree_docs_example(self):
+        ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                        [[0, 1], [9, 0]]], np.int32)
+        parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                            [[0, 0], [0, 1]]], np.int32)
+        out = paddle.gather_tree(paddle.to_tensor(ids),
+                                 paddle.to_tensor(parents))
+        want = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]],
+                         [[0, 1], [9, 0]]], np.int32)
+        np.testing.assert_array_equal(out.numpy(), want)
+
+    def test_fill_diagonal_nonsquare_offset(self):
+        x = np.zeros((3, 10), np.float32)
+        out = paddle.fill_diagonal(paddle.to_tensor(x), value=5.0, offset=2)
+        want = np.zeros((3, 10), np.float32)
+        want[[0, 1, 2], [2, 3, 4]] = 5.0
+        np.testing.assert_array_equal(out.numpy(), want)
+        # wrap on a tall matrix
+        tall = np.zeros((7, 3), np.float32)
+        out = paddle.fill_diagonal(paddle.to_tensor(tall), value=1.0,
+                                   wrap=True)
+        np_ref = np.zeros((7, 3), np.float32)
+        np.fill_diagonal(np_ref, 1.0, wrap=True)
+        np.testing.assert_array_equal(out.numpy(), np_ref)
+
+    def test_fused_rms_norm_begin_axis(self):
+        from paddle_tpu.incubate.nn import functional as FF
+        x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4, 8)
+                             .astype(np.float32))
+        out = FF.fused_rms_norm(x, None, None, 1e-6, 1)
+        xn = x.numpy()
+        ref = xn / np.sqrt((xn ** 2).mean(axis=(1, 2), keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
