@@ -101,3 +101,4 @@ from . import quantization  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 
 __version__ = "0.1.0"
+from .hapi.flops import flops  # noqa: E402,F401

@@ -58,10 +58,11 @@ def mode_kernel(x, axis=-1, keepdim=False):
     moved = jnp.moveaxis(sorted_x, axis, -1)
     flat = moved.reshape(-1, n)
     vals = jax.vmap(per_slice)(flat).reshape(moved.shape[:-1])
-    # index of the first occurrence in the ORIGINAL array
+    # index of the LAST occurrence in the ORIGINAL array (reference mode())
     eq = jnp.moveaxis(x, axis, -1).reshape(-1, n) == vals[..., None].reshape(
         -1, 1)
-    idx = jnp.argmax(eq, axis=-1).reshape(moved.shape[:-1])
+    idx = (n - 1 - jnp.argmax(eq[:, ::-1], axis=-1)).reshape(
+        moved.shape[:-1])
     if keepdim:
         vals = jnp.expand_dims(vals, axis)
         idx = jnp.expand_dims(idx, axis)
@@ -77,8 +78,11 @@ def count_nonzero_kernel(x, axis=None, keepdim=False):
 # -- math ---------------------------------------------------------------------
 
 @register_kernel("logcumsumexp")
-def logcumsumexp_kernel(x, axis=-1):
-    # numerically stable associative scan with logaddexp
+def logcumsumexp_kernel(x, axis=None):
+    # numerically stable associative scan with logaddexp; axis=None scans
+    # the flattened tensor (reference default)
+    if axis is None:
+        return jax.lax.associative_scan(jnp.logaddexp, x.reshape(-1))
     return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis % x.ndim)
 
 
@@ -215,8 +219,11 @@ def take_kernel(x, index, mode="raise"):
         idx = idx % flat.shape[0]
     elif mode == "clip":
         idx = jnp.clip(idx, 0, flat.shape[0] - 1)
-    else:  # jnp gather clamps; negative indices wrap like numpy
+    else:
+        # 'raise': XLA cannot raise on data-dependent indices — one numpy-
+        # style negative wrap, then clamp (out-of-range reads the edge)
         idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
     return flat[idx]
 
 
@@ -244,7 +251,10 @@ def index_fill_kernel(x, index, axis=0, value=0.0):
 
 @register_kernel("masked_scatter")
 def masked_scatter_kernel(x, mask, value):
-    # fill masked slots with consecutive elements of `value` (row-major)
+    # fill masked slots with consecutive elements of `value` (row-major).
+    # The reference errors when value has fewer elements than mask selects;
+    # a data-dependent raise is impossible under XLA, so the last element
+    # repeats instead (documented divergence)
     flat_m = mask.reshape(-1).astype(bool)
     order = jnp.cumsum(flat_m) - 1
     vals = value.reshape(-1)[jnp.clip(order, 0, value.size - 1)]
@@ -279,7 +289,9 @@ def view_as_kernel(x, other):
 @register_kernel("crop")
 def crop_kernel(x, shape=(), offsets=None):
     offs = tuple(offsets) if offsets is not None else (0,) * x.ndim
-    slices = tuple(slice(o, o + s) for o, s in zip(offs, shape))
+    # -1 in shape extends to the end of that dim (reference convention)
+    slices = tuple(slice(o, None if s == -1 else o + s)
+                   for o, s in zip(offs, shape))
     return x[slices]
 
 
@@ -392,6 +404,15 @@ def fill_diagonal_kernel(x, value=0.0, offset=0, wrap=False):
         n = max(min(rows_n + offset, cols_n), 0)
     if n == 0:
         return x
+    if x.ndim > 2:
+        # reference semantics: ndim>2 requires a hypercube and fills the
+        # hyper-diagonal [i, i, ..., i]
+        if len(set(x.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal: tensors with ndim > 2 must have all "
+                f"dimensions equal, got {x.shape}")
+        idx = jnp.arange(x.shape[0])
+        return x.at[tuple([idx] * x.ndim)].set(value)
     rows = jnp.arange(n) + max(-offset, 0)
     cols = jnp.arange(n) + max(offset, 0)
     out = x.at[..., rows, cols].set(value)

@@ -1,0 +1,81 @@
+"""Higher-order functional AD (jacobian/hessian/jvp/vjp/vhp) + paddle.flops."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import hessian, jacobian, jvp, vhp, vjp
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestJacobian:
+    def test_elementwise_square(self):
+        x = t([1.0, 2.0, 3.0])
+        J = jacobian(lambda v: v * v, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2, 4, 6.0]),
+                                   rtol=1e-5)
+
+    def test_matmul_jacobian_forward_mode(self):
+        A = np.random.RandomState(0).rand(3, 2).astype(np.float32)
+        x = t(np.random.RandomState(1).rand(2))
+        J = jacobian(lambda v: paddle.matmul(t(A), v), x, mode="fwd")
+        np.testing.assert_allclose(J.numpy(), A, rtol=1e-5)
+
+    def test_multi_input(self):
+        x, y = t([1.0, 2.0]), t([3.0, 4.0])
+        J = jacobian(lambda a, b: a * b, (x, y))
+        np.testing.assert_allclose(J[0].numpy(), np.diag([3, 4.0]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(J[1].numpy(), np.diag([1, 2.0]),
+                                   rtol=1e-5)
+
+
+class TestHessianAndProducts:
+    def test_hessian_cubic(self):
+        x = t([1.0, 2.0])
+        H = hessian(lambda v: (v ** 3.0).sum(), x)
+        np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]),
+                                   rtol=1e-4)
+
+    def test_hessian_quadratic_form(self):
+        A = np.array([[2.0, 1.0], [1.0, 3.0]], np.float32)
+        x = t([1.0, -1.0])
+        H = hessian(
+            lambda v: 0.5 * paddle.matmul(v.reshape([1, 2]),
+                                          paddle.matmul(t(A),
+                                                        v.reshape([2, 1])))
+            .sum(), x)
+        np.testing.assert_allclose(H.numpy(), A, rtol=1e-4)
+
+    def test_jvp_vjp_consistency(self):
+        x = t([0.5, 1.5, 2.5])
+        v = t([1.0, 0.0, 2.0])
+        _, jv = jvp(lambda a: paddle.exp(a), x, v)
+        np.testing.assert_allclose(jv.numpy(), np.exp(x.numpy()) * v.numpy(),
+                                   rtol=1e-5)
+        _, g = vjp(lambda a: paddle.sum(paddle.exp(a)), x)
+        np.testing.assert_allclose(g.numpy(), np.exp(x.numpy()), rtol=1e-5)
+
+    def test_vhp(self):
+        x = t([1.0, 2.0])
+        v = t([1.0, 1.0])
+        val, hv = vhp(lambda a: (a ** 4.0).sum(), x, v)
+        np.testing.assert_allclose(hv.numpy(), 12 * x.numpy() ** 2,
+                                   rtol=1e-4)
+
+
+class TestFlops:
+    def test_linear_exact(self):
+        n = paddle.nn.Linear(4, 8)
+        assert paddle.flops(n, [2, 4]) == 2 * 2 * 4 * 8
+
+    def test_conv_model_positive_and_mode_restored(self):
+        net = paddle.nn.Sequential(paddle.nn.Conv2D(3, 8, 3, padding=1),
+                                   paddle.nn.ReLU())
+        net.train()
+        f = paddle.flops(net, [1, 3, 16, 16])
+        assert f > 2 * 16 * 16 * 3 * 8 * 9 * 0.9
+        assert net.training  # restored

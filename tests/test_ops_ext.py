@@ -197,3 +197,33 @@ class TestReviewRegressions:
         xn = x.numpy()
         ref = xn / np.sqrt((xn ** 2).mean(axis=(1, 2), keepdims=True) + 1e-6)
         np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_crop_minus_one_and_mode_last_occurrence(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = paddle.crop(paddle.to_tensor(x), shape=[2, -1], offsets=[0, 1])
+        np.testing.assert_array_equal(out.numpy(), x[:2, 1:])
+        v, i = paddle.mode(paddle.to_tensor(
+            np.array([[2, 2, 1], [2, 3, 3]], np.float32)))
+        np.testing.assert_array_equal(v.numpy(), [2, 3])
+        np.testing.assert_array_equal(i.numpy(), [1, 2])  # LAST occurrence
+
+    def test_fill_diagonal_3d_hyperdiagonal(self):
+        x = np.zeros((3, 3, 3), np.float32)
+        out = paddle.fill_diagonal(paddle.to_tensor(x), value=1.0)
+        assert out.numpy().sum() == 3.0
+        assert out.numpy()[1, 1, 1] == 1.0
+        with pytest.raises(ValueError):
+            paddle.fill_diagonal(
+                paddle.to_tensor(np.zeros((2, 3, 3), np.float32)), value=1.0)
+
+    def test_logcumsumexp_flat_default(self):
+        x = np.random.RandomState(1).rand(2, 3).astype(np.float32)
+        out = paddle.logcumsumexp(paddle.to_tensor(x))
+        want = np.log(np.cumsum(np.exp(x.reshape(-1))))
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4)
+
+    def test_take_raise_mode_clamps(self):
+        x = np.arange(6, dtype=np.float32)
+        out = paddle.take(paddle.to_tensor(x),
+                          paddle.to_tensor(np.array([-7, 100], np.int32)))
+        assert np.isfinite(out.numpy()).all()
