@@ -102,3 +102,17 @@ from . import utils  # noqa: E402,F401
 
 __version__ = "0.1.0"
 from .hapi.flops import flops  # noqa: E402,F401
+
+
+def iinfo(dtype):
+    """paddle.iinfo — integer type info (reference pybind iinfo binding)."""
+    import jax.numpy as _jnp
+    from .core import dtype as _dt
+    return _jnp.iinfo(_dt.convert_dtype(dtype))
+
+
+def finfo(dtype):
+    """paddle.finfo — float type info (bfloat16 included)."""
+    import jax.numpy as _jnp
+    from .core import dtype as _dt
+    return _jnp.finfo(_dt.convert_dtype(dtype))

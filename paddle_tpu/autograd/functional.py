@@ -53,12 +53,11 @@ def jacobian(func: Callable, xs, create_graph: bool = False,
     autograd/functional.jacobian layout)."""
     arrays, single = _as_arrays(xs)
     jac_fn = jax.jacrev if mode == "rev" else jax.jacfwd
-    jac = jac_fn(_pure(func, single), argnums=tuple(range(len(arrays))))(
-        *arrays)
-    out = _wrap(jac)
-    if single and isinstance(out, tuple) and len(out) == 1:
-        return out[0]
-    return out
+    # single input: scalar argnums — no per-argnums tuple nesting, so a
+    # multi-output func yields (J1, J2, ...) directly
+    argnums = 0 if single else tuple(range(len(arrays)))
+    jac = jac_fn(_pure(func, single), argnums=argnums)(*arrays)
+    return _wrap(jac)
 
 
 def hessian(func: Callable, xs, create_graph: bool = False,
@@ -123,8 +122,8 @@ def vhp(func: Callable, xs, v=None):
         tangents = [jnp.ones_like(a) for a in arrays]
     else:
         tangents, _ = _as_arrays(v)
-    grad_fn = jax.grad(scalar, argnums=tuple(range(len(arrays))))
-    out = scalar(*arrays)
-    _, hvp = jax.jvp(lambda *a: grad_fn(*a), tuple(arrays), tuple(tangents))
+    # one traced computation: primal value + grads, jvp'd for the HVP
+    vg = jax.value_and_grad(scalar, argnums=tuple(range(len(arrays))))
+    (out, _), (_, hvp) = jax.jvp(vg, tuple(arrays), tuple(tangents))
     hvp_t = _wrap(hvp if not single else hvp[0])
     return Tensor(out), hvp_t

@@ -14,18 +14,28 @@ from ..core.tensor import Tensor
 from ..jit.api import _traced_rng
 
 
-def flops(net, input_size: Sequence[int], inputs=None, custom_ops=None,
-          print_detail: bool = False) -> int:
-    """Total forward FLOPs for `net` on inputs of `input_size`."""
+def flops(net, input_size: Optional[Sequence[int]] = None, inputs=None,
+          custom_ops=None, print_detail: bool = False) -> int:
+    """Total forward FLOPs for `net`, on zeros of `input_size` or on the
+    given `inputs` (list of Tensors/arrays — required for multi-input or
+    integer-dtype models)."""
+    import numpy as np
     was_training = net.training
     net.eval()
     try:
-        def fn(x):
+        def fn(*xs):
             with no_grad(), _traced_rng(jax.random.key(0)):
-                return net(Tensor(x))._data
+                return net(*[Tensor(x) for x in xs])._data
 
-        x = jnp.zeros(tuple(input_size), jnp.float32)
-        compiled = jax.jit(fn).lower(x).compile()
+        if inputs is not None:
+            seq = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            arrays = [a._data if isinstance(a, Tensor)
+                      else jnp.asarray(np.asarray(a)) for a in seq]
+        elif input_size is not None:
+            arrays = [jnp.zeros(tuple(input_size), jnp.float32)]
+        else:
+            raise ValueError("flops: provide input_size or inputs")
+        compiled = jax.jit(fn).lower(*arrays).compile()
         cost = compiled.cost_analysis()
         if isinstance(cost, list):  # older jax returns [dict]
             cost = cost[0]

@@ -396,6 +396,18 @@ def diag_embed_kernel(x, offset=0, dim1=-2, dim2=-1):
 
 @register_kernel("fill_diagonal")
 def fill_diagonal_kernel(x, value=0.0, offset=0, wrap=False):
+    if x.ndim > 2:
+        # reference semantics: ndim>2 requires a hypercube, fills the
+        # hyper-diagonal [i, i, ..., i]; offset/wrap are 2-D-only knobs
+        if offset != 0 or wrap:
+            raise ValueError(
+                "fill_diagonal: offset/wrap are unsupported for ndim > 2")
+        if len(set(x.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal: tensors with ndim > 2 must have all "
+                f"dimensions equal, got {x.shape}")
+        idx = jnp.arange(x.shape[0])
+        return x.at[tuple([idx] * x.ndim)].set(value)
     rows_n, cols_n = x.shape[-2], x.shape[-1]
     # offset-diagonal length for non-square matrices
     if offset >= 0:
@@ -404,15 +416,6 @@ def fill_diagonal_kernel(x, value=0.0, offset=0, wrap=False):
         n = max(min(rows_n + offset, cols_n), 0)
     if n == 0:
         return x
-    if x.ndim > 2:
-        # reference semantics: ndim>2 requires a hypercube and fills the
-        # hyper-diagonal [i, i, ..., i]
-        if len(set(x.shape)) != 1:
-            raise ValueError(
-                "fill_diagonal: tensors with ndim > 2 must have all "
-                f"dimensions equal, got {x.shape}")
-        idx = jnp.arange(x.shape[0])
-        return x.at[tuple([idx] * x.ndim)].set(value)
     rows = jnp.arange(n) + max(-offset, 0)
     cols = jnp.arange(n) + max(offset, 0)
     out = x.at[..., rows, cols].set(value)

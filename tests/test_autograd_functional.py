@@ -79,3 +79,27 @@ class TestFlops:
         f = paddle.flops(net, [1, 3, 16, 16])
         assert f > 2 * 16 * 16 * 3 * 8 * 9 * 0.9
         assert net.training  # restored
+
+
+class TestReviewRegressions:
+    def test_jacobian_multi_output_single_input(self):
+        x = t([1.0, 2.0])
+        J = jacobian(lambda v: (v * v, v + 1.0), x)
+        assert isinstance(J, tuple) and len(J) == 2
+        np.testing.assert_allclose(J[0].numpy(), np.diag([2.0, 4.0]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(J[1].numpy(), np.eye(2), rtol=1e-5)
+
+    def test_flops_inputs_kwarg(self):
+        emb = paddle.nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+        f = paddle.flops(emb, inputs=[ids])
+        assert f >= 0
+        with pytest.raises(ValueError):
+            paddle.flops(emb)
+
+    def test_fill_diagonal_3d_offset_rejected(self):
+        with pytest.raises(ValueError, match="offset"):
+            paddle.fill_diagonal(
+                paddle.to_tensor(np.zeros((3, 3, 3), np.float32)),
+                value=1.0, offset=1)

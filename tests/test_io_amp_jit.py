@@ -322,3 +322,10 @@ class TestJitSaveLoad:
             loaded(x, x)
         with pytest.raises(TypeError):
             loaded()
+
+
+class TestTypeInfo:
+    def test_iinfo_finfo(self):
+        assert paddle.iinfo("int32").max == 2 ** 31 - 1
+        assert paddle.finfo("float32").eps < 1e-6
+        assert paddle.finfo(paddle.bfloat16).max > 1e38
