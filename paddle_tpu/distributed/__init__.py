@@ -16,7 +16,8 @@ from .api import (  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, Group, ParallelEnv, init_parallel_env, get_rank, get_world_size,
     new_group, barrier, all_reduce, all_gather, broadcast, reduce, scatter,
-    all_to_all,
+    all_to_all, reduce_scatter, send, recv, isend, irecv, P2POp,
+    batch_isend_irecv, all_gather_object, scatter_object_list,
 )
 from .placements import Placement, Partial, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh, create_mesh, get_mesh, set_mesh  # noqa: F401

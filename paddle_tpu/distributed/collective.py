@@ -216,3 +216,139 @@ def all_to_all(out_tensor_list: List, in_tensor_list: List, group=None,
 def split(x: Tensor, num_or_sections, axis=0):
     from ..ops.dispatcher import call_op
     return call_op("split", x, num_or_sections=num_or_sections, axis=axis)
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: str = ReduceOp.SUM,
+                   group=None, sync_op: bool = True):
+    """reference communication/reduce_scatter.py. Two input forms:
+
+    * list of per-rank contributions (same shape): elementwise `op`-reduce
+      across the list — a REAL reduction — and the result lands in `tensor`
+      (sharded over the group axis when a topology is active);
+    * a single full tensor (already reduced): resharded so dim 0 is split
+      over the group axis (the scatter half only — eager single-controller
+      arrays cannot carry pending-partial values; compiled code gets the
+      fused reduce-scatter from GSPMD automatically)."""
+    axis = _axis_of(group) or "dp"
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        parts = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                 for t in src]
+        red = {ReduceOp.SUM: jnp.add, ReduceOp.AVG: jnp.add,
+               ReduceOp.MAX: jnp.maximum, ReduceOp.MIN: jnp.minimum,
+               ReduceOp.PROD: jnp.multiply}[op]
+        out = functools.reduce(red, parts)
+        if op == ReduceOp.AVG:
+            out = out / len(parts)
+    else:
+        out = src._data if isinstance(src, Tensor) else jnp.asarray(src)
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        mesh = hcg.mesh.mesh
+        spec = [None] * out.ndim
+        spec[0] = axis
+        out = jax.device_put(out, NamedSharding(mesh, PartitionSpec(*spec)))
+    tensor._set_data(out)
+    return tensor
+
+
+# -- P2P (single-controller semantics) ----------------------------------------
+# Under one controller every "rank" shares the process: send/recv become a
+# tagged in-process queue (exactly how the reference's single-host test
+# harness exercises P2P), and cross-stage transfers inside compiled programs
+# ride ppermute (distributed/pipeline.py). Multi-host eager P2P is out of
+# scope for v1 (documented, PARITY.md §2.5).
+
+_p2p_queues: dict = {}
+_P2P_QUEUE_CAP = 64  # unconsumed sends are a leak — fail loudly, not slowly
+
+
+class P2POp:
+    """reference communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer: int, group=None):
+        self.op = op            # send | recv (function refs accepted)
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+class _Work:
+    def __init__(self):
+        self._done = True
+
+    def is_completed(self):
+        return self._done
+
+    def wait(self):
+        return None
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True):
+    q = _p2p_queues.setdefault((env.get_rank(), dst), [])
+    if len(q) >= _P2P_QUEUE_CAP:
+        raise RuntimeError(
+            f"send: {len(q)} unconsumed messages queued to rank {dst} — "
+            f"each send must be paired with a recv (compiled pipelines "
+            f"should use ppermute, not eager P2P)")
+    q.append(jnp.asarray(tensor._data))
+    return _Work()
+
+
+def isend(tensor: Tensor, dst: int = 0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True):
+    q = _p2p_queues.get((src, env.get_rank()), [])
+    if not q:
+        raise RuntimeError(
+            f"recv: no message queued from rank {src} (single-controller "
+            f"P2P pairs each recv with a prior send)")
+    tensor._set_data(q.pop(0))
+    return _Work()
+
+
+def irecv(tensor: Tensor, src: int = 0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def batch_isend_irecv(p2p_op_list) -> list:
+    """Execute sends first, then receives (reference batched semantics
+    avoid ordering deadlocks the same way)."""
+    sends, recvs = [], []
+    for p in p2p_op_list:
+        name = getattr(p.op, "__name__", str(p.op))
+        if name in ("send", "isend"):
+            sends.append(p)
+        elif name in ("recv", "irecv"):
+            recvs.append(p)
+        else:
+            raise ValueError(f"batch_isend_irecv: unrecognized op {p.op!r}")
+    works = [send(p.tensor, p.peer, p.group) for p in sends]
+    works += [recv(p.tensor, p.peer, p.group) for p in recvs]
+    return works
+
+
+# -- object collectives (host-side pickle, reference *_object APIs) -----------
+
+def all_gather_object(object_list: List, obj, group=None):
+    """Single-controller: every rank holds the same process — the gathered
+    list is world_size copies (multi-host object gather is a TCPStore
+    exchange in the launcher layer)."""
+    object_list.extend([obj] * env.get_world_size())
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None, src=0,
+                        group=None):
+    rank = env.get_rank()
+    if in_object_list is None:
+        raise NotImplementedError(
+            "scatter_object_list: non-src ranks passing None require a "
+            "cross-process object channel; under the single-controller "
+            "runtime every rank supplies in_object_list")
+    if rank >= len(in_object_list):
+        raise ValueError(
+            f"scatter_object_list: rank {rank} but only "
+            f"{len(in_object_list)} objects supplied")
+    out_object_list.append(in_object_list[rank])

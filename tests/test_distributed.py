@@ -245,3 +245,68 @@ class TestSequenceParallel:
         sp.mark_as_sequence_parallel_parameter(lin.weight)
         assert lin.weight.sequence_parallel
         sp.register_sequence_parallel_allreduce_hooks(lin)
+
+
+class TestCollectiveExtras:
+    def test_reduce_scatter(self, hcg):
+        from jax.sharding import PartitionSpec
+        full = paddle.to_tensor(f32(8, 4))
+        out = paddle.to_tensor(f32(8, 4))
+        dist.collective.reduce_scatter(out, full,
+                                       group=dist.collective.Group("dp", 2))
+        assert out._data.sharding.spec == PartitionSpec("dp", None)
+        np.testing.assert_allclose(out.numpy(), full.numpy())
+
+    def test_p2p_send_recv_roundtrip(self):
+        from paddle_tpu.distributed import collective as C
+        t = paddle.to_tensor(f32(3, 3))
+        C.send(t, dst=0)
+        out = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        C.recv(out, src=0)
+        np.testing.assert_allclose(out.numpy(), t.numpy())
+        with pytest.raises(RuntimeError, match="no message"):
+            C.recv(out, src=5)
+
+    def test_batch_isend_irecv(self):
+        from paddle_tpu.distributed import collective as C
+        a = paddle.to_tensor(f32(2, 2))
+        b = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        works = C.batch_isend_irecv([
+            C.P2POp(C.isend, a, 0), C.P2POp(C.irecv, b, 0)])
+        assert all(w.is_completed() for w in works)
+        np.testing.assert_allclose(b.numpy(), a.numpy())
+
+    def test_object_collectives(self):
+        from paddle_tpu.distributed import collective as C
+        objs = []
+        C.all_gather_object(objs, {"k": 1})
+        assert len(objs) == C.ParallelEnv().world_size
+        out = []
+        C.scatter_object_list(out, [["a"], ["b"]])
+        assert out
+
+    def test_reduce_scatter_list_reduces(self, hcg):
+        from paddle_tpu.distributed import collective as C
+        a = np.ones((4, 2), np.float32)
+        b = np.full((4, 2), 2.0, np.float32)
+        out = paddle.to_tensor(np.zeros((4, 2), np.float32))
+        C.reduce_scatter(out, [paddle.to_tensor(a), paddle.to_tensor(b)])
+        np.testing.assert_allclose(out.numpy(), a + b)
+        C.reduce_scatter(out, [paddle.to_tensor(a), paddle.to_tensor(b)],
+                         op=C.ReduceOp.MAX)
+        np.testing.assert_allclose(out.numpy(), np.maximum(a, b))
+
+    def test_p2p_queue_cap(self):
+        from paddle_tpu.distributed import collective as C
+        t = paddle.to_tensor(np.zeros((1,), np.float32))
+        key = (0, 99)
+        C._p2p_queues.pop(key, None)
+        with pytest.raises(RuntimeError, match="unconsumed"):
+            for _ in range(C._P2P_QUEUE_CAP + 1):
+                C.send(t, dst=99)
+        C._p2p_queues.pop(key, None)
+
+    def test_scatter_object_list_errors(self):
+        from paddle_tpu.distributed import collective as C
+        with pytest.raises(NotImplementedError):
+            C.scatter_object_list([], None)
