@@ -48,6 +48,10 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     """reference distributed/spawn.py — single-controller runtime drives all
     local devices in-process, so spawn degenerates to a direct call."""
     return func(*args)
+from . import sharding  # noqa: E402,F401
+from .sharding import (  # noqa: E402,F401
+    DygraphShardingOptimizer, group_sharded_parallel, save_group_sharded_model,
+    shard_optimizer_states)
 from . import watchdog  # noqa: E402,F401
 from .watchdog import comm_watchdog  # noqa: E402,F401
 from . import spmd_rules  # noqa: E402,F401

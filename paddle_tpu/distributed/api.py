@@ -121,13 +121,25 @@ def shard_parameter(param: Tensor, mesh: ProcessMesh,
 
 
 def shard_optimizer(optimizer, shard_fn=None):
-    """Reference api.py:736. Optimizer states are created with
-    jnp.zeros_like(param) inside the fused update, so they inherit each
-    param's sharding automatically — ZeRO-style state sharding is the
-    `shard_fn` resharding params before first step."""
+    """Reference api.py:736. States of params that are already sharded
+    inherit the param sharding automatically. Beyond that:
+
+    - shard_fn given: applied to each param (caller-controlled resharding,
+      reference's custom shard_fn path).
+    - shard_fn None (default): if a hybrid group with sharding_degree > 1 is
+      active, optimizer state (masters + moments) is sharded over the
+      "sharding" mesh axis — real ZeRO stage 1 (reference
+      dygraph_sharding_optimizer.py:48); otherwise a no-op.
+    """
     if shard_fn is not None:
         for p in optimizer._parameter_list:
             shard_fn(p)
+        return optimizer
+    from .topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.axis_degree("sharding") > 1:
+        from .sharding import shard_optimizer_states
+        shard_optimizer_states(optimizer, hcg.mesh.mesh, "sharding")
     return optimizer
 
 

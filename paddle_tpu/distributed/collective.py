@@ -64,9 +64,42 @@ class ParallelEnv:
 
 
 def init_parallel_env() -> ParallelEnv:
-    """reference parallel.py:943 — rendezvous + proc group bootstrap. The
-    single-controller runtime owns all local devices; multi-host bootstrap is
-    jax.distributed.initialize (launcher wires it)."""
+    """reference parallel.py:943 — rendezvous + process-group bootstrap over
+    TCPStore (tcp_store.h:121).
+
+    Multi-host: when the launcher (distributed/launch) exported a world size
+    > 1, this calls ``jax.distributed.initialize(coordinator, n, rank)`` with
+    the envs the launcher set (PADDLE_DIST_COORDINATOR / PADDLE_TRAINERS_NUM
+    / PADDLE_TRAINER_ID), connecting this process to the XLA coordination
+    service — after which ``jax.devices()`` spans every host and GSPMD
+    collectives ride ICI/DCN across them. Must run before the first device
+    use (same ordering contract as the reference's init_parallel_env).
+
+    Single-process launches (world size 1) skip initialization — the single
+    controller already owns all local devices.
+    """
+    import os
+
+    world = env.get_world_size()
+    if world > 1 and not jax.distributed.is_initialized():
+        coordinator = os.environ.get("PADDLE_DIST_COORDINATOR") \
+            or os.environ.get("PADDLE_MASTER")
+        if not coordinator:
+            # a silent skip here would leave jax host-local while the app
+            # believes world_size=N — collectives would compute wrong
+            # (local-only) results and P2P would deadlock the peer host
+            raise RuntimeError(
+                f"init_parallel_env: world size {world} but no coordinator "
+                "address (PADDLE_DIST_COORDINATOR / PADDLE_MASTER). Launch "
+                "through `python -m paddle_tpu.distributed.launch` or export "
+                "the coordinator env.")
+        try:  # CPU backend needs a cross-process collectives impl
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # config knob absent/renamed: TPU path doesn't need it
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=world,
+                                   process_id=env.get_rank())
     return ParallelEnv()
 
 
@@ -284,7 +317,19 @@ class _Work:
         return None
 
 
+def _reject_cross_host_p2p():
+    """The queue lives in THIS process: in a real multi-host launch
+    (jax.distributed initialized) eager send/recv cannot reach the peer —
+    refuse loudly instead of silently deadlocking the other host."""
+    if jax.distributed.is_initialized() and env.get_world_size() > 1:
+        raise RuntimeError(
+            "eager send/recv is in-process only and cannot cross hosts; "
+            "use sharded collectives (all_to_all/ppermute via "
+            "distributed.pipeline) for cross-host transfers")
+
+
 def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True):
+    _reject_cross_host_p2p()
     q = _p2p_queues.setdefault((env.get_rank(), dst), [])
     if len(q) >= _P2P_QUEUE_CAP:
         raise RuntimeError(
@@ -300,6 +345,7 @@ def isend(tensor: Tensor, dst: int = 0, group=None):
 
 
 def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True):
+    _reject_cross_host_p2p()
     q = _p2p_queues.get((src, env.get_rank()), [])
     if not q:
         raise RuntimeError(

@@ -40,6 +40,9 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {}
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        # ZeRO stage when sharding_degree > 1: 1/2 = optimizer-state sharding
+        # (params replicated), 3 = param sharding with gather-on-use
+        self.sharding_configs = {"stage": 1}
 
     def __repr__(self):
         return f"DistributedStrategy(hybrid={self.hybrid_configs})"
@@ -55,6 +58,10 @@ def init(role_maker=None, is_collective: bool = False,
     global _fleet_strategy
     strategy = strategy or DistributedStrategy()
     _fleet_strategy = strategy
+    # multi-host bootstrap first (jax.distributed.initialize from launcher
+    # envs) so the mesh below spans every host's devices
+    from ..collective import init_parallel_env
+    init_parallel_env()
     hc = strategy.hybrid_configs
     degrees = {
         "data": int(hc.get("dp_degree", 1)),
@@ -92,6 +99,15 @@ class _ReplicatedModelWrapper(Layer):
         self._layers = layers
         self._hcg = hcg
         mesh = hcg.mesh.mesh
+        # ZeRO stage 3 (group_sharded_stage3.py:85): params go STRAIGHT to
+        # their sharded placement — replicating first would materialize a
+        # full copy per device, the exact memory cliff stage 3 exists to
+        # avoid. Remaining params (no divisible dim / stage<3) replicate.
+        strat = get_strategy()
+        if (hcg.axis_degree("sharding") > 1 and strat is not None
+                and int(strat.sharding_configs.get("stage", 1)) >= 3):
+            from ..sharding import shard_model_params
+            shard_model_params(layers, mesh, "sharding")
         for p in layers.parameters():
             sharding = getattr(p._data, "sharding", None)
             if not isinstance(sharding, NamedSharding) or sharding.mesh != mesh:
@@ -150,7 +166,23 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     grads arrive already-reduced and optimizer states inherit param
     shardings, so the hybrid wrapper's TP-allreduce/sharding-scatter logic
     (HybridParallelOptimizer:254) is vacuous; global-norm clip already spans
-    the mesh via psum. ZeRO state sharding: see shard_optimizer."""
+    the mesh via psum.
+
+    ZeRO: with sharding_degree>1 and stage 1/2, configures REAL optimizer
+    state sharding over the "sharding" mesh axis (reference
+    DygraphShardingOptimizer, dygraph_sharding_optimizer.py:48) — masters
+    and moments live 1/N per device; the fused update computes shard-locally
+    and all-gathers new params. Stage 3's state inherits the param sharding
+    set up by distributed_model, nothing to do here."""
+    strategy = strategy or _fleet_strategy
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.axis_degree("sharding") > 1:
+        stage = 1
+        if strategy is not None:
+            stage = int(strategy.sharding_configs.get("stage", 1))
+        if stage < 3:
+            from ..sharding import shard_optimizer_states
+            shard_optimizer_states(optimizer, hcg.mesh.mesh, "sharding")
     return optimizer
 
 from .elastic import ElasticManager, ElasticStatus  # noqa: E402,F401

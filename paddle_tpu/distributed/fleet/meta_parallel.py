@@ -55,8 +55,11 @@ class TensorParallel(MetaParallelBase):
 
 
 class ShardingParallel(MetaParallelBase):
-    """reference meta_parallel/sharding_parallel.py — see
-    distributed.api.shard_optimizer for the state sharding itself."""
+    """reference meta_parallel/sharding_parallel.py. Real ZeRO state/param
+    sharding lives in distributed/sharding.py: fleet.distributed_optimizer
+    shards masters+moments over the `sharding` axis (stage 1/2,
+    dygraph_sharding_optimizer.py:48) and distributed_model shards params
+    for stage 3 (group_sharded_stage3.py:85); this wrapper only forwards."""
 
 
 class SegmentParallel(MetaParallelBase):

@@ -242,8 +242,10 @@ class TrainStep:
                 m = None
                 if opt._multi_precision and p._data.dtype in (jnp.bfloat16,
                                                               jnp.float16):
-                    m = p._data.astype(jnp.float32)
-                s = opt._init_state(m if m is not None else p._data)
+                    m = opt._place_state(p, p._data.astype(jnp.float32))
+                s = jax.tree.map(lambda a: opt._place_state(p, a),
+                                 opt._init_state(m if m is not None
+                                                 else p._data))
             states.append(s)
             masters.append(m)
         opt._states, opt._masters = states, masters
@@ -288,6 +290,23 @@ class TrainStep:
 
             self._accum_fn = jax.jit(accum_step, donate_argnums=(0,))
 
+        # Pin update outputs to the call-time input shardings so ZeRO-sharded
+        # state stays sharded and params stay replicated across steps (XLA
+        # computes the update shard-locally and all-gathers new params —
+        # under this whole-step jit it may also reduce-scatter grads, the
+        # stage-2 semantics).
+        from ..distributed.sharding import pin as _pin_sh, sharding_of as _sh
+
+        param_sh = tuple(_sh(p._data) for p in params)
+        master_sh = tuple(_sh(m) for m in masters)
+        state_sh = tuple({k: _sh(v) for k, v in s.items()} for s in states)
+        pin_active = any(param_sh) or any(master_sh) \
+            or any(any(d.values()) for d in state_sh)
+        self._built_sharding_version = getattr(opt, "_sharding_version", 0)
+
+        def _pin(x, sh):
+            return _pin_sh(x, sh if pin_active else None)
+
         def step(accum, param_arrays, master_arrays, opt_states, buffer_arrays,
                  frozen_arrays, rng, inputs, labels, lr, stepno):
             (loss, new_buf), grads = grad_fn(param_arrays, frozen_arrays,
@@ -297,17 +316,20 @@ class TrainStep:
             if grad_clip is not None:
                 grads = clip_mod.pure_clip(grad_clip, grads)
             new_params, new_masters, new_states = [], [], []
-            for p, m, s, g, w in zip(param_arrays, master_arrays, opt_states,
-                                     grads, wd):
+            for p, m, s, g, w, psh, msh, ssh in zip(
+                    param_arrays, master_arrays, opt_states, grads, wd,
+                    param_sh, master_sh, state_sh):
                 target = m if m is not None else p
                 g = g.astype(target.dtype)
                 np_, ns_ = opt._update(target, g, s, lr, stepno, w)
+                ns_ = {k: _pin(v, ssh.get(k)) for k, v in ns_.items()}
                 if m is not None:
+                    np_ = _pin(np_, msh)
                     new_masters.append(np_)
-                    new_params.append(np_.astype(p.dtype))
+                    new_params.append(_pin(np_.astype(p.dtype), psh))
                 else:
                     new_masters.append(None)
-                    new_params.append(np_)
+                    new_params.append(_pin(np_, psh))
                 new_states.append(ns_)
             return (tuple(new_params), tuple(new_masters), tuple(new_states),
                     new_buf, loss)
@@ -316,9 +338,13 @@ class TrainStep:
         self._params, self._buffers, self._frozen = params, buffers, frozen
 
     def __call__(self, inputs, labels):
+        opt = self.optimizer
+        if self._compiled is not None and \
+                getattr(opt, "_sharding_version", 0) \
+                != getattr(self, "_built_sharding_version", 0):
+            self._compiled = None   # sharding reconfigured: stale pins
         if self._compiled is None:
             self._build()
-        opt = self.optimizer
         params, buffers = self._params, self._buffers
         to_arr = lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t)
         inputs = jax.tree.map(to_arr, inputs,

@@ -39,6 +39,20 @@ class Optimizer:
         self._states: List[Optional[Dict]] = [None] * len(self._parameter_list)
         self._masters: List[Optional[jax.Array]] = [None] * len(self._parameter_list)
         self._step_count = 0
+        # ZeRO stage-1 state sharding (distributed.sharding): id(param) ->
+        # NamedSharding for that param's master + moments. Empty = off.
+        self._state_shardings: Dict[int, object] = {}
+        self._sharding_version = 0
+
+    def _state_sharding_of(self, param) -> Optional[object]:
+        return self._state_shardings.get(id(param))
+
+    def _place_state(self, param, arr):
+        """Put a freshly created master/moment on its ZeRO shard placement."""
+        ns = self._state_sharding_of(param)
+        if ns is not None and arr.shape == param._data.shape:
+            return jax.device_put(arr, ns)
+        return arr
 
     def _param_weight_decay(self, i: int) -> float:
         """Per-param decay coeff honoring apply_decay_param_fun (reference
@@ -93,16 +107,17 @@ class Optimizer:
         self._step_count += 1
         lr = self.get_lr()
 
-        # lazily create state + fp32 masters
+        # lazily create state + fp32 masters (ZeRO-sharded when configured)
         for k, i in enumerate(idxs):
             p = self._parameter_list[i]
             if self._states[i] is None:
                 master = None
                 if self._multi_precision and p._data.dtype in (jnp.bfloat16, jnp.float16):
-                    master = p._data.astype(jnp.float32)
+                    master = self._place_state(p, p._data.astype(jnp.float32))
                 self._masters[i] = master
-                self._states[i] = self._init_state(
-                    master if master is not None else p._data)
+                self._states[i] = jax.tree.map(
+                    lambda a: self._place_state(p, a),
+                    self._init_state(master if master is not None else p._data))
 
         p_arrays = []
         for k, i in enumerate(idxs):
@@ -113,6 +128,12 @@ class Optimizer:
         wd_arrays = tuple(jnp.asarray(self._param_weight_decay(i), jnp.float32)
                           for i in idxs)
 
+        # pre-step placements (any sharding type) so stage-1 updates can
+        # restore params to exactly where they were
+        param_shardings = tuple(
+            getattr(self._parameter_list[i]._data, "sharding", None)
+            for i in idxs)
+
         new_p, new_s = _apply_pytree_update(
             self, self._update_static_key(),
             tuple(p_arrays), g_arrays, s_pytree,
@@ -122,9 +143,16 @@ class Optimizer:
             p = self._parameter_list[i]
             if self._masters[i] is not None:
                 self._masters[i] = new_p[k]
-                p._set_data(new_p[k].astype(p._data.dtype))
+                arr = new_p[k].astype(p._data.dtype)
             else:
-                p._set_data(new_p[k])
+                arr = new_p[k]
+            if self._state_shardings:
+                # ZeRO stage 1: the update ran on state shards; gather the
+                # param back to its pre-step (replicated) placement
+                orig = param_shardings[k]
+                if orig is not None and getattr(arr, "sharding", None) != orig:
+                    arr = jax.device_put(arr, orig)
+            p._set_data(arr)
             self._states[i] = new_s[k]
 
     def _update_static_key(self):
@@ -181,19 +209,36 @@ def _apply_pytree_update(opt, static_key, p_tuple, g_tuple, s_tuple, lr, step,
     instance's hyperparameters, so sharing across instances would silently
     reuse stale constants, and a strong ref would pin dead optimizers."""
     import weakref
+    from ..distributed.sharding import pin as _pin, sharding_of as _sh
     for k in [k for k, (ref, _) in _JIT_CACHE.items() if ref() is None]:
         del _JIT_CACHE[k]  # drop rules for collected optimizers
-    cache_key = (id(opt), static_key)
+    cache_key = (id(opt), static_key, opt._sharding_version)
     ent = _JIT_CACHE.get(cache_key)
     if ent is None or ent[0]() is not opt:
         ref = weakref.ref(opt)
+
+        # Output shardings are pinned to the CALL-TIME input shardings:
+        # sharded state stays sharded across steps (the ZeRO fixed point)
+        # instead of XLA deciding per-compile. A config change bumps
+        # _sharding_version, invalidating this entry.
+        if opt._state_shardings:
+            p_sh = tuple(_sh(a) for a in p_tuple)
+            s_sh = tuple({k2: _sh(v) for k2, v in s.items()} for s in s_tuple)
+        else:
+            p_sh = s_sh = None
 
         def run(p_tuple, g_tuple, s_tuple, lr, step, wd_tuple):
             o = ref()
             outs = [o._update(p, g.astype(p.dtype) if g.dtype != p.dtype else g,
                               s, lr, step, wd)
                     for p, g, s, wd in zip(p_tuple, g_tuple, s_tuple, wd_tuple)]
-            return tuple(x[0] for x in outs), tuple(x[1] for x in outs)
+            new_p = tuple(x[0] for x in outs)
+            new_s = tuple(x[1] for x in outs)
+            if p_sh is not None:
+                new_p = tuple(_pin(x, sh) for x, sh in zip(new_p, p_sh))
+                new_s = tuple({k2: _pin(v, sh.get(k2)) for k2, v in st.items()}
+                              for st, sh in zip(new_s, s_sh))
+            return new_p, new_s
 
         fn = jax.jit(run, donate_argnums=(0, 2))
         _JIT_CACHE[cache_key] = (ref, fn)
