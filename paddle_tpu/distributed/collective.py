@@ -85,6 +85,16 @@ def init_parallel_env() -> ParallelEnv:
         coordinator = os.environ.get("PADDLE_DIST_COORDINATOR") \
             or os.environ.get("PADDLE_MASTER")
         if not coordinator:
+            if "PADDLE_TRAINERS_NUM" not in os.environ:
+                # world size came from a generic WORLD_SIZE leftover (other
+                # launchers export it); without our launcher's envs this is
+                # not a paddle multi-host launch — stay single-process
+                import warnings
+                warnings.warn(
+                    f"init_parallel_env: WORLD_SIZE={world} is set but no "
+                    "coordinator address and no PADDLE_TRAINERS_NUM; "
+                    "ignoring it and initializing single-process.")
+                return ParallelEnv()
             # a silent skip here would leave jax host-local while the app
             # believes world_size=N — collectives would compute wrong
             # (local-only) results and P2P would deadlock the peer host

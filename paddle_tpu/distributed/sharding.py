@@ -212,6 +212,18 @@ def group_sharded_parallel(model: Layer, optimizer, level: str,
         hcg = get_hybrid_communicate_group()
         if hcg is not None:
             mesh = hcg.mesh.mesh
+            if hcg.get_sharding_parallel_world_size() <= 1:
+                # reference group=None semantics: shard over the world/dp
+                # group. A dp-only fleet (sharding_degree 1) must not be a
+                # silent no-op — ride the dp axis; error if nothing to ride.
+                if hcg.get_data_parallel_world_size() > 1:
+                    axis = "dp"
+                else:
+                    raise ValueError(
+                        "group_sharded_parallel: hybrid topology has "
+                        "sharding_degree 1 and dp_degree 1 — no axis to "
+                        "shard over; set sharding_degree in hybrid_configs "
+                        "or pass an explicit mesh via `group`")
         else:
             import numpy as _np
             # classic Mesh (Auto axis types): GSPMD resolves param-vs-batch

@@ -149,6 +149,15 @@ _hcg: Optional[HybridCommunicateGroup] = None
 def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
     global _hcg
     _hcg = hcg
+    # drop compiled shard_map closures keyed on the previous mesh so retired
+    # meshes (notebook / test / elastic re-inits) don't pin device references
+    try:
+        from ..ops.kernels.moe import _EP_CACHE
+        from ..ops.kernels.pallas.ring_attention import _RING_CACHE
+        _EP_CACHE.clear()
+        _RING_CACHE.clear()
+    except ImportError:
+        pass
 
 
 def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:

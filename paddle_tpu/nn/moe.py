@@ -6,17 +6,22 @@ CUDA `global_scatter`/`global_gather` collective ops,
 `paddle/fluid/operators/collective/global_scatter_op*`), plus gate impls
 under `.../moe/gate/`.
 
-TPU-first redesign (GShard/Switch style): routing is dense algebra —
-  - gate: softmax(x @ wg) in f32, top-k choice, capacity-bounded positions
-    via cumsum (tokens over capacity are dropped, standard GShard policy);
-  - dispatch:  [t, E*C] one-hot matmul gathers tokens into [E, C, h];
-  - experts:   stacked weights [E, h, m] -> one batched matmul (grouped
-    GEMM on the MXU), not a Python loop over experts;
-  - combine:   the transposed one-hot matmul, weighted by gate probs.
-The expert axis E is sharded over a mesh axis (default `dp`, matching the
-reference's MoE-group == data-group convention); with tokens batch-sharded
-on the same axis, XLA's partitioner derives the all-to-all exchanges that
-the reference implements manually with global_scatter/global_gather.
+TPU-first redesign (SURVEY §2.5 EP row: expert mesh axis + ragged
+all_to_all + Pallas grouped-GEMM):
+  - gate: softmax(x @ wg) in f32, top-k choice, capacity-bounded slot
+    positions via cumsum (tokens over capacity are dropped, GShard policy);
+  - dispatch: *index-based gather* into the [E, C, h] capacity buffer —
+    O(E*C*h) bytes moved, zero matmul FLOPs (the round-1 dense one-hot
+    dispatch was t*E*C*h MXU FLOPs, quadratic in tokens);
+  - experts: grouped-GEMM Pallas kernel over stacked weights [E, h, m]
+    that skips capacity tiles beyond the live token count;
+  - combine: weighted scatter-add back to token order;
+  - EP: experts sharded over `expert_axis`; the capacity buffer moves with
+    one tiled `lax.all_to_all` per direction inside shard_map (the
+    global_scatter/global_gather analog), counts riding along so peers
+    skip padding in compute.
+The compute core is the `moe_ffn` op (ops/kernels/moe.py), so autograd,
+AMP and static capture all flow through the normal dispatcher machinery.
 """
 
 from __future__ import annotations
@@ -37,6 +42,9 @@ class TopKGate(Layer):
     """Top-k softmax router with capacity (reference moe/gate/topk_gate).
 
     Returns (combine [t, E, C], dispatch-bool [t, E, C], aux_loss scalar).
+    Kept for API parity; `MoELayer` routes through the fused `moe_ffn` op
+    (index-based — see kernels/moe.py:route_topk) rather than these dense
+    one-hot tensors.
     """
 
     def __init__(self, hidden_size: int, num_experts: int, top_k: int = 2,
@@ -50,9 +58,9 @@ class TopKGate(Layer):
             default_initializer=I.XavierUniform())
 
     def capacity(self, num_tokens: int) -> int:
-        c = int(self.capacity_factor * num_tokens * self.top_k
-                / self.num_experts)
-        return max(c, self.top_k, 4)
+        from ..ops.kernels.moe import moe_capacity
+        return moe_capacity(num_tokens, self.top_k, self.num_experts,
+                            self.capacity_factor)
 
     def forward(self, x):
         """x: [t, h] -> (combine [t,E,C], dispatch [t,E,C], aux_loss)."""
@@ -95,7 +103,8 @@ class TopKGate(Layer):
 
 
 class ExpertFFN(Layer):
-    """Stacked SwiGLU expert weights: one grouped GEMM over [E, C, h]."""
+    """Stacked SwiGLU expert weights [E, h, m] driven by the grouped-GEMM
+    kernel (one ragged GEMM per projection, not a Python loop)."""
 
     def __init__(self, num_experts: int, hidden_size: int,
                  intermediate_size: int):
@@ -109,15 +118,16 @@ class ExpertFFN(Layer):
         self.down_weight = self.create_parameter((E, m, h),
                                                  default_initializer=init)
 
-    def forward(self, x):
-        """x: [E, C, h] -> [E, C, h] (batched over experts)."""
-        g = call_op("matmul", x, self.gate_weight)       # [E, C, m]
-        u = call_op("matmul", x, self.up_weight)
-        return call_op("matmul", call_op("swiglu", g, u), self.down_weight)
+    def forward(self, x, counts=None):
+        """x: [E, C, h] -> [E, C, h] (ragged-batched over experts)."""
+        g = call_op("grouped_gemm", x, self.gate_weight, counts)
+        u = call_op("grouped_gemm", x, self.up_weight, counts)
+        return call_op("grouped_gemm", call_op("swiglu", g, u),
+                       self.down_weight, counts)
 
 
 class MoELayer(Layer):
-    """Dense-dispatch MoE block (reference MoELayer moe_layer.py:99).
+    """Routed-experts MoE block (reference MoELayer moe_layer.py:99).
 
     forward(x [b, s, h]) -> [b, s, h]; the load-balance aux loss is
     accumulated on self.aux_loss (read+reset by the model's criterion).
@@ -152,20 +162,13 @@ class MoELayer(Layer):
 
     def forward(self, x):
         b, s, h = x.shape
-        t = b * s
-        flat = x.reshape([t, h])
-        combine, dispatch, aux = self.gate(flat)          # [t, E, C]
+        flat = x.reshape([b * s, h])
+        out, aux = call_op(
+            "moe_ffn", flat, self.gate.weight,
+            self.experts.gate_weight, self.experts.up_weight,
+            self.experts.down_weight,
+            top_k=self.gate.top_k,
+            capacity_factor=self.gate.capacity_factor,
+            expert_axis=self.expert_axis)
         self.aux_loss = aux
-        E = self.gate.num_experts
-        C = combine.shape[-1]
-        # dispatch: [E*C, t] @ [t, h] — the all-to-all falls out of the
-        # (batch-sharded tokens) x (expert-sharded result) contraction
-        d2 = dispatch.reshape([t, E * C]).transpose([1, 0])
-        expert_in = call_op("matmul", d2, flat.astype(d2.dtype))
-        expert_in = expert_in.reshape([E, C, h]).astype(x.dtype)
-        expert_out = self.experts(expert_in)              # [E, C, h]
-        # combine: [t, E*C] @ [E*C, h], gate-weighted
-        c2 = combine.reshape([t, E * C])
-        out = call_op("matmul", c2, expert_out.reshape([E * C, h])
-                      .astype(c2.dtype))
         return out.astype(x.dtype).reshape([b, s, h])

@@ -16,3 +16,4 @@ from . import quant  # noqa: F401
 from . import rnn  # noqa: F401
 from . import serving  # noqa: F401
 from . import math_ext  # noqa: F401
+from . import moe  # noqa: F401

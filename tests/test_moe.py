@@ -1,0 +1,245 @@
+"""MoE routing / grouped-GEMM / expert-parallel tests.
+
+Model: the reference's MoE tests exercise MoELayer scatter/gather parity and
+gate behavior (test/collective/fleet moe suites); here the index-based
+dispatch is checked against a brute-force per-token evaluation, the Pallas
+grouped GEMM against dense masked matmul (fwd+grads), EP shard_map output
+against the single-shard path, and the FLOP asymptotics of dispatch
+(linear in tokens, the round-2 ragged-dispatch requirement)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatcher import call_op
+from paddle_tpu.ops.kernels.moe import moe_capacity, route_topk, _moe_local
+from paddle_tpu.ops.kernels.pallas.grouped_gemm import (gmm_reference,
+                                                        grouped_matmul)
+
+
+def rng(*shape, seed=0, scale=0.1):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(
+        np.float32)
+
+
+class TestGroupedGemm:
+    def test_pallas_matches_reference(self):
+        x = jnp.asarray(rng(8, 12, 20))
+        w = jnp.asarray(rng(4, 20, 36, seed=1))
+        counts = jnp.array([0, 3, 12, 7, 1, 12, 0, 5], jnp.int32)
+        y_p = grouped_matmul(x, w, counts, 2, use_pallas=True)
+        y_r = gmm_reference(x, w, counts, 2)
+        np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_r),
+                                   atol=1e-5)
+        # rows past counts are exactly zero
+        assert float(jnp.abs(y_p[0]).max()) == 0.0
+        assert float(jnp.abs(y_p[1][3:]).max()) == 0.0
+
+    def test_gradients_match(self):
+        x = jnp.asarray(rng(4, 8, 16))
+        w = jnp.asarray(rng(4, 16, 24, seed=1))
+        counts = jnp.array([2, 8, 0, 5], jnp.int32)
+
+        def loss(use_pallas):
+            return jax.grad(
+                lambda x, w: (grouped_matmul(x, w, counts, 1,
+                                             use_pallas) ** 2).sum(),
+                argnums=(0, 1))(x, w)
+
+        gp, gr = loss(True), loss(False)
+        np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gr[0]),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gr[1]),
+                                   atol=1e-5)
+
+    def test_op_entry_counts_none(self):
+        x = Tensor(rng(3, 4, 8))
+        w = Tensor(rng(3, 8, 8, seed=2))
+        y = call_op("grouped_gemm", x, w)
+        ref = np.einsum("gck,gkn->gcn", np.asarray(x._data),
+                        np.asarray(w._data))
+        np.testing.assert_allclose(np.asarray(y._data), ref, atol=1e-5)
+
+
+class TestRouting:
+    def test_route_positions_and_capacity(self):
+        # 6 tokens, 2 experts, top-1, capacity 4: expert 0 wins every token
+        # through a biased gate; tokens 4,5 must be dropped
+        t, E = 6, 2
+        x = jnp.asarray(np.abs(rng(t, 8)) + 0.1)    # positive features
+        gw = jnp.zeros((8, E), jnp.float32).at[:, 0].set(1.0)
+        idx, w, counts, aux = route_topk(x, gw, 1, 4)
+        assert idx.shape == (E, 4) and w.shape == (E, 4)
+        np.testing.assert_array_equal(np.asarray(counts), [4, 0])
+        np.testing.assert_array_equal(np.asarray(idx[0]), [0, 1, 2, 3])
+        assert float(w[1].sum()) == 0.0
+
+    def test_route_matches_dense_gate(self):
+        """Index routing must agree with the dense TopKGate combine tensor."""
+        from paddle_tpu.nn.moe import TopKGate
+        t, h, E, K = 16, 8, 4, 2
+        gate = TopKGate(h, E, top_k=K)
+        x = Tensor(rng(t, h, seed=3, scale=1.0))
+        combine, dispatch, aux_d = gate(x)          # [t, E, C]
+        C = combine.shape[-1]
+        idx, w, counts, aux_i = route_topk(
+            x._data, gate.weight._data, K, C)
+        dense_from_idx = np.zeros((t, E, C), np.float32)
+        idx_np, w_np = np.asarray(idx), np.asarray(w)
+        for e in range(E):
+            for c in range(C):
+                if idx_np[e, c] < t:
+                    dense_from_idx[idx_np[e, c], e, c] = w_np[e, c]
+        np.testing.assert_allclose(dense_from_idx,
+                                   np.asarray(combine._data), atol=1e-5)
+        np.testing.assert_allclose(float(aux_i), float(aux_d._data),
+                                   atol=1e-5)
+
+
+class TestMoEFFN:
+    def _brute_force(self, x, gw, gp, up, dp, K, cf):
+        """Per-token reference: sum over kept top-k experts of
+        w_e * ffn_e(x_t), with GShard capacity dropping."""
+        t = x.shape[0]
+        E = gw.shape[1]
+        C = moe_capacity(t, K, E, cf)
+        idx, w, counts, _ = route_topk(jnp.asarray(x), jnp.asarray(gw), K, C)
+        out = np.zeros_like(x)
+        idx_np, w_np = np.asarray(idx), np.asarray(w)
+        silu = lambda v: v / (1.0 + np.exp(-v))
+        for e in range(E):
+            for c in range(C):
+                tok = idx_np[e, c]
+                if tok < t and w_np[e, c] != 0.0:
+                    mid = silu(x[tok] @ gp[e]) * (x[tok] @ up[e])
+                    out[tok] += w_np[e, c] * (mid @ dp[e])
+        return out
+
+    def test_matches_brute_force(self):
+        t, h, m, E, K = 12, 8, 16, 4, 2
+        x = rng(t, h, seed=5, scale=1.0)
+        gw = rng(h, E, seed=6, scale=1.0)
+        gp, up, dp = rng(E, h, m, seed=7), rng(E, h, m, seed=8), \
+            rng(E, m, h, seed=9)
+        out, aux = _moe_local(jnp.asarray(x), jnp.asarray(gw),
+                              jnp.asarray(gp), jnp.asarray(up),
+                              jnp.asarray(dp), K, 1.25, False)
+        ref = self._brute_force(x, gw, gp, up, dp, K, 1.25)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+    def test_layer_backward_reaches_all_params(self):
+        from paddle_tpu.nn.moe import MoELayer
+        layer = MoELayer(8, 16, num_experts=4, top_k=2)
+        x = Tensor(rng(2, 6, 8, scale=1.0))
+        out = layer(x)
+        loss = (out * out).sum() + layer.aux_loss
+        loss.backward()
+        assert layer.gate.weight.grad is not None
+        assert float(np.abs(np.asarray(
+            layer.gate.weight.grad._data)).max()) > 0
+        for p in layer.experts.parameters():
+            assert p.grad is not None
+
+    def test_dispatch_flops_linear_in_tokens(self):
+        """The ragged-dispatch requirement: doubling tokens must ~double
+        FLOPs (dense one-hot dispatch was quadratic: t * E*C(t) * h)."""
+        h, m, E, K = 32, 64, 8, 2
+        gw = jnp.asarray(rng(h, E))
+        gp = jnp.asarray(rng(E, h, m, seed=1))
+        up = jnp.asarray(rng(E, h, m, seed=2))
+        dp = jnp.asarray(rng(E, m, h, seed=3))
+
+        def flops(t):
+            f = jax.jit(lambda x: _moe_local(x, gw, gp, up, dp, K, 1.25,
+                                             False)[0])
+            c = f.lower(jax.ShapeDtypeStruct((t, h), jnp.float32)) \
+                 .compile().cost_analysis()
+            return c["flops"]
+
+        f1, f2 = flops(256), flops(512)
+        assert f2 / f1 < 3.0, (f1, f2)
+
+
+class TestExpertParallel:
+    def test_ep_matches_local(self):
+        """moe_ffn under an 8-way expert axis must match the single-shard
+        path on identical weights (all_to_all round trip is exact)."""
+        from paddle_tpu.distributed import topology as topo
+        topo.set_hybrid_communicate_group(None)
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        try:
+            t, h, m, E, K = 16, 8, 16, 8, 2
+            x = Tensor(rng(t, h, scale=1.0))
+            gw = Tensor(rng(h, E, seed=1, scale=1.0))
+            gp, up, dp = (Tensor(rng(E, h, m, seed=2)),
+                          Tensor(rng(E, h, m, seed=3)),
+                          Tensor(rng(E, m, h, seed=4)))
+            out_ep, aux_ep = call_op("moe_ffn", x, gw, gp, up, dp,
+                                     top_k=K, expert_axis="dp")
+            out_ep = np.asarray(out_ep._data)
+        finally:
+            topo.set_hybrid_communicate_group(None)
+        out_local, aux_l = _moe_local(x._data, gw._data, gp._data, up._data,
+                                      dp._data, K, 1.25, False)
+        # EP shards tokens 8-way: per-shard capacity differs from the
+        # single-shard capacity, so compare against the brute-force with
+        # per-shard routing: rerun local path per 2-token shard
+        shards = []
+        for s in range(8):
+            xs = x._data[s * 2:(s + 1) * 2]
+            o, _ = _moe_local(xs, gw._data, gp._data, up._data, dp._data,
+                              K, 1.25, False)
+            shards.append(np.asarray(o))
+        np.testing.assert_allclose(out_ep, np.concatenate(shards),
+                                   atol=1e-4)
+
+    def test_ragged_token_count_falls_back_to_local(self):
+        """t not divisible by the expert-axis degree (last partial batch)
+        must not crash: the kernel falls back to single-shard compute."""
+        from paddle_tpu.distributed import topology as topo
+        from paddle_tpu.nn.moe import MoELayer
+        topo.set_hybrid_communicate_group(None)
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        try:
+            layer = MoELayer(8, 16, num_experts=8, top_k=2)
+            out = layer(Tensor(rng(1, 6, 8, scale=1.0)))  # 6 tokens, n=8
+            assert out.shape == [1, 6, 8]
+        finally:
+            topo.set_hybrid_communicate_group(None)
+
+    def test_moe_model_trains_under_ep(self):
+        from paddle_tpu.distributed import topology as topo
+        from paddle_tpu.models.moe import (MoEConfig, MoEForCausalLM,
+                                           MoEPretrainingCriterion)
+        topo.set_hybrid_communicate_group(None)
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        try:
+            cfg = MoEConfig.tiny_moe(num_experts=8)
+            model = dist.fleet.distributed_model(MoEForCausalLM(cfg))
+            crit = MoEPretrainingCriterion(cfg, model)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            ids = Tensor(np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (8, 16)).astype(np.int32))
+            losses = []
+            for _ in range(2):
+                loss = crit(model(ids), ids)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss._data))
+            assert np.isfinite(losses).all()
+            assert losses[1] < losses[0]
+        finally:
+            topo.set_hybrid_communicate_group(None)

@@ -148,6 +148,21 @@ class TestGroupSharded:
         assert losses[-1] < losses[0]
 
 
+class TestGroupShardedDpOnly:
+    def test_dp_only_fleet_shards_over_dp(self):
+        """group_sharded_parallel under a dp-only hybrid group must not be
+        a silent no-op: with sharding_degree 1 it rides the dp axis."""
+        _fresh_fleet(dp_degree=8)
+        model = _mlp()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        wrapped, opt = dist.sharding.group_sharded_parallel(
+            model, opt, level="p_g_os")
+        sharded = [p for p in model.parameters()
+                   if not _replicated(p._data)]
+        assert sharded, "params still replicated under dp-only fleet"
+
+
 class TestTrainStepStage1:
     def test_state_stays_sharded_across_compiled_steps(self):
         from paddle_tpu.jit.api import TrainStep
