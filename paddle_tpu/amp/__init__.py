@@ -13,11 +13,21 @@ from __future__ import annotations
 import contextlib
 from typing import Optional, Set
 
+import jax
 import jax.numpy as jnp
 
 from ..core import dtype as dtype_mod
 from ..core.tensor import Tensor
 from ..ops import dispatcher
+
+
+@jax.jit
+def _fused_unscale(grads, inv):
+    """grads * inv + one global finite flag, compiled as one program."""
+    scaled = tuple(g * inv.astype(g.dtype) for g in grads)
+    finite = jnp.all(jnp.stack(
+        [jnp.all(jnp.isfinite(g)) for g in scaled]))
+    return scaled, ~finite
 
 # O1 lists (reference python/paddle/amp/amp_lists.py white/black lists)
 WHITE_LIST: Set[str] = {
@@ -126,20 +136,26 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
+        """One fused jitted pass over all grads: unscale + global finite
+        check, with a single host sync (the reference's check_finite_and_
+        unscale kernel, grad_scaler.py:579 — NOT a per-param Python loop,
+        which would serialize the device once per parameter)."""
         if not self._enable:
             return
         if id(optimizer) in self._unscaled:  # guard against double unscale
             return
         self._unscaled.add(id(optimizer))
         inv = 1.0 / self._scale
-        found_inf = False
-        for p in optimizer._parameter_list:
-            if p.grad is not None:
-                g = p.grad._data * inv if inv != 1.0 else p.grad._data
-                if not bool(jnp.all(jnp.isfinite(g))):
-                    found_inf = True
-                p.grad._set_data(g)
-        self._found_inf = found_inf  # always refreshed, even at scale 1.0
+        with_grads = [p for p in optimizer._parameter_list
+                      if p.grad is not None]
+        if not with_grads:
+            self._found_inf = False
+            return
+        grads = tuple(p.grad._data for p in with_grads)
+        new_grads, found = _fused_unscale(grads, jnp.float32(inv))
+        for p, g in zip(with_grads, new_grads):
+            p.grad._set_data(g)
+        self._found_inf = bool(found)  # the one host sync per step
 
     def step(self, optimizer):
         self.unscale_(optimizer)

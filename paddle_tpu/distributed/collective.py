@@ -21,8 +21,32 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
+from .. import flags
 from . import env
 from .topology import get_hybrid_communicate_group
+
+
+def _watched(fn):
+    """Bracket an eager collective with a watchdog CommTask (reference
+    comm_task_manager.h:37): for sync ops the call blocks inside the task
+    scope, so a DCN/cross-host stall trips the timeout handler instead of
+    hanging silently."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from .watchdog import comm_watchdog
+        mgr = comm_watchdog()
+        with mgr.start_task(f"eager:{fn.__name__}",
+                            timeout_s=float(flags.get_flag("comm_timeout_s")),
+                            rank=env.get_rank()):
+            out = fn(*args, **kwargs)
+            if kwargs.get("sync_op", True):
+                try:
+                    jax.block_until_ready(
+                        out._data if isinstance(out, Tensor) else out)
+                except (AttributeError, TypeError):
+                    pass  # list outputs / None: already synced by impl
+            return out
+    return wrapper
 
 
 class ReduceOp:
@@ -125,6 +149,7 @@ def new_group(ranks=None, backend=None, axis: str = "dp") -> Group:
     return Group(axis, len(ranks) if ranks else get_world_size(), ranks)
 
 
+@_watched
 def barrier(group=None):
     jax.block_until_ready(jnp.zeros(()))
 
@@ -149,6 +174,7 @@ def _sharded_axes(t: Tensor):
     return sh, names
 
 
+@_watched
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
                sync_op: bool = True):
     """On a tensor sharded over the group axis: psum/pmax over that axis and
@@ -196,6 +222,7 @@ def _strip_axis(entry, axis):
     return entry
 
 
+@_watched
 def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
     """Gather shards into per-rank tensors (reference all_gather.py)."""
     sh, axes = _sharded_axes(tensor)
@@ -220,6 +247,7 @@ def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
     return tensor_list
 
 
+@_watched
 def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
     """Single-controller tensors are already consistent; replicate placement."""
     sh, axes = _sharded_axes(tensor)
@@ -230,10 +258,12 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
     return tensor
 
 
+@_watched
 def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op=op, group=group)
 
 
+@_watched
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list:
         stacked = jnp.concatenate([t._data for t in tensor_list], axis=0)
@@ -241,6 +271,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_watched
 def all_to_all(out_tensor_list: List, in_tensor_list: List, group=None,
                sync_op=True):
     """Single-controller: transpose of the (rank, chunk) matrix."""
@@ -261,6 +292,7 @@ def split(x: Tensor, num_or_sections, axis=0):
     return call_op("split", x, num_or_sections=num_or_sections, axis=axis)
 
 
+@_watched
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: str = ReduceOp.SUM,
                    group=None, sync_op: bool = True):
     """reference communication/reduce_scatter.py. Two input forms:
@@ -338,6 +370,7 @@ def _reject_cross_host_p2p():
             "distributed.pipeline) for cross-host transfers")
 
 
+@_watched
 def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True):
     _reject_cross_host_p2p()
     q = _p2p_queues.setdefault((env.get_rank(), dst), [])
@@ -354,6 +387,7 @@ def isend(tensor: Tensor, dst: int = 0, group=None):
     return send(tensor, dst, group, sync_op=False)
 
 
+@_watched
 def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True):
     _reject_cross_host_p2p()
     q = _p2p_queues.get((src, env.get_rank()), [])

@@ -8,17 +8,23 @@ Reference counterpart: `fleet/meta_parallel/` — `PipelineParallel`
 TPU-first: the wrappers don't move bytes — parameters are mesh-sharded at
 construction and XLA inserts collectives — so each wrapper only (a) places
 inputs on the right mesh axes and (b) for PP, drives the compiled
-microbatch schedule. The reference's schedule classes map to engine
-configs, not different runtimes:
+microbatch schedule. The reference's schedule classes map to engines:
 
-| reference schedule                         | here                        |
-|--------------------------------------------|-----------------------------|
-| FThenB (`pipeline_scheduler_pass.py:47`)   | `schedule="FThenB"`         |
-| 1F1B (`pipeline_parallel.py:440`)          | `schedule="1F1B"` (default) |
-| interleaved VPP (`:906`)                   | `schedule="VPP"` + chunks   |
+| reference schedule                         | here                         |
+|--------------------------------------------|------------------------------|
+| FThenB (`pipeline_scheduler_pass.py:47`)   | rotation scan, remat off     |
+| 1F1B (`pipeline_parallel.py:440`)          | rotation scan, remat per mb  |
+| interleaved VPP (`:906`)                   | `virtual_pp_degree` > 1 in   |
+|                                            | pipeline_configs — a distinct|
+|                                            | table-driven engine          |
 
-All three compile to the same `ppermute` rotation; they differ in remat
-policy (activation-memory shape), which is what the schedules buy on GPU.
+FThenB/1F1B share one `ppermute` rotation scan and differ in remat policy
+(their GPU difference is activation memory; wall-clock is identical in a
+single compiled program). Interleaved VPP is a real second engine
+(distributed/pipeline.py:_build_vpp_engine): v chunks per device driven by
+a precomputed greedy schedule, cutting the fill/drain bubble to
+(S-1)/(M*v+S-1) — measured by vpp_bubble_fraction and asserted in
+tests/test_pallas_and_pp.py.
 """
 
 from __future__ import annotations

@@ -337,8 +337,10 @@ def _transpose(x: DistTensorSpec, perm: Sequence[int] = (), **attrs
 @register_spmd_rule("reshape")
 def _reshape(x: DistTensorSpec, shape: Sequence[int] = (), **attrs
              ) -> SpmdInfo:
-    """spmd_rules/reshape.cc (simplified): sharding survives on dims whose
-    size is preserved at the same linearized position; otherwise cleared."""
+    """spmd_rules/reshape.cc via dim_trans (MakeReshapeDimTrans): walk both
+    shapes grouping equal-product runs — 1:1 dims keep sharding, flatten
+    groups keep the leading factor's sharding, split groups keep it on the
+    leading chunk; mixed groups are cleared."""
     out_shape = list(shape)
     neg = [i for i, s in enumerate(out_shape) if s == -1]
     total = 1
@@ -350,16 +352,42 @@ def _reshape(x: DistTensorSpec, shape: Sequence[int] = (), **attrs
             if s != -1:
                 known *= s
         out_shape[neg[0]] = total // known
-    out_map = [-1] * len(out_shape)
-    # leading-dim preservation: common case (merge/split of trailing dims)
-    for d in range(min(x.ndim, len(out_shape))):
-        if x.shape[d] == out_shape[d]:
-            if all(x.shape[i] == out_shape[i] for i in range(d)):
-                out_map[d] = x.dims_mapping[d]
-        else:
-            break
-    return SpmdInfo([x.copy()],
-                    [DistTensorSpec(tuple(out_shape), _dedup(out_map))])
+    out_dims: List = []
+    i = j = 0
+    while i < x.ndim or j < len(out_shape):
+        # skip/emit size-1 alignment trivially inside the grouping below
+        pi, pj = 1, 1
+        gi, gj = [], []
+        # grow groups until products match
+        if i < x.ndim:
+            pi *= x.shape[i]; gi.append(i); i += 1
+        if j < len(out_shape):
+            pj *= out_shape[j]; gj.append(j); j += 1
+        while pi != pj:
+            if pi < pj and i < x.ndim:
+                pi *= x.shape[i]; gi.append(i); i += 1
+            elif pj < pi and j < len(out_shape):
+                pj *= out_shape[j]; gj.append(j); j += 1
+            else:
+                break
+        if len(gi) == 1 and len(gj) == 1 and pi == pj:
+            out_dims.append(("dim", gi[0]))
+        elif len(gj) == 1 and gi and pi == pj:
+            out_dims.append(("flatten", gi))
+        elif len(gi) == 1 and pi == pj:
+            # the sharding keeper is the first non-unit chunk (a size-1
+            # leading chunk cannot carry a shard)
+            src = gi[0]
+            keeper = next((oj for oj in gj if out_shape[oj] > 1), gj[0])
+            for oj in gj:
+                out_dims.append(("split", src, out_shape[oj], oj == keeper))
+        else:  # uneven factorization / trailing unit dims: clear
+            for oj in gj:
+                out_dims.append(("const", out_shape[oj]))
+    info = dim_trans_infer(x, out_dims)
+    # a split keeps sharding only if the shard count divides the chunk; the
+    # leading-chunk rule above is the reference's behavior (dim_trans.cc)
+    return info
 
 
 @register_spmd_rule("concat")
@@ -407,6 +435,269 @@ def _fused_rope(q: DistTensorSpec, k: DistTensorSpec, **attrs) -> SpmdInfo:
     return SpmdInfo([rq, rk],
                     [DistTensorSpec(q.shape, list(rq.dims_mapping)),
                      DistTensorSpec(k.shape, list(rk.dims_mapping))])
+
+
+# -- dim-trans machinery (spmd_rules/dim_trans.cc) ---------------------------
+#
+# Shape-changing ops (reshape/flatten/squeeze/unsqueeze) are described as a
+# per-output-dim transformation over input dims; sharding propagates to an
+# output dim when it is built from a single input dim or is the LEADING
+# factor of a flatten group (the reference's Flatten/Split/InputDim scheme).
+
+def dim_trans_infer(x: DistTensorSpec, out_dims: List) -> SpmdInfo:
+    """out_dims: one entry per output dim —
+       ("dim", i)          output dim IS input dim i
+       ("flatten", [i,..]) output dim merges input dims (leading dim's
+                           sharding survives; the rest must be whole)
+       ("const", size)     new size-`size` dim (unsharded)
+       ("split", i, size, leading)  a chunk of input dim i; only the
+                           leading chunk keeps i's sharding
+    """
+    req = list(x.dims_mapping)
+    out_map: List[int] = []
+    out_shape: List[int] = []
+    for ent in out_dims:
+        kind = ent[0]
+        if kind == "dim":
+            i = ent[1]
+            out_map.append(x.dims_mapping[i])
+            out_shape.append(x.shape[i])
+        elif kind == "flatten":
+            idxs = ent[1]
+            sz = 1
+            for i in idxs:
+                sz *= x.shape[i]
+            out_shape.append(sz)
+            out_map.append(x.dims_mapping[idxs[0]])
+            for i in idxs[1:]:
+                req[i] = -1     # non-leading factors must be whole per shard
+        elif kind == "const":
+            out_shape.append(ent[1])
+            out_map.append(-1)
+        elif kind == "split":
+            _, i, size, leading = ent
+            out_shape.append(size)
+            if leading:
+                out_map.append(x.dims_mapping[i])
+            else:
+                out_map.append(-1)
+        else:
+            raise ValueError(kind)
+    return SpmdInfo([DistTensorSpec(x.shape, _dedup(req))],
+                    [DistTensorSpec(tuple(out_shape), _dedup(out_map))])
+
+
+@register_spmd_rule("flatten")
+def _flatten(x: DistTensorSpec, start_axis: int = 0, stop_axis: int = -1,
+             **attrs) -> SpmdInfo:
+    """spmd_rules/flatten.cc via dim_trans: flattened group keeps the
+    leading dim's sharding."""
+    sa, so = start_axis % x.ndim, stop_axis % x.ndim
+    out_dims: List = [("dim", i) for i in range(sa)]
+    out_dims.append(("flatten", list(range(sa, so + 1))))
+    out_dims += [("dim", i) for i in range(so + 1, x.ndim)]
+    return dim_trans_infer(x, out_dims)
+
+
+@register_spmd_rule("squeeze")
+def _squeeze(x: DistTensorSpec, axis=None, **attrs) -> SpmdInfo:
+    """spmd_rules/squeeze.cc: size-1 dims drop; others pass through."""
+    if axis is None:
+        drop = {i for i, s in enumerate(x.shape) if s == 1}
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        drop = {a % x.ndim for a in axes if x.shape[a % x.ndim] == 1}
+    out_dims = [("dim", i) for i in range(x.ndim) if i not in drop]
+    return dim_trans_infer(x, out_dims)
+
+
+@register_spmd_rule("unsqueeze")
+def _unsqueeze(x: DistTensorSpec, axis=0, **attrs) -> SpmdInfo:
+    """spmd_rules/unsqueeze.cc: inserted size-1 dims are unsharded."""
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    out_ndim = x.ndim + len(axes)
+    axes = sorted(a % out_ndim for a in axes)
+    out_dims: List = []
+    src = 0
+    for d in range(out_ndim):
+        if d in axes:
+            out_dims.append(("const", 1))
+        else:
+            out_dims.append(("dim", src))
+            src += 1
+    return dim_trans_infer(x, out_dims)
+
+
+# -- identity-propagation & misc rules ---------------------------------------
+
+def _identity_rule(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    return SpmdInfo([x.copy()],
+                    [DistTensorSpec(x.shape, list(x.dims_mapping),
+                                    set(x.partial_on))])
+
+
+@register_spmd_rule("cast")
+def _cast(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/cast.cc: dtype change, sharding unchanged."""
+    return _identity_rule(x)
+
+
+@register_spmd_rule("scale")
+def _scale(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/scale.cc: elementwise affine, sharding unchanged."""
+    return _identity_rule(x)
+
+
+@register_spmd_rule("pow")
+def _pow(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/pow.cc: elementwise, sharding unchanged."""
+    return _identity_rule(x)
+
+
+@register_spmd_rule("full_like")
+def _full_like(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/full_like.cc: value-independent fill — output replicated
+    (the cheap choice: a fill needs no communication either way)."""
+    return SpmdInfo([x.copy()], [DistTensorSpec(x.shape, [-1] * x.ndim)])
+
+
+@register_spmd_rule("numel")
+def _numel(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/numel.cc: scalar metadata output, replicated."""
+    return SpmdInfo([x.copy()], [DistTensorSpec((), [])])
+
+
+@register_spmd_rule("triu")
+def _triu(x: DistTensorSpec, diagonal: int = 0, **attrs) -> SpmdInfo:
+    """spmd_rules/triu.cc: the two matrix dims are unsharded (the mask is
+    positional over the full matrix); batch dims pass through."""
+    req = _dedup(list(x.dims_mapping[:-2]) + [-1, -1])
+    return SpmdInfo([DistTensorSpec(x.shape, req)],
+                    [DistTensorSpec(x.shape, list(req))])
+
+
+@register_spmd_rule("slice")
+def _slice(x: DistTensorSpec, axes=(), **attrs) -> SpmdInfo:
+    """spmd_rules/slice.cc: sliced axes must be whole per shard; the rest
+    propagate. Output shape is not computable without starts/ends, so the
+    output spec reuses x.shape (callers use the mappings)."""
+    req = list(x.dims_mapping)
+    for a in axes:
+        req[a % x.ndim] = -1
+    req = _dedup(req)
+    return SpmdInfo([DistTensorSpec(x.shape, req)],
+                    [DistTensorSpec(x.shape, list(req))])
+
+
+@register_spmd_rule("stack")
+def _stack(*specs: DistTensorSpec, axis: int = 0, **attrs) -> SpmdInfo:
+    """spmd_rules/stack.cc: inputs merge; the new axis is unsharded."""
+    nd = specs[0].ndim
+    ax = axis % (nd + 1)
+    merged = _dedup([_merge_dim([s.dims_mapping[d] for s in specs])
+                     for d in range(nd)])
+    req = [DistTensorSpec(s.shape, list(merged)) for s in specs]
+    out_map = merged[:ax] + [-1] + merged[ax:]
+    out_shape = (specs[0].shape[:ax] + (len(specs),) + specs[0].shape[ax:])
+    return SpmdInfo(req, [DistTensorSpec(out_shape, out_map)])
+
+
+@register_spmd_rule("tile")
+def _tile(x: DistTensorSpec, repeat_times=(), **attrs) -> SpmdInfo:
+    """spmd_rules/tile.cc: dims with repeat 1 keep sharding; repeated dims
+    and broadcast (new leading) dims are unsharded."""
+    rt = list(repeat_times)
+    if len(rt) < x.ndim:          # paddle pads short repeat_times in front
+        rt = [1] * (x.ndim - len(rt)) + rt
+    bcast = len(rt) - x.ndim
+    req = list(x.dims_mapping)
+    for i in range(x.ndim):
+        if rt[bcast + i] != 1:
+            req[i] = -1
+    req = _dedup(req)
+    out_map = [-1] * len(rt)
+    out_shape = []
+    for i in range(len(rt)):
+        if i < bcast:
+            out_shape.append(rt[i])
+        else:
+            out_map[i] = req[i - bcast] if rt[i] == 1 else -1
+            out_shape.append(x.shape[i - bcast] * rt[i])
+    return SpmdInfo([DistTensorSpec(x.shape, req)],
+                    [DistTensorSpec(tuple(out_shape), _dedup(out_map))])
+
+
+@register_spmd_rule("where")
+def _where(cond: DistTensorSpec, x: DistTensorSpec, y: DistTensorSpec,
+           **attrs) -> SpmdInfo:
+    """spmd_rules/where.cc: ternary broadcast elementwise."""
+    return _elementwise(cond, x, y)
+
+
+@register_spmd_rule("default_data_parallel")
+def _default_dp(*specs: DistTensorSpec, n_outputs: int = 1,
+                **attrs) -> SpmdInfo:
+    """spmd_rules/default_data_parallel.cc: merge the batch (0th) axis over
+    all inputs; everything else replicated; outputs batch-sharded."""
+    b = _merge_dim([s.dims_mapping[0] for s in specs if s.ndim > 0])
+    req = [DistTensorSpec(s.shape, _dedup([b] + [-1] * (s.ndim - 1))
+                          if s.ndim else []) for s in specs]
+    outs = [DistTensorSpec(specs[0].shape,
+                           _dedup([b] + [-1] * (specs[0].ndim - 1)))
+            for _ in range(n_outputs)]
+    return SpmdInfo(req, outs)
+
+
+@register_spmd_rule("optimizer")
+def _optimizer(param: DistTensorSpec, grad: DistTensorSpec,
+               *moments: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/optimizer.cc (AdamInferSpmdDynamic): param/grad merge
+    elementwise; every moment aligns to the merged param mapping (ZeRO
+    state follows the param shards); scalars stay replicated."""
+    merged = _dedup([_merge_dim([p, g]) for p, g in
+                     zip(param.dims_mapping, grad.dims_mapping)])
+    req = [DistTensorSpec(param.shape, list(merged)),
+           DistTensorSpec(grad.shape, list(merged))]
+    outs = [DistTensorSpec(param.shape, list(merged))]
+    for m in moments:
+        mapping = list(merged) if m.ndim == param.ndim else [-1] * m.ndim
+        req.append(DistTensorSpec(m.shape, mapping))
+        outs.append(DistTensorSpec(m.shape, list(mapping)))
+    return SpmdInfo(req, outs)
+
+
+@register_spmd_rule("fused_linear_param_grad_add")
+def _fused_linear_param_grad_add(x: DistTensorSpec, dout: DistTensorSpec,
+                                 dweight: Optional[DistTensorSpec] = None,
+                                 dbias: Optional[DistTensorSpec] = None,
+                                 **attrs) -> SpmdInfo:
+    """spmd_rules/fused_linear_param_grad_add.cc: dweight = x^T @ dout over
+    the flattened batch/row dims — any mesh axis sharding those dims leaves
+    dweight/dbias Partial on it; k/n shardings propagate to dweight."""
+    k_axis = x.dims_mapping[-1]
+    n_axis = dout.dims_mapping[-1]
+    partial = set()
+    for m in list(x.dims_mapping[:-1]) + list(dout.dims_mapping[:-1]):
+        if m != -1:
+            partial.add(m)
+    dw_map = _dedup([k_axis, n_axis])
+    dw_shape = (x.shape[-1], dout.shape[-1])
+    db_shape = (dout.shape[-1],)
+    req = [x.copy(), dout.copy()]
+    outs = [DistTensorSpec(dw_shape, dw_map, set(partial)),
+            DistTensorSpec(db_shape, [dw_map[1]], set(partial))]
+    return SpmdInfo(req, outs)
+
+
+@register_spmd_rule("replicated")
+def _replicated(*specs: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/replicated.cc: force everything replicated (the explicit
+    form of the __default__ fallback, with outputs)."""
+    n_outputs = attrs.get("n_outputs", 1)
+    ins = [DistTensorSpec(s.shape, [-1] * s.ndim) for s in specs]
+    outs = [DistTensorSpec(specs[0].shape, [-1] * specs[0].ndim)
+            for _ in range(n_outputs)]
+    return SpmdInfo(ins, outs)
 
 
 # -- reshard planning ---------------------------------------------------------

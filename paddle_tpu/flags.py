@@ -101,6 +101,9 @@ define_flag("check_nan_inf", False, "check every op output for NaN/Inf (eager)")
 define_flag("eager_op_jit", True, "jit-compile each eager op (per-op XLA cache)")
 define_flag("use_pallas_kernels", True, "route hot ops to Pallas hand kernels")
 define_flag("benchmark", False, "block on every op for accurate timing")
+define_flag("comm_timeout_s", 600.0,
+            "eager collective / train-step watchdog timeout (seconds); the "
+            "FLAGS_nccl_blocking_wait analog for DCN stalls")
 define_flag("low_precision_op_list", 0, "log ops run in low precision under AMP")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")

@@ -5,15 +5,24 @@ multiprocess workers in io/dataloader/dataloader_iter.py:201).
 
 TPU-native: the loader produces host numpy batches; transfer overlaps with
 compute via a background prefetch thread feeding a bounded queue (the
-blocking-queue analog). Multiprocess shared-memory workers arrive with the
-native runtime; num_workers>0 currently maps to threads.
+blocking-queue analog). `num_workers > 0` spawns real worker PROCESSES
+(the `_DataLoaderIterMultiProcess` analog): index batches fan out over
+per-worker queues, collated numpy batches come back on a shared result
+queue and are reassembled in order — Python-heavy transforms escape the
+GIL. `persistent_workers=True` keeps the pool alive across epochs.
+IterableDataset keeps the thread path (a process pool would duplicate the
+stream; the reference splits via worker_info, which map-style covers here).
 """
 
 from __future__ import annotations
 
+import atexit
 import itertools
+import multiprocessing as mp
+import os
 import queue
 import threading
+import traceback
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -197,21 +206,140 @@ def default_collate_fn(batch: List):
     return batch
 
 
+def _worker_loop(dataset, index_q, data_q, collate_fn, init_fn,
+                 worker_id, num_workers, base_seed):
+    """Worker-process body (reference io/dataloader/worker.py _worker_loop):
+    pull index batches, collate samples, push (seq, batch) back. Runs until
+    it sees the None sentinel."""
+    np.random.seed((base_seed + worker_id) % (2 ** 31))
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        while True:
+            item = index_q.get()
+            if item is None:
+                break
+            epoch, seq, idxs = item
+            try:
+                batch = collate_fn([dataset[i] for i in idxs])
+                data_q.put((epoch, seq, batch, None))
+            except Exception:
+                data_q.put((epoch, seq, None, traceback.format_exc()))
+    except KeyboardInterrupt:
+        pass
+
+
+class _WorkerPool:
+    """Spawns `num_workers` processes; dispatches (seq, indices), yields
+    collated batches in order (seq-based reassembly)."""
+
+    def __init__(self, dataset, collate_fn, num_workers, worker_init_fn,
+                 prefetch_factor, timeout):
+        # fork keeps the dataset un-pickled and matches the reference's
+        # Linux default; workers only touch numpy, never the device runtime
+        ctx = mp.get_context(
+            os.environ.get("PADDLE_TPU_WORKER_START_METHOD", "fork"))
+        self.num_workers = num_workers
+        self.timeout = timeout or None
+        self.prefetch = prefetch_factor
+        self.data_q = ctx.Queue()
+        self.index_qs = [ctx.Queue() for _ in range(num_workers)]
+        base_seed = int(np.random.randint(0, 2 ** 31))
+        self.procs = []
+        for w in range(num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, self.index_qs[w], self.data_q, collate_fn,
+                      worker_init_fn, w, num_workers, base_seed),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+        self._closed = False
+        self._epoch = 0
+        atexit.register(self.shutdown)
+
+    def run_epoch(self, index_iter):
+        """Generator over collated batches, in sampler order. Messages carry
+        an epoch tag so results from an earlier abandoned epoch (caller
+        broke out of the loop mid-stream) are discarded, not miscounted."""
+        self._epoch += 1
+        epoch = self._epoch
+        seq_out = 0          # next seq to yield
+        buffered = {}        # seq -> batch (arrived out of order)
+        pending = 0
+        it = iter(enumerate(index_iter))
+        limit = self.num_workers * self.prefetch
+
+        def dispatch():
+            nonlocal pending
+            try:
+                seq, idxs = next(it)
+            except StopIteration:
+                return False
+            self.index_qs[seq % self.num_workers].put((epoch, seq, idxs))
+            pending += 1
+            return True
+
+        for _ in range(limit):
+            if not dispatch():
+                break
+        while pending > 0 or seq_out in buffered:
+            while seq_out in buffered:
+                yield buffered.pop(seq_out)
+                seq_out += 1
+                dispatch()
+            if pending == 0:
+                break
+            try:
+                ep, seq, batch, err = self.data_q.get(timeout=self.timeout)
+            except queue.Empty:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker timed out after {self.timeout}s")
+            if ep != epoch:
+                continue        # leftover from an abandoned epoch
+            pending -= 1
+            if err is not None:
+                self.shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            buffered[seq] = batch
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        atexit.unregister(self.shutdown)   # don't pin retired pools forever
+        for q in self.index_qs:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False,
                  drop_last=False, collate_fn=None, num_workers=0,
                  use_buffer_reader=True, prefetch_factor=2, timeout=0,
-                 worker_init_fn=None):
+                 worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
-        self.num_workers = num_workers
+        self.num_workers = int(num_workers)
         self.prefetch_factor = max(2, prefetch_factor)
         self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool: Optional[_WorkerPool] = None
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
             self.batch_size = batch_size
             self.drop_last = drop_last
+            self.num_workers = 0  # stream datasets stay on the thread path
         else:
             self.batch_sampler = batch_sampler or BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size,
@@ -221,6 +349,10 @@ class DataLoader:
         if self.batch_sampler is None:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.shutdown()
 
     def _produce(self):
         if self.batch_sampler is None:
@@ -236,7 +368,24 @@ class DataLoader:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
+    def _iter_multiprocess(self):
+        if self._pool is None or self._pool._closed:
+            self._pool = _WorkerPool(self.dataset, self.collate_fn,
+                                     self.num_workers, self.worker_init_fn,
+                                     self.prefetch_factor, self.timeout)
+        pool = self._pool
+        try:
+            for batch in pool.run_epoch(iter(self.batch_sampler)):
+                yield _to_tensors(batch)
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+                self._pool = None
+
     def __iter__(self):
+        if self.num_workers > 0 and self.batch_sampler is not None:
+            yield from self._iter_multiprocess()
+            return
         src = self._produce()
         if not self.use_buffer_reader:
             for b in src:

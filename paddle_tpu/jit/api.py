@@ -338,6 +338,29 @@ class TrainStep:
         self._params, self._buffers, self._frozen = params, buffers, frozen
 
     def __call__(self, inputs, labels):
+        loss = self._call_impl(inputs, labels)
+        # multi-host: watch the async step for DCN stalls (reference
+        # comm_task_manager.h:37 watches NCCL tasks). A daemon thread
+        # blocks on the loss and retires the CommTask; if the step wedges
+        # on a dead peer, the watchdog fires instead of hanging silently.
+        if jax.process_count() > 1:
+            from .. import flags as _flags
+            from ..distributed.watchdog import comm_watchdog
+            import threading
+
+            task = comm_watchdog().start_task(
+                "train_step", timeout_s=float(_flags.get_flag("comm_timeout_s")))
+
+            def _retire(arr=loss._data, t=task):
+                try:
+                    jax.block_until_ready(arr)
+                finally:
+                    t._mgr.finish_task(t)
+
+            threading.Thread(target=_retire, daemon=True).start()
+        return loss
+
+    def _call_impl(self, inputs, labels):
         opt = self.optimizer
         if self._compiled is not None and \
                 getattr(opt, "_sharding_version", 0) \

@@ -192,3 +192,39 @@ class TestReviewRegressions:
             assert t.task_id in mgr._tasks
         assert t.task_id not in mgr._tasks
         mgr.shutdown()
+
+    def test_eager_collectives_register_comm_tasks(self):
+        """VERDICT weak-4: the collective path must actually bracket itself
+        with CommTasks (reference comm_task_manager.h:37), not just ship an
+        unused manager."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import topology as topo
+        from paddle_tpu.distributed import watchdog as wd
+
+        seen = []
+        mgr = wd.comm_watchdog()
+        orig = mgr.start_task
+
+        def spy(name, timeout_s=600.0, rank=0):
+            seen.append(name)
+            return orig(name, timeout_s, rank)
+
+        mgr.start_task = spy
+        topo.set_hybrid_communicate_group(None)
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        dist.fleet.init(is_collective=True, strategy=strategy)
+        try:
+            t = dist.shard_tensor(
+                paddle.to_tensor(np.ones((8, 4), np.float32)),
+                dist.ProcessMesh(np.arange(8), ["dp"]), [dist.Shard(0)])
+            dist.all_reduce(t)
+            dist.barrier()
+        finally:
+            mgr.start_task = orig
+            topo.set_hybrid_communicate_group(None)
+        assert "eager:all_reduce" in seen
+        assert "eager:barrier" in seen
+        assert not mgr._tasks  # every task retired
