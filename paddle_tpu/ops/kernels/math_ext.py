@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..dispatcher import register_kernel
 
@@ -213,25 +214,36 @@ def frexp_kernel(x):
 
 @register_kernel("take")
 def take_kernel(x, index, mode="raise"):
+    """mode='raise' bounds-checks on the host in eager calls (the op is
+    jit: false for exactly this); under to_static/jit tracing XLA cannot
+    raise on data-dependent indices, so out-of-range degrades to numpy-wrap
+    + edge-clamp — the one documented divergence from the reference."""
     flat = x.reshape(-1)
     idx = index.astype(jnp.int32)
+    n = flat.shape[0]
     if mode == "wrap":
-        idx = idx % flat.shape[0]
+        idx = idx % n
     elif mode == "clip":
-        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+        idx = jnp.clip(idx, 0, n - 1)
     else:
-        # 'raise': XLA cannot raise on data-dependent indices — one numpy-
-        # style negative wrap, then clamp (out-of-range reads the edge)
-        idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
-        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+        if not isinstance(idx, jax.core.Tracer):
+            bad = (np.asarray(idx) < -n) | (np.asarray(idx) >= n)
+            if bad.any():
+                raise IndexError(
+                    f"take(mode='raise'): index out of range for tensor "
+                    f"with {n} elements")
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
     return flat[idx]
 
 
 @register_kernel("bucketize")
-def bucketize_kernel(x, sorted_sequence, out_int32=True, right=False):
+def bucketize_kernel(x, sorted_sequence, out_int32=False, right=False):
     side = "right" if right else "left"
     out = jnp.searchsorted(sorted_sequence, x, side=side)
-    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    if out_int32 or not jax.config.jax_enable_x64:
+        return out.astype(jnp.int32)  # avoid the x64 truncation warning
+    return out.astype(jnp.int64)
 
 
 @register_kernel("cdist")

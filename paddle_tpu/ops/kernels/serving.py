@@ -73,7 +73,17 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
 
     q[B,1,H,D]; pools [NB,BS,KV,D]; block_tables[B,MB] int32 (block ids per
     sequence, padded arbitrarily); context_lens[B] valid token counts.
+    Routed to the Pallas block-table kernel (pallas/paged_attention.py —
+    streams pool blocks into VMEM, no dense HBM gather) when
+    FLAGS_use_pallas_kernels; XLA gather+SDPA composite otherwise.
     """
+    from ... import flags
+    if (flags.get_flag("use_pallas_kernels")
+            and q.shape[1] == 1 and q.shape[3] == k_pool.shape[3]
+            and q.shape[2] % k_pool.shape[2] == 0):
+        from .pallas import paged_attention as pa
+        return pa.paged_attention(q, k_pool, v_pool, block_tables,
+                                  context_lens, scale)
     B = q.shape[0]
     nb, bs = k_pool.shape[0], k_pool.shape[1]
     mb = block_tables.shape[1]

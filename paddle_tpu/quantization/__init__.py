@@ -41,6 +41,20 @@ def fake_quant(x: Tensor, scale, bit_length: int = 8) -> Tensor:
 
 # -- observers ----------------------------------------------------------------
 
+def _check_not_traced(data):
+    """QAT observers mutate Python-held device state; under to_static /
+    TrainStep tracing that would capture a tracer and silently lose
+    calibration (then crash on later eager use). Fail loudly instead —
+    calibrate eagerly, convert(), THEN compile (reference QAT flow)."""
+    import jax as _jax
+    if isinstance(data, _jax.core.Tracer):
+        raise RuntimeError(
+            "quantization observers must run eagerly: observe() was called "
+            "under jit/to_static tracing. Calibrate the model eagerly "
+            "first, call convert(), and only then compile the quantized "
+            "model.")
+
+
 class AbsmaxObserver:
     """Per-tensor abs-max range observer (reference observer/abs_max.py).
 
@@ -53,6 +67,7 @@ class AbsmaxObserver:
 
     def observe(self, x):
         data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        _check_not_traced(data)
         self._max = jnp.maximum(self._max,
                                 jnp.abs(data).max().astype(jnp.float32))
 
@@ -71,6 +86,7 @@ class EMAObserver:
 
     def observe(self, x):
         data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        _check_not_traced(data)
         cur = jnp.abs(data).max().astype(jnp.float32)
         self._ema = cur if self._ema is None else (
             self.moving_rate * self._ema + (1 - self.moving_rate) * cur)

@@ -79,6 +79,21 @@ class TestViterbi:
 
 
 class TestQuantization:
+    def test_observer_rejects_traced_input(self):
+        """ADVICE r1: observers hold Python-side state; calling observe()
+        under tracing must fail loudly, not silently capture a tracer."""
+        import jax
+        import pytest as _pytest
+        from paddle_tpu.quantization import AbsmaxObserver
+        obs = AbsmaxObserver()
+
+        def f(x):
+            obs.observe(x)
+            return x
+
+        with _pytest.raises(RuntimeError, match="eagerly"):
+            jax.eval_shape(f, jax.ShapeDtypeStruct((4,), "float32"))
+
     def test_qat_ste_gradients(self):
         from paddle_tpu.quantization import QAT
         model = paddle.nn.Sequential(paddle.nn.Linear(8, 16),

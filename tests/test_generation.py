@@ -68,6 +68,34 @@ class TestKVCacheDecode:
 
 
 class TestPagedCache:
+    def test_pallas_paged_kernel_matches_composite(self):
+        """The Pallas block-table decode kernel (pallas/paged_attention.py,
+        block_multi_head_attention analog) must match the XLA gather+SDPA
+        composite bit-for-tolerance, incl. GQA and per-seq lengths."""
+        from paddle_tpu.ops.kernels.pallas.paged_attention import (
+            paged_attention as pallas_paged)
+        from paddle_tpu.ops.kernels.serving import paged_attention_kernel
+        from paddle_tpu import flags as _flags
+        for (B, H, KV, D, NB, BS, MB) in [(3, 8, 2, 64, 16, 16, 4),
+                                          (2, 4, 4, 128, 8, 8, 3),
+                                          (1, 8, 1, 64, 4, 16, 2)]:
+            rs = np.random.RandomState(B)
+            q = jnp.asarray(rs.randn(B, 1, H, D).astype(np.float32))
+            kp = jnp.asarray(rs.randn(NB, BS, KV, D).astype(np.float32))
+            vp = jnp.asarray(rs.randn(NB, BS, KV, D).astype(np.float32))
+            tbl = jnp.asarray(rs.randint(0, NB, (B, MB)).astype(np.int32))
+            lens = jnp.asarray(
+                rs.randint(1, MB * BS + 1, (B,)).astype(np.int32))
+            out_p = pallas_paged(q, kp, vp, tbl, lens)
+            prev = _flags.get_flag("use_pallas_kernels")
+            _flags.set_flags({"use_pallas_kernels": False})
+            try:
+                out_c = paged_attention_kernel(q, kp, vp, tbl, lens)
+            finally:
+                _flags.set_flags({"use_pallas_kernels": prev})
+            np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_c),
+                                       atol=3e-5)
+
     def test_paged_matches_contiguous_attention(self):
         """paged_attention over scattered blocks == cache_attention over a
         contiguous buffer with the same contents."""

@@ -222,8 +222,14 @@ class TestReviewRegressions:
         want = np.log(np.cumsum(np.exp(x.reshape(-1))))
         np.testing.assert_allclose(out.numpy(), want, rtol=1e-4)
 
-    def test_take_raise_mode_clamps(self):
+    def test_take_raise_mode_raises_eagerly(self):
+        """ADVICE r1: mode='raise' must bounds-check on the host in eager
+        calls (reference behavior) instead of silently clamping."""
         x = np.arange(6, dtype=np.float32)
+        with pytest.raises(IndexError):
+            paddle.take(paddle.to_tensor(x),
+                        paddle.to_tensor(np.array([-7, 100], np.int32)))
+        # in-range negatives wrap numpy-style
         out = paddle.take(paddle.to_tensor(x),
-                          paddle.to_tensor(np.array([-7, 100], np.int32)))
-        assert np.isfinite(out.numpy()).all()
+                          paddle.to_tensor(np.array([-1, 2], np.int32)))
+        np.testing.assert_allclose(out.numpy(), [5.0, 2.0])
