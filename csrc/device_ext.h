@@ -1,0 +1,103 @@
+// Custom-device plugin C ABI — reference counterpart:
+// paddle/phi/backends/device_ext.h:94 (C_DeviceInterface) and the plugin
+// loading protocol in paddle/phi/backends/custom/ (SURVEY §2.1 stance:
+// "keep plugin C-API shape"). A plugin .so exports
+//     void InitPlugin(CustomRuntimeParams*);
+// filling in device_type, version, and the interface table. The host
+// validates the version and routes memory/device management through the
+// table. On TPU the compute path stays XLA/PJRT; the plugin ABI covers the
+// runtime surface (alloc/copy/sync/stats) the reference exposes to
+// out-of-tree devices, provable without hardware via fake_cpu_device.cc
+// (the fake_cpu_device.h analog).
+
+#ifndef PADDLE_TPU_DEVICE_EXT_H_
+#define PADDLE_TPU_DEVICE_EXT_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PADDLE_CUSTOM_RUNTIME_MAJOR_VERSION 1
+#define PADDLE_CUSTOM_RUNTIME_MINOR_VERSION 0
+#define PADDLE_CUSTOM_RUNTIME_PATCH_VERSION 0
+
+typedef enum { C_SUCCESS = 0, C_WARNING, C_FAILED, C_ERROR,
+               C_INTERNAL_ERROR } C_Status;
+
+typedef struct C_Device_st {
+  int id;
+} * C_Device;
+
+typedef struct C_Stream_st* C_Stream;
+typedef struct C_Event_st* C_Event;
+
+typedef struct C_DeviceInterface {
+  size_t size;  // sizeof(C_DeviceInterface): fwd/bwd-compat guard
+
+  // device management
+  C_Status (*initialize)();
+  C_Status (*finalize)();
+  C_Status (*init_device)(const C_Device device);
+  C_Status (*set_device)(const C_Device device);
+  C_Status (*get_device)(const C_Device device);
+  C_Status (*deinit_device)(const C_Device device);
+
+  // streams/events: no-op capable on ordered runtimes (XLA orders)
+  C_Status (*create_stream)(const C_Device device, C_Stream* stream);
+  C_Status (*destroy_stream)(const C_Device device, C_Stream stream);
+  C_Status (*synchronize_device)(const C_Device device);
+  C_Status (*synchronize_stream)(const C_Device device, C_Stream stream);
+  C_Status (*create_event)(const C_Device device, C_Event* event);
+  C_Status (*record_event)(const C_Device device, C_Stream stream,
+                           C_Event event);
+  C_Status (*destroy_event)(const C_Device device, C_Event event);
+  C_Status (*synchronize_event)(const C_Device device, C_Event event);
+
+  // memory
+  C_Status (*device_memory_allocate)(const C_Device device, void** ptr,
+                                     size_t size);
+  C_Status (*device_memory_deallocate)(const C_Device device, void* ptr,
+                                       size_t size);
+  C_Status (*host_memory_allocate)(const C_Device device, void** ptr,
+                                   size_t size);
+  C_Status (*host_memory_deallocate)(const C_Device device, void* ptr,
+                                     size_t size);
+  C_Status (*memory_copy_h2d)(const C_Device device, void* dst,
+                              const void* src, size_t size);
+  C_Status (*memory_copy_d2h)(const C_Device device, void* dst,
+                              const void* src, size_t size);
+  C_Status (*memory_copy_d2d)(const C_Device device, void* dst,
+                              const void* src, size_t size);
+
+  // info
+  C_Status (*get_device_count)(size_t* count);
+  C_Status (*get_device_list)(size_t* devices);
+  C_Status (*device_memory_stats)(const C_Device device, size_t* total,
+                                  size_t* free);
+  C_Status (*device_min_chunk_size)(const C_Device device, size_t* size);
+} C_DeviceInterface;
+
+typedef struct CustomRuntimeVersion {
+  size_t major, minor, patch;
+} CustomRuntimeVersion;
+
+typedef struct CustomRuntimeParams {
+  size_t size;                    // sizeof(CustomRuntimeParams)
+  C_DeviceInterface* interface;   // filled by the plugin
+  CustomRuntimeVersion version;   // plugin's compiled-against version
+  char* device_type;              // plugin writes its device name here
+  size_t device_type_size;
+  char* sub_device_type;
+  size_t sub_device_type_size;
+} CustomRuntimeParams;
+
+// every plugin exports: void InitPlugin(CustomRuntimeParams*);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // PADDLE_TPU_DEVICE_EXT_H_

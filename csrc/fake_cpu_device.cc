@@ -1,0 +1,121 @@
+// Fake CPU custom-device plugin — reference counterpart:
+// paddle/phi/backends/custom/fake_cpu_device.h + the plugin test
+// test/custom_runtime/test_custom_cpu_plugin.py: a malloc-backed device
+// proving the C_DeviceInterface ABI end-to-end without hardware.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "device_ext.h"
+
+namespace {
+
+size_t g_allocated = 0;  // live device bytes (stats surface)
+
+C_Status ok() { return C_SUCCESS; }
+
+C_Status initialize() { return ok(); }
+C_Status finalize() { return ok(); }
+C_Status init_device(const C_Device) { return ok(); }
+C_Status set_device(const C_Device) { return ok(); }
+C_Status get_device(const C_Device device) {
+  if (device != nullptr) device->id = 0;
+  return ok();
+}
+C_Status deinit_device(const C_Device) { return ok(); }
+
+C_Status create_stream(const C_Device, C_Stream* s) {
+  *s = nullptr;
+  return ok();
+}
+C_Status destroy_stream(const C_Device, C_Stream) { return ok(); }
+C_Status synchronize_device(const C_Device) { return ok(); }
+C_Status synchronize_stream(const C_Device, C_Stream) { return ok(); }
+C_Status create_event(const C_Device, C_Event* e) {
+  *e = nullptr;
+  return ok();
+}
+C_Status record_event(const C_Device, C_Stream, C_Event) { return ok(); }
+C_Status destroy_event(const C_Device, C_Event) { return ok(); }
+C_Status synchronize_event(const C_Device, C_Event) { return ok(); }
+
+C_Status dev_alloc(const C_Device, void** ptr, size_t size) {
+  *ptr = std::malloc(size);
+  if (*ptr == nullptr) return C_FAILED;
+  g_allocated += size;
+  return ok();
+}
+C_Status dev_free(const C_Device, void* ptr, size_t size) {
+  std::free(ptr);
+  g_allocated -= size;
+  return ok();
+}
+C_Status host_alloc(const C_Device d, void** ptr, size_t size) {
+  return dev_alloc(d, ptr, size);
+}
+C_Status host_free(const C_Device d, void* ptr, size_t size) {
+  return dev_free(d, ptr, size);
+}
+C_Status copy(const C_Device, void* dst, const void* src, size_t size) {
+  std::memcpy(dst, src, size);
+  return ok();
+}
+
+C_Status get_device_count(size_t* count) {
+  *count = 1;
+  return ok();
+}
+C_Status get_device_list(size_t* devices) {
+  devices[0] = 0;
+  return ok();
+}
+C_Status device_memory_stats(const C_Device, size_t* total, size_t* free_b) {
+  *total = size_t(1) << 33;  // pretend 8G
+  *free_b = (size_t(1) << 33) - g_allocated;
+  return ok();
+}
+C_Status device_min_chunk_size(const C_Device, size_t* size) {
+  *size = 512;
+  return ok();
+}
+
+}  // namespace
+
+extern "C" void InitPlugin(CustomRuntimeParams* params) {
+  if (params == nullptr || params->interface == nullptr) return;
+  params->version.major = PADDLE_CUSTOM_RUNTIME_MAJOR_VERSION;
+  params->version.minor = PADDLE_CUSTOM_RUNTIME_MINOR_VERSION;
+  params->version.patch = PADDLE_CUSTOM_RUNTIME_PATCH_VERSION;
+  std::snprintf(params->device_type, params->device_type_size, "%s",
+                "fake_cpu");
+
+  std::memset(params->interface, 0, sizeof(C_DeviceInterface));
+  auto* iface = params->interface;
+  iface->size = sizeof(C_DeviceInterface);
+  iface->initialize = initialize;
+  iface->finalize = finalize;
+  iface->init_device = init_device;
+  iface->set_device = set_device;
+  iface->get_device = get_device;
+  iface->deinit_device = deinit_device;
+  iface->create_stream = create_stream;
+  iface->destroy_stream = destroy_stream;
+  iface->synchronize_device = synchronize_device;
+  iface->synchronize_stream = synchronize_stream;
+  iface->create_event = create_event;
+  iface->record_event = record_event;
+  iface->destroy_event = destroy_event;
+  iface->synchronize_event = synchronize_event;
+  iface->device_memory_allocate = dev_alloc;
+  iface->device_memory_deallocate = dev_free;
+  iface->host_memory_allocate = host_alloc;
+  iface->host_memory_deallocate = host_free;
+  iface->memory_copy_h2d = copy;
+  iface->memory_copy_d2h = copy;
+  iface->memory_copy_d2d = copy;
+  iface->get_device_count = get_device_count;
+  iface->get_device_list = get_device_list;
+  iface->device_memory_stats = device_memory_stats;
+  iface->device_min_chunk_size = device_min_chunk_size;
+}

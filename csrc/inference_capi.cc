@@ -1,0 +1,228 @@
+// C inference API over the predictor — reference counterpart:
+// paddle/fluid/inference/capi_exp/pd_inference_api.h (the C ABI over
+// AnalysisPredictor; SURVEY §2.8 stance: "C API only").
+//
+// Mechanism: the library embeds CPython and forwards every call to
+// paddle_tpu/inference/capi_bridge.py, where predictors live in an
+// int-handle registry (no PyObject ownership crosses the ABI). Works both
+// in-process (loaded into an existing interpreter, e.g. the tests) and as
+// a standalone embedding (Py_Initialize on first use) — on TPU the
+// "inference engine" below the Python layer is the XLA/PJRT executable
+// the predictor compiled, so embedding the runtime IS the deployment
+// shape, not a shortcut.
+//
+// Build: make capi  (links against libpython; see Makefile).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+bool g_we_initialized = false;
+std::string g_last_error;
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+void ensure_python() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    // release the GIL acquired by initialization so other threads'
+    // PyGILState_Ensure can proceed (standalone embedding shape)
+    PyEval_SaveThread();
+  }
+}
+
+PyObject* bridge() {  // borrowed-style: cached module, GIL held by caller
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+    if (mod == nullptr) {
+      PyErr_Print();
+    }
+  }
+  return mod;
+}
+
+void record_py_error(const char* where) {
+  g_last_error = std::string(where) + ": python call failed";
+  if (PyErr_Occurred()) {
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    if (v != nullptr) {
+      PyObject* s = PyObject_Str(v);
+      if (s != nullptr) {
+        g_last_error += std::string(": ") + PyUnicode_AsUTF8(s);
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(t);
+    Py_XDECREF(v);
+    Py_XDECREF(tb);
+  }
+}
+
+// call a bridge function returning long
+long call_long(const char* fn, const char* fmt, ...) {
+  Gil gil;
+  PyObject* mod = bridge();
+  if (mod == nullptr) return -1;
+  va_list vl;
+  va_start(vl, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, vl);
+  va_end(vl);
+  if (args == nullptr) {
+    record_py_error(fn);
+    return -1;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  PyObject* r = f ? PyObject_CallObject(f, args) : nullptr;
+  Py_XDECREF(f);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    record_py_error(fn);
+    return -1;
+  }
+  long out = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return out;
+}
+
+// call a bridge function returning str/bytes, copied into out
+bool call_str(const char* fn, std::string* out, const char* fmt, ...) {
+  Gil gil;
+  PyObject* mod = bridge();
+  if (mod == nullptr) return false;
+  va_list vl;
+  va_start(vl, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, vl);
+  va_end(vl);
+  if (args == nullptr) {
+    record_py_error(fn);
+    return false;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  PyObject* r = f ? PyObject_CallObject(f, args) : nullptr;
+  Py_XDECREF(f);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    record_py_error(fn);
+    return false;
+  }
+  if (PyBytes_Check(r)) {
+    out->assign(PyBytes_AsString(r), PyBytes_Size(r));
+  } else {
+    const char* s = PyUnicode_AsUTF8(r);
+    out->assign(s ? s : "");
+  }
+  Py_DECREF(r);
+  return true;
+}
+
+struct Predictor {
+  long handle;
+  // fixed per-API slots: returned pointers stay valid until the SAME
+  // API is called again on this predictor (no vector reallocation, no
+  // unbounded growth)
+  std::string in_names, out_names, meta;
+};
+
+}  // namespace
+
+extern "C" {
+
+typedef struct PD_Predictor PD_Predictor;
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+PD_Predictor* PD_PredictorCreate(const char* prog_file,
+                                 const char* params_file) {
+  ensure_python();
+  long h = call_long("create", "(ss)", prog_file,
+                     params_file ? params_file : "");
+  if (h < 0) return nullptr;
+  auto* p = new Predictor();
+  p->handle = h;
+  return reinterpret_cast<PD_Predictor*>(p);
+}
+
+void PD_PredictorDestroy(PD_Predictor* pred) {
+  if (pred == nullptr) return;
+  auto* p = reinterpret_cast<Predictor*>(pred);
+  call_long("destroy", "(l)", p->handle);
+  delete p;
+}
+
+// ';'-separated name lists. Returned pointers stay valid until the same
+// getter is called again on this predictor.
+const char* PD_PredictorGetInputNames(PD_Predictor* pred) {
+  auto* p = reinterpret_cast<Predictor*>(pred);
+  std::string s;
+  if (!call_str("input_names", &s, "(l)", p->handle)) return "";
+  p->in_names.swap(s);
+  return p->in_names.c_str();
+}
+
+const char* PD_PredictorGetOutputNames(PD_Predictor* pred) {
+  auto* p = reinterpret_cast<Predictor*>(pred);
+  std::string s;
+  if (!call_str("output_names", &s, "(l)", p->handle)) return "";
+  p->out_names.swap(s);
+  return p->out_names.c_str();
+}
+
+// dtype: "float32" | "int32" | ... (numpy names)
+int PD_PredictorSetInput(PD_Predictor* pred, const char* name,
+                         const int64_t* shape, int ndim, const void* data,
+                         int64_t nbytes, const char* dtype) {
+  auto* p = reinterpret_cast<Predictor*>(pred);
+  std::string shape_csv;
+  for (int i = 0; i < ndim; ++i) {
+    if (i) shape_csv += ",";
+    shape_csv += std::to_string(shape[i]);
+  }
+  return static_cast<int>(call_long(
+      "set_input", "(lsssy#)", p->handle, name, shape_csv.c_str(), dtype,
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes)));
+}
+
+int PD_PredictorRun(PD_Predictor* pred) {
+  auto* p = reinterpret_cast<Predictor*>(pred);
+  return static_cast<int>(call_long("run", "(l)", p->handle));
+}
+
+// Two-phase output fetch: query meta ("dtype|nbytes|d0,d1,.."), then copy.
+const char* PD_PredictorGetOutputMeta(PD_Predictor* pred, const char* name) {
+  auto* p = reinterpret_cast<Predictor*>(pred);
+  std::string s;
+  if (!call_str("output_meta", &s, "(ls)", p->handle, name)) return "";
+  p->meta.swap(s);
+  return p->meta.c_str();
+}
+
+int PD_PredictorCopyOutput(PD_Predictor* pred, const char* name, void* buf,
+                           int64_t buf_bytes) {
+  auto* p = reinterpret_cast<Predictor*>(pred);
+  std::string s;
+  if (!call_str("output_bytes", &s, "(ls)", p->handle, name)) return -1;
+  if (static_cast<int64_t>(s.size()) > buf_bytes) {
+    g_last_error = "PD_PredictorCopyOutput: buffer too small";
+    return -1;
+  }
+  std::memcpy(buf, s.data(), s.size());
+  return static_cast<int>(s.size());
+}
+
+}  // extern "C"

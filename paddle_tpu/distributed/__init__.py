@@ -56,3 +56,6 @@ from . import watchdog  # noqa: E402,F401
 from .watchdog import comm_watchdog  # noqa: E402,F401
 from . import spmd_rules  # noqa: E402,F401
 from .spmd_rules import get_spmd_rule, DistTensorSpec  # noqa: E402,F401
+from . import auto_parallel  # noqa: E402,F401
+from .auto_parallel import (  # noqa: E402,F401
+    DistModel, Engine, Strategy, to_static)

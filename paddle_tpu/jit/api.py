@@ -166,9 +166,22 @@ class StaticFunction:
 def to_static(function=None, input_spec=None, build_strategy=None,
               full_graph=True, backend=None):
     """paddle.jit.to_static (reference jit/api.py:171). Works as decorator or
-    wrapper over a function or a Layer (compiles its forward)."""
+    wrapper over a function or a Layer (compiles its forward).
+
+    full_graph=True (default) uses the whole-graph tracer (StaticFunction —
+    data-dependent Python control flow is not allowed, reference AST path).
+    full_graph=False uses SOT-lite (jit/sot.py): eager trace + compiled
+    segments with graph-break guards, surviving data-dependent control
+    flow (reference sot/translate.py)."""
 
     def wrap(fn):
+        if not full_graph:
+            from .sot import SOTFunction
+            if isinstance(fn, Layer):
+                layer = fn
+                sf = SOTFunction(lambda *a, **k: layer.forward(*a, **k))
+                return _LayerStaticWrapper(layer, sf)
+            return SOTFunction(fn)
         if isinstance(fn, Layer):
             layer = fn
             sf = StaticFunction(lambda *a, **k: layer.forward(*a, **k), layer)

@@ -242,6 +242,10 @@ _OP_SPAN_HOOK = None
 # into the current Program instead of executing.
 _STATIC_MODE_FN = None
 
+# SOT-lite integration (jit/sot.py): while tracing, every eager op is
+# mirrored into the recorder's linear trace (ops still execute normally).
+_SOT_RECORDER = None
+
 
 def set_op_span_hook(hook):
     global _OP_SPAN_HOOK
@@ -340,6 +344,9 @@ def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
                 raise FloatingPointError(f"NaN/Inf in output of op '{schema.name}'")
 
     outs = [Tensor(a) for a in out_arrays]
+
+    if _SOT_RECORDER is not None:
+        _SOT_RECORDER.on_op(schema, in_tensors, attrs, present, outs)
 
     if need_grad:
         if hashable:

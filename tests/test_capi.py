@@ -1,0 +1,133 @@
+"""C-ABI surfaces: inference C API + custom-device plugin.
+
+Model: the reference's capi tests (test/capi usage of pd_inference_api.h)
+and the hardware-free plugin test
+(test/custom_runtime/test_custom_cpu_plugin.py — load fake device, alloc /
+copy / stats through the C interface table)."""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_LIBDIR = os.path.join(os.path.dirname(paddle.__file__), "native", "_lib")
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(paddle.__file__)),
+                     "csrc")
+
+
+def _ensure(target: str, lib: str) -> str:
+    path = os.path.join(_LIBDIR, lib)
+    if not os.path.exists(path):
+        r = subprocess.run(["make", "-s", target], cwd=_CSRC,
+                           capture_output=True, timeout=180)
+        if r.returncode != 0 or not os.path.exists(path):
+            pytest.skip(f"cannot build {lib}: {r.stderr.decode()[:200]}")
+    return path
+
+
+class TestCustomDevicePlugin:
+    def test_fake_cpu_plugin_roundtrip(self):
+        from paddle_tpu.utils.custom_device import (get_custom_device,
+                                                    load_custom_device)
+        path = _ensure("fake_device", "libfake_cpu_device.so")
+        dev = load_custom_device(path)
+        assert dev.device_type == "fake_cpu"
+        assert get_custom_device("fake_cpu") is dev
+        assert dev.device_count() == 1
+        total0, free0 = dev.memory_stats()
+        ptr = dev.alloc(1024)
+        assert ptr
+        _, free1 = dev.memory_stats()
+        assert free0 - free1 == 1024          # stats track the allocation
+        payload = np.arange(256, dtype=np.float32).tobytes()
+        dev.copy_h2d(ptr, payload)
+        back = dev.copy_d2h(ptr, len(payload))
+        assert back == payload
+        dev.synchronize()
+        dev.free(ptr, 1024)
+        _, free2 = dev.memory_stats()
+        assert free2 == free0
+        dev.finalize()
+
+
+class TestInferenceCAPI:
+    def _export_model(self, tmp_path) -> str:
+        import paddle_tpu.nn as nn
+        import paddle_tpu.static as static
+        paddle.seed(0)
+        prefix = str(tmp_path / "linmodel")
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", (2, 4), "float32")
+            lin = nn.Linear(4, 3)
+            out = lin(x)
+        exe = static.Executor()
+        static.save_inference_model(prefix, [x], [out], exe, program=prog)
+        return prefix
+
+    def test_capi_end_to_end(self, tmp_path):
+        lib_path = _ensure("capi", "libpaddle_tpu_capi.so")
+        prefix = self._export_model(tmp_path)
+        lib = ctypes.CDLL(lib_path)
+        lib.PD_PredictorCreate.restype = ctypes.c_void_p
+        lib.PD_PredictorCreate.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.PD_PredictorGetInputNames.restype = ctypes.c_char_p
+        lib.PD_PredictorGetInputNames.argtypes = [ctypes.c_void_p]
+        lib.PD_PredictorGetOutputNames.restype = ctypes.c_char_p
+        lib.PD_PredictorGetOutputNames.argtypes = [ctypes.c_void_p]
+        lib.PD_PredictorSetInput.restype = ctypes.c_int
+        lib.PD_PredictorSetInput.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_char_p]
+        lib.PD_PredictorRun.restype = ctypes.c_int
+        lib.PD_PredictorRun.argtypes = [ctypes.c_void_p]
+        lib.PD_PredictorGetOutputMeta.restype = ctypes.c_char_p
+        lib.PD_PredictorGetOutputMeta.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_char_p]
+        lib.PD_PredictorCopyOutput.restype = ctypes.c_int
+        lib.PD_PredictorCopyOutput.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_int64]
+        lib.PD_GetLastError.restype = ctypes.c_char_p
+
+        pred = lib.PD_PredictorCreate(prefix.encode(), b"")
+        assert pred, lib.PD_GetLastError().decode()
+        in_names = lib.PD_PredictorGetInputNames(pred).decode().split(";")
+        out_names = lib.PD_PredictorGetOutputNames(pred).decode().split(";")
+        assert in_names == ["x"] and len(out_names) == 1
+
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        shape = (ctypes.c_int64 * 2)(2, 4)
+        rc = lib.PD_PredictorSetInput(
+            pred, b"x", shape, 2, x.ctypes.data_as(ctypes.c_void_p),
+            x.nbytes, b"float32")
+        assert rc == 0, lib.PD_GetLastError().decode()
+        assert lib.PD_PredictorRun(pred) == 0, \
+            lib.PD_GetLastError().decode()
+
+        meta = lib.PD_PredictorGetOutputMeta(
+            pred, out_names[0].encode()).decode()
+        dtype, nbytes, shape_s = meta.split("|")
+        assert dtype == "float32" and shape_s == "2,3"
+        buf = ctypes.create_string_buffer(int(nbytes))
+        n = lib.PD_PredictorCopyOutput(pred, out_names[0].encode(), buf,
+                                       int(nbytes))
+        assert n == int(nbytes)
+        out = np.frombuffer(buf.raw, np.float32).reshape(2, 3)
+
+        # golden: run the same artifact through the Python predictor
+        from paddle_tpu.inference import Config, Predictor
+        p2 = Predictor(Config(prefix))
+        h = p2.get_input_handle("x")
+        h.copy_from_cpu(x)
+        p2.run()
+        ref = p2.get_output_handle(p2.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-6)
+
+        lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
+        lib.PD_PredictorDestroy(pred)
