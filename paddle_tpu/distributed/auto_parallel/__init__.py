@@ -88,7 +88,8 @@ class Engine:
         self._eval_fn = None
         self._predict_fn = None
         self._history: List[float] = []
-        self._sample_split = 1
+        self._sample_split = 1        # train batch split
+        self._eval_split = 1          # eval batch split (independent)
 
     # -- step builders --------------------------------------------------------
     def _loss_fn(self):
@@ -116,7 +117,7 @@ class Engine:
             model, loss_fn = self._model, self._loss_fn()
 
             def step(*batch):
-                n = self._sample_split
+                n = self._eval_split
                 ins, lbls = batch[:n], batch[n:]
                 with no_grad():
                     out = model(*ins)
@@ -193,7 +194,7 @@ class Engine:
 
     def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
                  steps=None, log_freq=10, verbose=1):
-        self._sample_split = valid_sample_split or self._sample_split or 1
+        self._eval_split = valid_sample_split or self._sample_split or 1
         loader = self._loader_of(valid_data, batch_size)
         step = self._ensure_eval()
         for m in self._metrics:
@@ -205,7 +206,7 @@ class Engine:
             batch = batch if isinstance(batch, (list, tuple)) else (batch,)
             loss, outs = step(*batch)
             losses.append(float(loss._data))
-            n = self._sample_split
+            n = self._eval_split
             for m in self._metrics:
                 m.update(m.compute(outs[0], *batch[n:]))
         result = {"loss": float(np.mean(losses)) if losses else float("nan")}

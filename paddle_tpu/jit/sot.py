@@ -150,6 +150,7 @@ class _Segment:
         self.out_syms = list(out_syms)
         self.n_keys = sum(1 for n in nodes if n.keyed)
         self._jit = None
+        self._bwd_jits: Dict[tuple, Any] = {}
 
     def _raw(self, arrays, ext_arrays, keys):
         env: Dict[int, Any] = dict(zip(self.in_syms, arrays))
@@ -186,32 +187,50 @@ class _Segment:
             out_arrays = self._jit(arrays, ext_arrays, keys)
             return [Tensor(a) for a in out_arrays]
         prim = arrays + ext_arrays
-        dmask = [not t._stop_gradient
-                 and jnp.issubdtype(t._data.dtype, jnp.inexact)
-                 for t in all_in]
-
-        def f_all(*dp):
-            it = iter(dp)
-            full = [next(it) if d else p for p, d in zip(prim, dmask)]
-            na = len(arrays)
-            return self._raw(tuple(full[:na]), tuple(full[na:]), keys)
-
-        # ONE forward pass: jax.vjp computes outputs + residuals together
-        out_arrays, vjp_fn = jax.vjp(
-            f_all, *(p for p, d in zip(prim, dmask) if d))
+        dmask = tuple(not t._stop_gradient
+                      and jnp.issubdtype(t._data.dtype, jnp.inexact)
+                      for t in all_in)
+        # forward: the same cached jitted program as the no-grad path
+        if self._jit is None:
+            self._jit = jax.jit(self._raw)
+        out_arrays = self._jit(arrays, ext_arrays, keys)
         outs = [Tensor(a) for a in out_arrays]
         out_avals = [(a.shape, a.dtype) for a in out_arrays]
+        na = len(arrays)
 
-        def vjp_callable(_primals, cts, _vjp=vjp_fn, _avals=out_avals,
-                         _dmask=tuple(dmask)):
-            full_cts = []
-            for c, (shp, dt) in zip(cts, _avals):
-                if jnp.issubdtype(dt, jnp.inexact):
-                    full_cts.append(c if c is not None
-                                    else jnp.zeros(shp, dt))
-                else:   # integer outputs take symbolic-zero cotangents
-                    full_cts.append(np.zeros(shp, jax.dtypes.float0))
-            gs = iter(_vjp(tuple(full_cts)))
+        # backward: one cached jitted vjp per dmask (recomputes the segment
+        # forward inside the compiled program — remat-style, but compiled,
+        # unlike an eager jax.vjp which replays ops unjitted every call)
+        bwd = self._bwd_jits.get(dmask)
+        if bwd is None:
+            def bwd_fn(diff_p, other_p, keys, cts, _dmask=dmask, _na=na):
+                di, oi = iter(diff_p), iter(other_p)
+                frozen = [next(di) if d else next(oi) for d in _dmask]
+
+                def f_diff(*dp):
+                    it = iter(dp)
+                    full = [next(it) if d else f
+                            for f, d in zip(frozen, _dmask)]
+                    outs_ = self._raw(tuple(full[:_na]), tuple(full[_na:]),
+                                      keys)
+                    return tuple(o for o in outs_
+                                 if jnp.issubdtype(o.dtype, jnp.inexact))
+
+                _, vjp = jax.vjp(
+                    f_diff, *(p for p, d in zip(frozen, _dmask) if d))
+                return vjp(tuple(cts))
+            bwd = jax.jit(bwd_fn)
+            self._bwd_jits[dmask] = bwd
+
+        def vjp_callable(_primals, cts, _bwd=bwd, _avals=out_avals,
+                         _dmask=dmask, _keys=keys):
+            cts_f = tuple(
+                (c if c is not None else jnp.zeros(shp, dt))
+                for c, (shp, dt) in zip(cts, _avals)
+                if jnp.issubdtype(dt, jnp.inexact))
+            diff_p = tuple(p for p, d in zip(_primals, _dmask) if d)
+            other_p = tuple(p for p, d in zip(_primals, _dmask) if not d)
+            gs = iter(_bwd(diff_p, other_p, _keys, cts_f))
             return [next(gs) if d else None for d in _dmask]
 
         engine.record_node("sot_segment", vjp_callable, prim, all_in, outs)
@@ -364,10 +383,6 @@ class SOTFunction:
         self._cache: Dict[Tuple, _TraceEntry] = {}
         self.trace_count = 0
         self.replay_count = 0
-
-    def _key(self, flat, treedef):
-        return (treedef,
-                tuple((tuple(t.shape), str(t.dtype)) for t in flat))
 
     def __call__(self, *args, **kwargs):
         flat_all, treedef = jax.tree.flatten(

@@ -424,11 +424,24 @@ def make_op_fn(schema: OpSchema) -> Callable:
 
 
 def call_op(name: str, *args, **kwargs):
-    return _OP_FNS[name](*args, **kwargs)
+    fn = _OP_FNS.get(name)
+    if fn is None:
+        fn = _resolve_compat(name)
+    return fn(*args, **kwargs)
 
 
 def get_op(name: str) -> Callable:
-    return _OP_FNS[name]
+    fn = _OP_FNS.get(name)
+    return fn if fn is not None else _resolve_compat(name)
+
+
+def _resolve_compat(name: str) -> Callable:
+    """Legacy-name fallback (op_compat.py — the op_compat.yaml analog)."""
+    from .op_compat import resolve
+    target = resolve(name)
+    if target is None or target not in _OP_FNS:
+        raise KeyError(f"unknown op '{name}' (no op_compat mapping)")
+    return _OP_FNS[target]
 
 
 def build_ops(yaml_path: str) -> Dict[str, Callable]:
@@ -436,6 +449,8 @@ def build_ops(yaml_path: str) -> Dict[str, Callable]:
     from . import kernels  # noqa: F401  — registers all kernels
     OPS.update(load_schemas(yaml_path))
     for name, schema in OPS.items():
+        if schema.inplace_of:
+            continue  # Tensor method over the base op (_attach_inplace_ops)
         if schema.kernel not in KERNELS:
             raise RuntimeError(f"op '{name}': kernel '{schema.kernel}' not registered")
         fn = make_op_fn(schema)

@@ -17,3 +17,6 @@ from . import rnn  # noqa: F401
 from . import serving  # noqa: F401
 from . import math_ext  # noqa: F401
 from . import moe  # noqa: F401
+from . import extra_math  # noqa: F401
+from . import extra_nn  # noqa: F401
+from . import extra_misc  # noqa: F401

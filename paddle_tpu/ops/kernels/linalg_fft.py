@@ -58,7 +58,9 @@ def eigvals_kernel(x):
 @register_kernel("lu")
 def lu_kernel(x):
     lu, piv = jax.scipy.linalg.lu_factor(x)
-    return lu, piv
+    # reference lu returns 1-based LAPACK pivots (python/paddle linalg.lu);
+    # jax's are 0-based
+    return lu, piv.astype(jnp.int32) + 1
 
 
 @register_kernel("det")

@@ -1,0 +1,146 @@
+"""Legacy/reference op-name mapping — the op_compat.yaml analog.
+
+Reference counterpart: `paddle/phi/api/yaml/op_compat.yaml` maps legacy
+(fluid-era) operator names and parameter spellings onto the modern phi op
+set, so old programs and reference-named call sites keep resolving. Here
+the table maps reference op names (both legacy `elementwise_*`/`reduce_*`
+spellings and modern names whose local spelling differs) onto this
+framework's ops; `resolve()` is consulted by `call_op`/`get_op` as a
+fallback, so `call_op("elementwise_add", x, y)` works.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# reference name -> our op name
+OP_COMPAT: Dict[str, str] = {
+    # legacy elementwise_* family (op_compat.yaml elementwise entries)
+    "elementwise_add": "add",
+    "elementwise_sub": "subtract",
+    "elementwise_mul": "multiply",
+    "elementwise_div": "divide",
+    "elementwise_pow": "pow",
+    "elementwise_max": "maximum",
+    "elementwise_min": "minimum",
+    "elementwise_mod": "remainder",
+    "elementwise_floordiv": "floor_divide",
+    "elementwise_fmax": "fmax",
+    "elementwise_fmin": "fmin",
+    "elementwise_heaviside": "heaviside",
+    # legacy reduce_* family
+    "reduce_sum": "sum",
+    "reduce_mean": "mean",
+    "reduce_max": "max",
+    "reduce_min": "min",
+    "reduce_prod": "prod",
+    "reduce_all": "all",
+    "reduce_any": "any",
+    # legacy misc renames (op_compat.yaml)
+    "matmul_v2": "matmul",
+    "fill_constant": "full",
+    "fill_any_like": "full_like",
+    "lookup_table_v2": "embedding",
+    "softmax_with_cross_entropy": "softmax_with_cross_entropy",
+    "top_k_v2": "topk",
+    "arg_max": "argmax",
+    "arg_min": "argmin",
+    "hard_swish": "hardswish",
+    "hard_sigmoid": "hardsigmoid",
+    "hard_shrink": "hardshrink",
+    "soft_shrink": "softshrink",
+    "softshrink": "softshrink",
+    "tanh_shrink": "tanh_shrink",
+    "brelu": "clip",
+    "expand_v2": "expand",
+    "expand_as_v2": "expand_as",
+    "tile": "tile",
+    "flatten_contiguous_range": "flatten",
+    "reshape2": "reshape",
+    "transpose2": "transpose",
+    "squeeze2": "squeeze",
+    "unsqueeze2": "unsqueeze",
+    "slice": "slice",
+    "strided_slice": "strided_slice",
+    "one_hot_v2": "one_hot",
+    "pad2d": "pad",
+    "depthwise_conv2d": "conv2d",
+    "mul": "matmul",
+    "flip": "flip",
+    "reverse": "reverse",
+    "range": "arange",
+    "linspace": "linspace",
+    "gaussian_random": "randn",
+    "uniform_random": "rand",
+    "truncated_gaussian_random": "truncated_gaussian_random",
+    "grid_sampler": "grid_sample",
+    "bilinear_interp_v2": "bilinear_interp",
+    "nearest_interp_v2": "nearest_interp",
+    "bicubic_interp_v2": "bicubic_interp",
+    "linear_interp_v2": "linear_interp",
+    "trilinear_interp_v2": "trilinear_interp",
+    "max_pool2d_v2": "pool2d",
+    "unfold": "unfold",
+    "norm": "p_norm",
+    "frobenius_norm": "frobenius_norm",
+    "clip_by_norm": "clip_by_norm",
+    "sum": "add_n",                      # legacy `sum` op = add_n
+    "mean": "mean_all",                  # legacy `mean` op = full mean
+    "shape": "shape_op",
+    "size": "numel",
+    "warpctc": "ctc_loss",
+    "flash_attn": "flash_attention",
+    "memory_efficient_attention": "memory_efficient_attention",
+    "fused_rotary_position_embedding": "rope",
+    "dropout_nd": "dropout",
+    "log_softmax": "log_softmax",
+    "sigmoid_cross_entropy_with_logits": "sigmoid_cross_entropy_with_logits",
+    "cross_entropy2": "softmax_with_cross_entropy",
+    "tril_triu": "tril",
+    "where_index": "nonzero",
+    "masked_select": "masked_select",
+    "index_select": "index_select",
+    "roi_align": "roi_align",
+    "c_allgather": "c_concat",      # GSPMD: gather == reshard-to-replicated
+    "c_reduce_sum": "c_allreduce_sum",
+    "c_sync_calc_stream": "c_identity",
+    "c_sync_comm_stream": "c_identity",
+    "assign_value": "assign_value",
+    "split_with_num": "split",
+    "pull_box_sparse": "embedding",
+    # optimizer op family: reference trailing-underscore eager names
+    "sgd": "sgd_op",
+    "sgd_": "sgd_op",
+    "momentum": "momentum_op",
+    "momentum_": "momentum_op",
+    "adam": "adam_op",
+    "adam_": "adam_op",
+    "adamw": "adamw_op",
+    "adamw_": "adamw_op",
+    "adagrad": "adagrad_op",
+    "adagrad_": "adagrad_op",
+    "adadelta": "adadelta_op",
+    "adadelta_": "adadelta_op",
+    "adamax": "adamax_op",
+    "adamax_": "adamax_op",
+    "rmsprop": "rmsprop_op",
+    "rmsprop_": "rmsprop_op",
+    "lamb": "lamb_op",
+    "lamb_": "lamb_op",
+    "asgd_": "asgd_op",
+    "rprop_": "rprop_op",
+    "check_finite_and_unscale_": "check_finite_and_unscale_op",
+    "update_loss_scaling_": "update_loss_scaling_op",
+    "exponential_": "exponential",
+    "uniform_inplace": "rand",
+    "gaussian_inplace": "randn",
+}
+
+
+def resolve(name: str) -> Optional[str]:
+    """Our name for a reference-spelled op, or None if unmapped."""
+    return OP_COMPAT.get(name)
+
+
+def has_compat(name: str) -> bool:
+    return name in OP_COMPAT
