@@ -47,15 +47,15 @@ def main():
     on_tpu = dev.platform != "cpu"
 
     if on_tpu:
-        # sized for one v5e chip (16G HBM): ~730M params, bf16 + fp32 master.
+        # sized for one v5e chip (16G HBM): ~620M params, bf16 + fp32 master.
         # Wide layers (hidden 3072) keep the MXU tiled efficiently — measured
         # sweep on v5e: hidden 1024/12L -> 38.6% MFU, 2048/8L -> 43.6%,
-        # 2560/6L -> 46.6%, 3072/5L -> 49.1% (batch 6, seq 2048, no remat;
-        # larger configs OOM the 16G HBM). recompute off: activations fit
-        # once attention runs through the Pallas flash kernel (no
-        # [b,h,s,s] materialisation).
+        # 2560/6L -> 46.6%, 3072/5L/b6 -> 49.1%, 3072/4L/b8 -> 50.4%
+        # (seq 2048, no remat; b10 regresses to 47.5%, larger configs OOM
+        # the 16G HBM). recompute off: activations fit once attention runs
+        # through the Pallas flash kernel (no [b,h,s,s] materialisation).
         hidden = int(os.environ.get("PTPU_BENCH_HIDDEN", 3072))
-        layers = int(os.environ.get("PTPU_BENCH_LAYERS", 5))
+        layers = int(os.environ.get("PTPU_BENCH_LAYERS", 4))
         heads = int(os.environ.get("PTPU_BENCH_HEADS", hidden // 64))
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=hidden,
@@ -64,7 +64,7 @@ def main():
             num_hidden_layers=layers, num_attention_heads=heads,
             num_key_value_heads=heads // 2, max_position_embeddings=2048,
             dtype="bfloat16", recompute=False)
-        batch = int(os.environ.get("PTPU_BENCH_BATCH", 6))
+        batch = int(os.environ.get("PTPU_BENCH_BATCH", 8))
         seq = int(os.environ.get("PTPU_BENCH_SEQ", 2048))
         steps = int(os.environ.get("PTPU_BENCH_STEPS", 10))
         paddle.set_default_dtype("bfloat16")
