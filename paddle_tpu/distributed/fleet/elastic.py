@@ -91,7 +91,10 @@ class ElasticManager:
         return alive
 
     def pod_status(self) -> str:
-        alive = self.alive_nodes()
+        # nodes under preemption notice leave the membership immediately,
+        # so the next relaunch re-ranks without them (reference scale-in)
+        preempted = set(self.preempted_nodes())
+        alive = [n for n in self.alive_nodes() if n not in preempted]
         n = len(alive)
         if n < self.np_min:
             return ElasticStatus.HOLD
@@ -116,3 +119,111 @@ class ElasticManager:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
+
+    # -- preemption notices ---------------------------------------------------
+    # TPU-VM preemptions arrive as a SIGTERM (spot/maintenance notice) a few
+    # tens of seconds before the VM dies — the reference handles the analog
+    # via etcd watches + launcher relaunch (manager.py:221-256 + elastic
+    # level). Here a notice (signal or explicit call) is broadcast into the
+    # store so every peer sees it, and the training loop checkpoints and
+    # exits cleanly via should_checkpoint()/is_preempted().
+
+    # Notices expire after `notice_ttl` seconds, so a relaunched generation
+    # (same job_id) resumes training instead of checkpointing forever, and
+    # a node whose maintenance notice was cancelled rejoins membership.
+    notice_ttl: float = 120.0
+
+    def _notice_fresh(self, raw) -> bool:
+        return raw is not None and \
+            time.time() - float(raw) < self.notice_ttl
+
+    def notify_preemption(self, node_id: Optional[str] = None):
+        """Record a preemption notice for `node_id` (default: this node)."""
+        nid = node_id or self.node_id
+        now = repr(time.time())
+        self.store.set(f"{self.prefix}/preempt/{nid}", now)
+        # job-wide flag: should_checkpoint() reads ONE key per step, not
+        # one per node (train-loop hot path)
+        self.store.set(f"{self.prefix}/preempt_any", now)
+
+    def preempted_nodes(self) -> List[str]:
+        return [n for n in self._known_nodes()
+                if self._notice_fresh(self.store.get(
+                    f"{self.prefix}/preempt/{n}", wait=False))]
+
+    def is_preempted(self) -> bool:
+        """True when THIS node has received a (fresh) preemption notice."""
+        return self._notice_fresh(self.store.get(
+            f"{self.prefix}/preempt/{self.node_id}", wait=False))
+
+    def should_checkpoint(self) -> bool:
+        """True when any member is under a fresh notice — the whole job
+        should checkpoint now, before membership shrinks. One store read."""
+        return self._notice_fresh(self.store.get(
+            f"{self.prefix}/preempt_any", wait=False))
+
+
+class PreemptionHandler:
+    """Wires an OS preemption signal into the elastic manager.
+
+    reference analog: launcher Master heartbeat watch + etcd lease expiry
+    (launch/controllers/master.py:268-288); on TPU-VMs the earliest signal
+    is SIGTERM.
+
+    The signal handler itself only sets a flag — store I/O from inside a
+    signal handler could deadlock on the TCPStore client's non-reentrant
+    lock (the handler runs in the main thread, possibly mid-request).
+    `process()` does the actual broadcast + callback and belongs in the
+    training loop:
+
+        handler = PreemptionHandler(manager, on_notice=save_ckpt).install()
+        ...
+        if handler.process() or manager.should_checkpoint():  # per step
+            save_ckpt(); exit
+    """
+
+    def __init__(self, manager: ElasticManager,
+                 on_notice: Optional[Callable[[], None]] = None):
+        self.manager = manager
+        self.on_notice = on_notice
+        self._prev_handler = None
+        self._signum = None
+        self._flag = threading.Event()
+        self._processed = False
+        self.notices = 0
+
+    def install(self, signum: Optional[int] = None):
+        import signal
+        self._signum = signum if signum is not None else signal.SIGTERM
+        self._prev_handler = signal.signal(self._signum, self._handle)
+        return self
+
+    def _handle(self, signum, frame):
+        # async-signal-safe: flag only, no locks, no sockets
+        self.notices += 1
+        self._flag.set()
+        if callable(self._prev_handler):
+            self._prev_handler(signum, frame)
+
+    def pending(self) -> bool:
+        return self._flag.is_set() and not self._processed
+
+    def process(self) -> bool:
+        """Broadcast + run the callback if a notice arrived. Returns True
+        when this node is under notice. Call once per training step."""
+        if not self.pending():
+            return self._processed
+        self._processed = True
+        try:
+            self.manager.notify_preemption()
+        except Exception:
+            pass  # store may already be gone; local callback still runs
+        if self.on_notice is not None:
+            self.on_notice()
+        return True
+
+    def uninstall(self):
+        import signal
+        if self._signum is not None and self._prev_handler is not None:
+            signal.signal(self._signum, self._prev_handler)
+            self._signum = None

@@ -303,3 +303,19 @@ def sum(x: SparseCooTensor, axis: Optional[int] = None,
         return Tensor(jnp.sum(x._values))
     dense = x.to_dense()._data
     return Tensor(jnp.sum(dense, axis=axis, keepdims=keepdim))
+
+
+# -- BCSR (block-sparse) ------------------------------------------------------
+
+def bcsr_from_dense(dense, block_m: int, block_k: int, tol: float = 0.0):
+    """Tile a dense matrix into block-CSR (see pallas/bcsr_spmm.py)."""
+    from ..ops.kernels.pallas.bcsr_spmm import bcsr_from_dense as _f
+    return _f(_as_array(dense), block_m, block_k, tol)
+
+
+def bcsr_matmul(crows, cols, values, x) -> Tensor:
+    """Block-CSR sparse @ dense via the Pallas BCSR SpMM kernel — MXU
+    [bm x bk] @ [bk x bn] products per nonzero block (SURVEY §2.2 "BCSR
+    Pallas where hot"; the unstructured path stays `matmul` above)."""
+    from ..ops.kernels.pallas.bcsr_spmm import bcsr_spmm as _f
+    return Tensor(_f(crows, cols, _as_array(values), _as_array(x)))

@@ -249,9 +249,36 @@ class TestModels:
         x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
         assert model(x).shape == [1, 7]
 
+    def test_densenet121(self):
+        model = models.densenet121(num_classes=4)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+        assert model(x).shape == [1, 4]
+
+    def test_shufflenet_v2(self):
+        model = models.shufflenet_v2_x0_25(num_classes=5)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+        assert model(x).shape == [1, 5]
+
+    def test_googlenet(self):
+        model = models.googlenet(num_classes=3)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 96, 96).astype("float32"))
+        assert model(x).shape == [1, 3]
+
+    def test_inception_v3(self):
+        model = models.inception_v3(num_classes=3)
+        model.eval()
+        x = paddle.to_tensor(
+            np.random.randn(1, 3, 299, 299).astype("float32"))
+        assert model(x).shape == [1, 3]
+
     def test_pretrained_raises(self):
         with pytest.raises(RuntimeError, match="pretrained"):
             models.resnet18(pretrained=True)
+        with pytest.raises(RuntimeError, match="pretrained"):
+            models.densenet121(pretrained=True)
 
     def test_resnet_train_step(self):
         # config-1 smoke: one SGD step of ResNet-18 on fake CIFAR batch
