@@ -43,6 +43,9 @@ class ElasticManager:
     def register(self):
         """Announce this node (membership index + first heartbeat) and start
         the heartbeat lease."""
+        # a relaunched generation must not re-observe its own pre-restart
+        # preemption notice (crash-loop: checkpoint-and-exit every gen)
+        self._clear_own_notice()
         self.store.set(f"{self.prefix}/nodes/{self.node_id}", self.node_id)
         self._register_index()
         self._beat()
@@ -136,6 +139,17 @@ class ElasticManager:
     def _notice_fresh(self, raw) -> bool:
         return raw is not None and \
             time.time() - float(raw) < self.notice_ttl
+
+    def _clear_own_notice(self):
+        try:
+            self.store.delete(f"{self.prefix}/preempt/{self.node_id}")
+        except Exception:
+            return
+        # drop the job-wide flag too when no other node holds a fresh notice
+        if not any(self._notice_fresh(self.store.get(
+                f"{self.prefix}/preempt/{n}", wait=False))
+                   for n in self._known_nodes() if n != self.node_id):
+            self.store.delete(f"{self.prefix}/preempt_any")
 
     def notify_preemption(self, node_id: Optional[str] = None):
         """Record a preemption notice for `node_id` (default: this node)."""

@@ -776,3 +776,37 @@ def prior_box_kernel(input, image, min_sizes=(), max_sizes=(),
     var = np.tile(np.asarray(variances, np.float32),
                   (fh, fw, num_priors, 1))
     return jnp.asarray(out), jnp.asarray(var)
+
+
+@register_kernel("batch_norm")
+def batch_norm_kernel(x, mean, variance, scale=None, bias=None,
+                      is_test=False, momentum=0.9, epsilon=1e-05,
+                      data_format="NCHW", use_global_stats=False):
+    """Unified batch_norm op (reference batch_norm/batch_norm_ — the
+    per-mode kernels batch_norm_train/infer stay the Layer path). Returns
+    (out, mean_out, variance_out, saved_mean, saved_variance): running
+    stats fold the batch stats by `momentum` in training mode."""
+    from .nn import batch_norm_infer, batch_norm_train
+    if is_test or use_global_stats:
+        out = batch_norm_infer(x, mean, variance, scale, bias, epsilon,
+                               data_format)
+        return out, mean, variance, mean, variance
+    out, bmean, bvar = batch_norm_train(x, scale, bias, epsilon,
+                                        data_format)
+    m = float(momentum)
+    mean_out = mean * m + bmean * (1 - m)
+    var_out = variance * m + bvar * (1 - m)
+    return out, mean_out, var_out, bmean, bvar
+
+
+@register_kernel("viterbi_decode")
+def viterbi_decode_kernel(potentials, transition, lengths=None,
+                          include_bos_eos_tag=True):
+    """CRF Viterbi decode op (reference viterbi_decode_kernel) — delegates
+    to the scan-based decoder in text/ (same math, one home)."""
+    from ...core.tensor import Tensor as _T
+    from ...text import viterbi_decode as _vd
+    scores, path = _vd(_T(potentials), _T(transition),
+                       _T(lengths) if lengths is not None else None,
+                       include_bos_eos_tag=include_bos_eos_tag)
+    return scores._data, path._data

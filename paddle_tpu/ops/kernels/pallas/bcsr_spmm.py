@@ -70,7 +70,9 @@ def bcsr_spmm(crows, cols, values, x, bn: int = 512):
     first[crows_np[:-1][np.diff(crows_np) > 0]] = 1
     last[crows_np[1:][np.diff(crows_np) > 0] - 1] = 1
 
-    bn = min(bn, N) if N % 128 == 0 else N
+    # N tiles stay lane-aligned even for ragged N (pad up to 128s): a
+    # single full-width block would blow VMEM for wide vocab-sized N
+    bn = max(128, -(-min(bn, N) // 128) * 128)
     Np = -(-N // bn) * bn
     xp = jnp.pad(x, ((0, 0), (0, Np - N))) if Np != N else x
     nn = Np // bn

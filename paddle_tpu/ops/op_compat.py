@@ -132,6 +132,8 @@ OP_COMPAT: Dict[str, str] = {
     "check_finite_and_unscale_": "check_finite_and_unscale_op",
     "update_loss_scaling_": "update_loss_scaling_op",
     "exponential_": "exponential",
+    "batch_norm_": "batch_norm",
+    "sync_batch_norm_": "sync_batch_norm",
     "uniform_inplace": "rand",
     "gaussian_inplace": "randn",
 }

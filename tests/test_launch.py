@@ -159,6 +159,24 @@ class TestElastic:
             m.stop()
             store.close()
 
+    def test_relaunched_generation_clears_own_notice(self):
+        """Review regression: a node relaunched within notice_ttl must not
+        re-observe its own pre-restart notice (checkpoint-exit crash loop)."""
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        m = ElasticManager(store, "n0", np_min=1, ttl=5.0, job_id="g")
+        m.register()
+        m.notify_preemption()
+        assert m.should_checkpoint()
+        m.stop()
+        # next generation, same job_id/node_id
+        m2 = ElasticManager(store, "n0", np_min=1, ttl=5.0, job_id="g")
+        m2.register()
+        assert not m2.is_preempted()
+        assert not m2.should_checkpoint()
+        assert m2.pod_status() != ElasticStatus.HOLD
+        m2.stop()
+        store.close()
+
     def test_preemption_notice_expires(self):
         """Notices carry a TTL so a relaunched generation resumes training
         instead of checkpointing forever."""
