@@ -23,7 +23,6 @@ from __future__ import annotations
 import contextlib
 import functools
 import heapq
-import weakref
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -104,25 +103,26 @@ def record_node(op_name, vjp_callable, primals, in_tensors, out_tensors) -> None
 
 
 # -- tensor hooks -------------------------------------------------------------
-
-_tensor_hooks: "weakref.WeakKeyDictionary[Tensor, List[Callable]]" = weakref.WeakKeyDictionary()
+# Leaf hooks live ON the tensor object (not a WeakKeyDictionary keyed by
+# Tensor: dict bucket probing would call the elementwise __eq__ and blow up
+# on multi-element tensors whenever id-hashes collide).
 
 
 class RemovableHandle:
-    def __init__(self, store, key, fn):
-        self._store, self._key, self._fn = store, key, fn
+    def __init__(self, store: list, fn):
+        self._store, self._fn = store, fn
 
     def remove(self):
         try:
-            self._store[self._key].remove(self._fn)
-        except (KeyError, ValueError):
+            self._store.remove(self._fn)
+        except ValueError:
             pass
 
 
 def register_tensor_hook(t: Tensor, hook: Callable):
     """Hook fires ONCE on the tensor's fully-accumulated gradient
     (paddle/pytorch semantics), not per contribution. Non-leaf tensors
-    register on their producing node's output slot; leaves in a weak map."""
+    register on their producing node's output slot; leaves on the object."""
     if t._node is not None:
         entry = (t._out_idx, hook)
         t._node.hooks.append(entry)
@@ -138,8 +138,12 @@ def register_tensor_hook(t: Tensor, hook: Callable):
                     pass
 
         return _NodeHandle(t._node, entry)
-    _tensor_hooks.setdefault(t, []).append(hook)
-    return RemovableHandle(_tensor_hooks, t, hook)
+    hooks = getattr(t, "_leaf_hooks", None)
+    if hooks is None:
+        hooks = []
+        t._leaf_hooks = hooks
+    hooks.append(hook)
+    return RemovableHandle(hooks, hook)
 
 
 def _run_hooks(hooks, g: jax.Array) -> jax.Array:
@@ -220,7 +224,7 @@ def backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]]
         node.out_grads = [None] * len(node.out_avals)  # per-pass accumulator
 
     for _, (t, g) in leaf_acc.items():
-        g = _run_hooks(_tensor_hooks.get(t, ()), g)
+        g = _run_hooks(getattr(t, "_leaf_hooks", None) or (), g)
         if t._grad is None:
             t._grad = Tensor(g)
         else:

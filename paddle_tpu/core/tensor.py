@@ -27,7 +27,8 @@ from . import dtype as dtype_mod
 class Tensor:
     __slots__ = (
         "_data", "_stop_gradient", "_grad", "_node", "_out_idx",
-        "_version", "name", "persistable", "__weakref__",
+        "_version", "name", "persistable", "_leaf_hooks", "main_grad",
+        "__weakref__",
     )
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True, name: Optional[str] = None):

@@ -191,3 +191,4 @@ from .sequence_parallel_utils import (  # noqa: E402,F401
     ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,
     GatherOp, AllGatherOp, ReduceScatterOp,
     mark_as_sequence_parallel_parameter)
+from . import utils  # noqa: E402,F401

@@ -144,12 +144,10 @@ class ElasticManager:
         try:
             self.store.delete(f"{self.prefix}/preempt/{self.node_id}")
         except Exception:
-            return
-        # drop the job-wide flag too when no other node holds a fresh notice
-        if not any(self._notice_fresh(self.store.get(
-                f"{self.prefix}/preempt/{n}", wait=False))
-                   for n in self._known_nodes() if n != self.node_id):
-            self.store.delete(f"{self.prefix}/preempt_any")
+            pass
+        # preempt_any is NOT deleted here: a check-then-delete would race a
+        # concurrent notify from another node; should_checkpoint verifies
+        # the flag against per-node notices instead
 
     def notify_preemption(self, node_id: Optional[str] = None):
         """Record a preemption notice for `node_id` (default: this node)."""
@@ -172,9 +170,14 @@ class ElasticManager:
 
     def should_checkpoint(self) -> bool:
         """True when any member is under a fresh notice — the whole job
-        should checkpoint now, before membership shrinks. One store read."""
-        return self._notice_fresh(self.store.get(
-            f"{self.prefix}/preempt_any", wait=False))
+        should checkpoint now, before membership shrinks. One store read on
+        the common (no-notice) path; the rare flag-set path re-verifies
+        against per-node notices (register() clears a relaunched node's
+        own, so the flag alone would over-trigger)."""
+        if not self._notice_fresh(self.store.get(
+                f"{self.prefix}/preempt_any", wait=False)):
+            return False
+        return bool(self.preempted_nodes())
 
 
 class PreemptionHandler:

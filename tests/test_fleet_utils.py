@@ -1,0 +1,127 @@
+"""Fleet utility long tail: FusedCommBuffer, MixPrecision wrappers, fs.
+
+Model: reference test/collective/fleet utils suites (grad fusion parity,
+main-grad dtype assertions)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.utils import (FusedCommBuffer, HDFSClient,
+                                                LocalFS, MixPrecisionLayer,
+                                                MixPrecisionOptimizer,
+                                                fused_parameters)
+
+
+class TestMixPrecision:
+    def test_main_grad_fp32_and_step(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        for p in m.parameters():
+            p._set_data(p._data.astype("bfloat16"))
+        mp = MixPrecisionLayer(m, dtype="bfloat16")
+        opt = MixPrecisionOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m.parameters()))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32)).astype("bfloat16")
+        w0 = np.asarray(m.weight._data, np.float32).copy()
+        loss = mp(x).astype("float32").sum()
+        loss.backward()
+        assert m.weight.main_grad is not None
+        assert m.weight.main_grad._data.dtype == jnp.float32
+        opt.step()
+        opt.clear_grad()
+        assert m.weight.main_grad is None
+        assert not np.allclose(np.asarray(m.weight._data, np.float32), w0)
+
+    def test_main_grad_accumulates_across_micro_batches(self):
+        """Review regression: hooks fire per backward pass, so main_grad
+        must SUM micro-batch grads (and step feeds fp32 into the update)."""
+        w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+
+        class One(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.add_parameter("w", w)
+
+            def forward(self, x):
+                return (self.w * x).sum()
+
+        m = One()
+        mp = MixPrecisionLayer(m)
+        opt = MixPrecisionOptimizer(
+            paddle.optimizer.SGD(learning_rate=1.0,
+                                 parameters=m.parameters()))
+        mp(paddle.to_tensor(np.full(2, 1.0, np.float32))).backward()
+        mp(paddle.to_tensor(np.full(2, 2.0, np.float32))).backward()
+        np.testing.assert_allclose(np.asarray(w.main_grad._data),
+                                   [3.0, 3.0])   # 1 + 2, not just 2
+        opt.step()
+        np.testing.assert_allclose(np.asarray(w._data), [-2.0, -2.0])
+        assert w.grad._data.dtype == jnp.float32  # fp32 reached the update
+
+    def test_leaf_hooks_after_set_data(self):
+        """Regression: leaf hooks live on the tensor object — re-binding
+        data (dtype cast) must not orphan them, and Tensor keys never go
+        through elementwise __eq__."""
+        w = paddle.to_tensor(np.ones((3, 3), np.float32),
+                             stop_gradient=False)
+        seen = []
+        h = w.register_hook(lambda g: seen.append(1))
+        w._set_data(w._data.astype("bfloat16"))
+        (w.astype("float32") * 2.0).sum().backward()
+        assert seen == [1]
+        h.remove()
+        w.clear_grad()
+        (w.astype("float32") * 2.0).sum().backward()
+        assert seen == [1]          # removed handle never fires
+
+
+class TestFusedCommBuffer:
+    def test_bucketing_and_fused_reduce(self):
+        paddle.seed(1)
+        m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        params = list(m.parameters())
+        buffers = fused_parameters(params, group_size=1)
+        assert sum(len(b.params) for b in buffers) == len(params)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        m(x).sum().backward()
+        grads_before = [np.asarray(p.grad._data).copy() for p in params]
+        for b in buffers:
+            for p in b.params:
+                b.add_grad(p)
+            assert not b.all_ready       # reset after comm
+        # single process, replicated grads: fused all_reduce is identity
+        for p, g0 in zip(params, grads_before):
+            np.testing.assert_allclose(np.asarray(p.grad._data), g0,
+                                       rtol=1e-6)
+
+    def test_acc_steps_scaling(self):
+        w = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+        buf = FusedCommBuffer(0, [w], acc_steps=2)
+        (w * 3.0).sum().backward()
+        buf.add_grad(w)
+        np.testing.assert_allclose(np.asarray(w.grad._data), [1.5] * 4)
+
+
+class TestFS:
+    def test_localfs_roundtrip(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "x")
+        fs.mkdirs(d)
+        fs.touch(d + "/f")
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert dirs == ["x"] and files == []
+        assert fs.is_dir(d) and fs.is_file(d + "/f")
+        fs.mv(d + "/f", d + "/g")
+        assert fs.is_exist(d + "/g")
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_raises_clearly(self):
+        with pytest.raises(RuntimeError, match="hadoop"):
+            HDFSClient().ls_dir("/tmp")
