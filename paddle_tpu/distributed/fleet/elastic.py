@@ -154,9 +154,11 @@ class ElasticManager:
         nid = node_id or self.node_id
         now = repr(time.time())
         self.store.set(f"{self.prefix}/preempt/{nid}", now)
-        # job-wide flag: should_checkpoint() reads ONE key per step, not
-        # one per node (train-loop hot path)
-        self.store.set(f"{self.prefix}/preempt_any", now)
+        # job-wide flag carries the notifier id: should_checkpoint() reads
+        # ONE key on the common path and re-verifies only that node's
+        # notice (so a relaunched node clearing its OWN notice resumes the
+        # job without requiring membership registration of the notifier)
+        self.store.set(f"{self.prefix}/preempt_any", f"{now}|{nid}")
 
     def preempted_nodes(self) -> List[str]:
         return [n for n in self._known_nodes()
@@ -171,13 +173,22 @@ class ElasticManager:
     def should_checkpoint(self) -> bool:
         """True when any member is under a fresh notice — the whole job
         should checkpoint now, before membership shrinks. One store read on
-        the common (no-notice) path; the rare flag-set path re-verifies
-        against per-node notices (register() clears a relaunched node's
-        own, so the flag alone would over-trigger)."""
-        if not self._notice_fresh(self.store.get(
-                f"{self.prefix}/preempt_any", wait=False)):
+        the common (no-notice) path; when the flag is fresh, the notifier's
+        own per-node key is re-checked (a relaunched node clears its own
+        notice, so the flag alone would over-trigger forever)."""
+        raw = self.store.get(f"{self.prefix}/preempt_any", wait=False)
+        if raw is None:
             return False
-        return bool(self.preempted_nodes())
+        try:
+            ts, nid = raw.decode().split("|", 1)
+        except ValueError:
+            ts, nid = raw.decode(), None
+        if not self._notice_fresh(ts.encode()):
+            return False
+        if nid is None:
+            return True
+        return self._notice_fresh(self.store.get(
+            f"{self.prefix}/preempt/{nid}", wait=False))
 
 
 class PreemptionHandler:

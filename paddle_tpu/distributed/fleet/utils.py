@@ -54,6 +54,7 @@ class FusedCommBuffer:
         self.acc_steps = acc_steps
         self._ready: Dict[int, bool] = {_py_id(p): False
                                         for p in self.params}
+        self._acc_counter = 0
         self._sizes = [int(p._data.size) for p in self.params]
         self._shapes = [tuple(p._data.shape) for p in self.params]
 
@@ -64,7 +65,15 @@ class FusedCommBuffer:
     def add_grad(self, param: Tensor):
         self._ready[_py_id(param)] = True
         if self.all_ready:
-            self.comm_grads()
+            self._acc_counter += 1
+            if self._acc_counter < self.acc_steps:
+                # intermediate micro-batch: grads keep accumulating in
+                # p.grad; only the LAST micro-step communicates + scales
+                for k in self._ready:
+                    self._ready[k] = False
+            else:
+                self._acc_counter = 0
+                self.comm_grads()
 
     def comm_grads(self):
         grads = [p.grad._data.reshape(-1) if p.grad is not None
@@ -93,14 +102,16 @@ class FusedCommBuffer:
             self._ready[k] = False
 
 
-def fused_parameters(parameters: Sequence[Tensor], group_size: int = 128,
+def fused_parameters(parameters: Sequence[Tensor],
+                     group_size: int = 256 * 1024 * 1024,
                      comm_group=None, acc_step: int = 1):
-    """Partition params into FusedCommBuffers of ~group_size MB
-    (reference fused_parameters:761). Returns the buffer list."""
+    """Partition params into FusedCommBuffers of ~group_size BYTES
+    (reference fused_parameters:761 — same unit and default).
+    Returns the buffer list."""
     buffers: List[FusedCommBuffer] = []
     cur: List[Tensor] = []
     cur_bytes = 0
-    limit = group_size * 1024 * 1024
+    limit = int(group_size)
     for p in parameters:
         cur.append(p)
         cur_bytes += int(p._data.size) * p._data.dtype.itemsize
@@ -208,6 +219,8 @@ class LocalFS:
     """reference fleet/utils/fs.py LocalFS — thin, real."""
 
     def ls_dir(self, path):
+        if not os.path.exists(path):
+            return [], []          # reference LocalFS: empty, not raising
         entries = sorted(os.listdir(path))
         dirs = [e for e in entries
                 if os.path.isdir(os.path.join(path, e))]
@@ -255,12 +268,16 @@ class LocalFS:
 
 class HDFSClient:
     """API-shape parity only: this stack has no hadoop runtime (reference
-    shells out to `hadoop fs`). Raises on use with a clear message."""
+    shells out to `hadoop fs`). Each API method raises with a clear
+    message; attribute probes (hasattr/deepcopy) behave normally."""
 
     def __init__(self, hadoop_home=None, configs=None):
         self.hadoop_home = hadoop_home
 
-    def __getattr__(self, name):
+    def _unavailable(self, *a, **k):
         raise RuntimeError(
             "HDFSClient: no hadoop runtime in this environment; use "
             "LocalFS or mount the store locally (gcsfuse for GCS).")
+
+    ls_dir = is_dir = is_file = is_exist = mkdirs = delete = _unavailable
+    rename = mv = upload = download = touch = cat = _unavailable

@@ -101,11 +101,17 @@ class TestFusedCommBuffer:
                                        rtol=1e-6)
 
     def test_acc_steps_scaling(self):
+        """Review regression: only the LAST micro-step communicates and
+        scales — intermediate add_grad rounds must not rescale."""
         w = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
         buf = FusedCommBuffer(0, [w], acc_steps=2)
         (w * 3.0).sum().backward()
-        buf.add_grad(w)
-        np.testing.assert_allclose(np.asarray(w.grad._data), [1.5] * 4)
+        buf.add_grad(w)                  # micro-step 1: accumulate only
+        np.testing.assert_allclose(np.asarray(w.grad._data), [3.0] * 4)
+        (w * 3.0).sum().backward()       # grads accumulate to 6
+        buf.add_grad(w)                  # micro-step 2: comm + scale 1/2
+        np.testing.assert_allclose(np.asarray(w.grad._data), [3.0] * 4)
+        assert buf._acc_counter == 0     # window reset
 
 
 class TestFS:

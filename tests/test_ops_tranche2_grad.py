@@ -1,0 +1,116 @@
+"""Finite-difference gradient checks for round-2 tranche ops.
+
+Model: the reference's OpTest check_grad (test/legacy_test/op_test.py:150
+get_numeric_gradient) — analytic tape grads vs central differences."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output
+
+
+def f32(*shape, seed=0, scale=0.5):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(
+        np.float32)
+
+
+class TestTrancheGrads:
+    def test_stanh(self):
+        check_grad("stanh", {"x": f32(4, 5)}, {}, ["x"])
+
+    def test_tanh_shrink(self):
+        check_grad("tanh_shrink", {"x": f32(4, 5)}, {}, ["x"])
+
+    def test_gammaln(self):
+        check_grad("gammaln", {"x": np.abs(f32(8)) + 1.0}, {}, ["x"])
+
+    def test_fmax_fmin(self):
+        check_grad("fmax", {"x": f32(6), "y": f32(6, seed=1)}, {},
+                   ["x", "y"])
+        check_grad("fmin", {"x": f32(6), "y": f32(6, seed=1)}, {},
+                   ["x", "y"])
+
+    def test_dist_and_pnorm(self):
+        check_grad("dist", {"x": f32(3, 4), "y": f32(3, 4, seed=1)},
+                   {"p": 2.0}, ["x", "y"])
+        check_grad("p_norm", {"x": f32(3, 4) + 1.0},
+                   {"porder": 2.0, "axis": 1}, ["x"])
+
+    def test_losses(self):
+        check_grad("huber_loss",
+                   {"input": f32(8), "label": f32(8, seed=1)},
+                   {"delta": 1.0}, ["input"])
+        check_grad("kldiv_loss",
+                   {"x": f32(6), "label": np.abs(f32(6, seed=1)) + 0.1},
+                   {"reduction": "mean"}, ["x"])
+        check_grad("sigmoid_cross_entropy_with_logits",
+                   {"x": f32(6),
+                    "label": (f32(6, seed=1) > 0).astype(np.float32)},
+                   {}, ["x"])
+
+    def test_clip_by_norm(self):
+        check_grad("clip_by_norm", {"x": f32(4, 4, scale=2.0)},
+                   {"max_norm": 1.0}, ["x"])
+
+    def test_grid_sample(self):
+        rs = np.random.RandomState(0)
+        grid = (rs.rand(1, 3, 3, 2).astype(np.float32) - 0.5) * 1.2
+        check_grad("grid_sample", {"x": f32(1, 2, 5, 5), "grid": grid},
+                   {"align_corners": True}, ["x"], delta=5e-3, rtol=5e-2,
+                   atol=5e-3)
+
+    def test_conv3d(self):
+        check_grad("conv3d",
+                   {"x": f32(1, 1, 3, 4, 4),
+                    "weight": f32(2, 1, 2, 2, 2, seed=1)}, {},
+                   ["x", "weight"], rtol=3e-2)
+
+    def test_fold(self):
+        check_grad("fold", {"x": f32(1, 8, 4)},
+                   {"output_sizes": [4, 4], "kernel_sizes": [2, 2],
+                    "strides": [2, 2]}, ["x"])
+
+    def test_pool2d_avg(self):
+        check_grad("pool2d", {"x": f32(1, 2, 4, 4)},
+                   {"kernel_size": [2, 2], "strides": [2, 2],
+                    "pooling_type": "avg"}, ["x"])
+
+    def test_maxout(self):
+        check_grad("maxout", {"x": f32(2, 4, 3, 3, scale=1.0)},
+                   {"groups": 2}, ["x"], rtol=3e-2)
+
+    def test_index_sample(self):
+        idx = np.array([[0, 2], [1, 0]], np.int32)
+        check_grad("index_sample", {"x": f32(2, 4), "index": idx}, {},
+                   ["x"])
+
+    def test_fused_softmax_masks(self):
+        check_grad("fused_softmax_mask_upper_triangle",
+                   {"x": f32(1, 2, 4, 4)}, {}, ["x"])
+
+    def test_fused_gemm_epilogue(self):
+        check_grad("fused_gemm_epilogue",
+                   {"x": f32(3, 4), "y": f32(4, 5, seed=1),
+                    "bias": f32(5, seed=2)},
+                   {"activation": "gelu"}, ["x", "y", "bias"], rtol=3e-2)
+
+    def test_c_embedding(self):
+        ids = np.array([[4, 6, 2]], np.int32)
+        check_grad("c_embedding",
+                   {"table": f32(4, 3), "ids": ids},
+                   {"start_index": 4}, ["table"])
+
+    def test_grouped_gemm_via_op(self):
+        check_grad("grouped_gemm",
+                   {"x": f32(2, 8, 4), "w": f32(2, 4, 4, seed=1)},
+                   {}, ["x", "w"], rtol=3e-2)
+
+    def test_interp_bilinear(self):
+        check_grad("bilinear_interp", {"x": f32(1, 1, 4, 4)},
+                   {"size": [8, 8]}, ["x"], rtol=3e-2)
+
+    def test_tensor_unfold_and_as_strided(self):
+        check_grad("tensor_unfold", {"x": f32(10)},
+                   {"axis": 0, "size": 4, "step": 3}, ["x"])
+        check_grad("as_strided", {"x": f32(10)},
+                   {"shape": [4, 2], "stride": [2, 1]}, ["x"])
