@@ -231,7 +231,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, res, dout):
+def _bwd(causal, scale, res, dout, dlse=None):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
@@ -239,9 +239,14 @@ def _bwd(causal, scale, res, dout):
     bq, bk = _block(sq, 512), _block(sk, 512)
     nq, nk = sq // bq, sk // bk
 
-    # delta = rowsum(dout * out), stored [bh, 1, sq] like lse
+    # delta = rowsum(dout * out), stored [bh, 1, sq] like lse. When lse is
+    # itself an output being differentiated (ring attention's merge weights
+    # use it), its cotangent folds in here: ds = p*(dp - delta + dlse),
+    # i.e. delta' = delta - dlse — the kernels stay unchanged.
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)[:, None, :]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -315,6 +320,29 @@ def _flash_bwd(causal, scale, res, dout):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_block(q, k, v, causal, scale):
+    """One attention block returning (out, lse), folded layout
+    ([bh, sq, d], [bh, sq]) — the ring-attention building block. lse is a
+    REAL differentiable output: the online-softmax merge weights downstream
+    consume it, and its cotangent folds into the backward's delta term."""
+    out, lse = _fwd(q, k, v, causal, scale)
+    return out, lse[:, 0, :]
+
+
+def _flash_block_fwd(q, k, v, causal, scale):
+    out, lse = _fwd(q, k, v, causal, scale)
+    return (out, lse[:, 0, :]), (q, k, v, out, lse)
+
+
+def _flash_block_bwd(causal, scale, res, cts):
+    dout, dlse = cts
+    return _bwd(causal, scale, res, dout, dlse=dlse)
+
+
+flash_block.defvjp(_flash_block_fwd, _flash_block_bwd)
 
 
 def flash_attention(query, key, value, causal=False, scale=None):
