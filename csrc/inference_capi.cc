@@ -64,7 +64,12 @@ void record_py_error(const char* where) {
     if (v != nullptr) {
       PyObject* s = PyObject_Str(v);
       if (s != nullptr) {
-        g_last_error += std::string(": ") + PyUnicode_AsUTF8(s);
+        const char* msg = PyUnicode_AsUTF8(s);  // may fail on encoding
+        if (msg != nullptr) {
+          g_last_error += std::string(": ") + msg;
+        } else {
+          PyErr_Clear();
+        }
         Py_DECREF(s);
       }
     }
@@ -121,10 +126,21 @@ bool call_str(const char* fn, std::string* out, const char* fmt, ...) {
     record_py_error(fn);
     return false;
   }
+  if (r == Py_None) {  // bridge signals failure as None (b'' is a real,
+    Py_DECREF(r);      // legitimately empty result)
+    std::string detail;
+    if (call_str("last_error", &detail, "()") && !detail.empty()) {
+      g_last_error = std::string(fn) + ": " + detail;
+    } else {
+      g_last_error = std::string(fn) + ": bridge returned None";
+    }
+    return false;
+  }
   if (PyBytes_Check(r)) {
     out->assign(PyBytes_AsString(r), PyBytes_Size(r));
   } else {
     const char* s = PyUnicode_AsUTF8(r);
+    if (s == nullptr) PyErr_Clear();
     out->assign(s ? s : "");
   }
   Py_DECREF(r);

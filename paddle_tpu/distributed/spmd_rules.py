@@ -370,6 +370,11 @@ def _reshape(x: DistTensorSpec, shape: Sequence[int] = (), **attrs
                 pj *= out_shape[j]; gj.append(j); j += 1
             else:
                 break
+        if not gj:
+            # leftover input dims with no output group (trailing unit dims,
+            # e.g. (N,1)->(N,)): consumed with nothing to emit; a size-1 dim
+            # cannot carry a shard so no req update is needed
+            continue
         if len(gi) == 1 and len(gj) == 1 and pi == pj:
             out_dims.append(("dim", gi[0]))
         elif len(gj) == 1 and gi and pi == pj:

@@ -105,9 +105,11 @@ def output_meta(h: int, name: str) -> str:
         return ""
 
 
-def output_bytes(h: int, name: str) -> bytes:
+def output_bytes(h: int, name: str):
+    """Raw output buffer, or None on error (a legitimately empty output is
+    b'' — the C side maps None to rc -1 so the two are distinguishable)."""
     try:
         return _output_array(h, name).tobytes()
     except Exception as e:
         _set_err(e)
-        return b""
+        return None

@@ -114,6 +114,6 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hp, D), q.dtype),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-      qr, k_pool, v_pool)
+    )(jnp.clip(block_tables.astype(jnp.int32), 0, NB - 1),
+      context_lens.astype(jnp.int32), qr, k_pool, v_pool)
     return out.reshape(B, KV, G8, D)[:, :, :G].reshape(B, 1, H, D)

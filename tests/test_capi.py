@@ -129,5 +129,12 @@ class TestInferenceCAPI:
         ref = p2.get_output_handle(p2.get_output_names()[0]).copy_to_cpu()
         np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-6)
 
+        # error path: a bad output name must be rc=-1 (distinguishable from
+        # a legitimately empty output), with the cause in PD_GetLastError
+        n = lib.PD_PredictorCopyOutput(pred, b"no_such_output", buf,
+                                       int(nbytes))
+        assert n == -1
+        assert b"no_such_output" in lib.PD_GetLastError()
+
         lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
         lib.PD_PredictorDestroy(pred)

@@ -274,6 +274,26 @@ class TestDimTransRules:
         assert info.output_specs[0].shape == (128, 1)
         assert info.output_specs[0].dims_mapping == [0, -1]
 
+    def test_reshape_remove_trailing_unit_dim(self):
+        # (N, 1) -> (N,): regression — the leftover size-1 input group used
+        # to IndexError on an empty output group
+        info = get_spmd_rule("reshape").infer_forward(
+            spec((4, 1), [0, -1]), shape=[4])
+        assert info.output_specs[0].shape == (4,)
+        assert info.output_specs[0].dims_mapping == [0]
+
+    def test_reshape_append_unit_dim(self):
+        info = get_spmd_rule("reshape").infer_forward(
+            spec((4,), [0]), shape=[4, 1])
+        assert info.output_specs[0].shape == (4, 1)
+        assert info.output_specs[0].dims_mapping == [0, -1]
+
+    def test_reshape_remove_middle_unit_dims(self):
+        info = get_spmd_rule("reshape").infer_forward(
+            spec((8, 1, 1), [0, -1, -1]), shape=[8])
+        assert info.output_specs[0].shape == (8,)
+        assert info.output_specs[0].dims_mapping == [0]
+
     def test_reshape_prepend_unit_dim_keeps_sharding(self):
         info = get_spmd_rule("reshape").infer_forward(
             spec((16,), [0]), shape=[1, 16])
