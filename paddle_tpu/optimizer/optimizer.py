@@ -335,6 +335,185 @@ class AdamW(Adam):
         return True
 
 
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference python/paddle/optimizer/lamb.py:30,
+    kernel funcs paddle/phi/kernels/funcs/lamb_functors.h:443-455): adam moments
+    with bias correction, trust_ratio_div = m_hat/(sqrt(v_hat)+eps) + wd*p,
+    per-layer trust ratio r = ||p|| / ||trust_ratio_div|| (1 when either norm
+    is 0), p -= lr * r * trust_ratio_div."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _param_weight_decay(self, i: int) -> float:
+        # reference lamb.py passes the PARAM (not its name) to the exclude fn
+        if self._exclude_fn is not None and \
+                self._exclude_fn(self._parameter_list[i]):
+            return 0.0
+        return self._weight_decay
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._beta1, self._beta2, self._eps)
+
+    def _init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        lr = lr.astype(p.dtype)
+        wd = wd.astype(p.dtype)
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        m_hat = m / (1 - b1 ** step)
+        v_hat = v / (1 - b2 ** step)
+        tr_div = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+        pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+        tn = jnp.sqrt(jnp.sum(jnp.square(tr_div)))
+        r = jnp.where((pn > 0) & (tn > 0), pn / jnp.where(tn > 0, tn, 1.0), 1.0)
+        return p - lr * r * tr_div, {"m": m, "v": v}
+
+
+class Adamax(Optimizer):
+    """Adam with infinity norm (reference python/paddle/optimizer/adamax.py,
+    kernel paddle/phi/kernels/impl/adamax_kernel_impl.h:61-70):
+    inf_norm = max(|g|, beta2*inf_norm + eps), p -= lr/(1-b1^t) * m/inf_norm.
+    Weight decay is coupled (added to the gradient), as in the reference's
+    regularizer path."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._beta1, self._beta2, self._eps)
+
+    def _init_state(self, param):
+        return {"m": jnp.zeros_like(param), "inf": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        lr = lr.astype(p.dtype)
+        g = g + wd.astype(p.dtype) * p
+        m = b1 * state["m"] + (1 - b1) * g
+        inf = jnp.maximum(jnp.abs(g), b2 * state["inf"] + eps)
+        lr_t = lr / (1 - b1 ** step)
+        return p - lr_t * m / inf, {"m": m, "inf": inf}
+
+
+class Adadelta(Optimizer):
+    """Reference python/paddle/optimizer/adadelta.py, kernel
+    paddle/phi/kernels/impl/adadelta_kernel_impl.h:60-82:
+    E[g2] = rho*E[g2] + (1-rho)*g2; update = -sqrt(E[dx2]+eps)/sqrt(E[g2]+eps)*g;
+    E[dx2] = rho*E[dx2] + (1-rho)*update2; p += lr*update."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._eps = rho, epsilon
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._rho, self._eps)
+
+    def _init_state(self, param):
+        return {"g2": jnp.zeros_like(param), "dx2": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        rho, eps = self._rho, self._eps
+        g = g + wd.astype(p.dtype) * p
+        g2 = rho * state["g2"] + (1 - rho) * jnp.square(g)
+        upd = -jnp.sqrt(state["dx2"] + eps) / jnp.sqrt(g2 + eps) * g
+        dx2 = rho * state["dx2"] + (1 - rho) * jnp.square(upd)
+        return p + lr.astype(p.dtype) * upd, {"g2": g2, "dx2": dx2}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (reference python/paddle/optimizer/asgd.py
+    docstring math, kernel paddle/phi/kernels/impl/asgd_kernel_impl.h):
+    keeps the last `batch_num` gradients per param; each step replaces slot
+    i = t % n in the running sum d and updates
+    p -= lr * (d / min(t+1, n) + wd*p)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        if batch_num < 1:
+            raise ValueError("batch_num must be >= 1")
+        self._n = int(batch_num)
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._n)
+
+    def _init_state(self, param):
+        return {"d": jnp.zeros_like(param),
+                "ys": jnp.zeros((self._n,) + param.shape, param.dtype)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        n = self._n
+        idx = (step - 1) % n
+        y_old = jax.lax.dynamic_index_in_dim(state["ys"], idx, 0,
+                                             keepdims=False)
+        d = state["d"] - y_old + g
+        ys = jax.lax.dynamic_update_index_in_dim(state["ys"], g, idx, 0)
+        denom = jnp.minimum(step, n).astype(p.dtype)
+        upd = d / denom + wd.astype(p.dtype) * p
+        return p - lr.astype(p.dtype) * upd, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference python/paddle/optimizer/rprop.py math,
+    kernel paddle/phi/kernels/impl/rprop_kernel_impl.h). Per-element step
+    size: grows by etas[1] (capped at learning_rate_range[1]) when the
+    gradient keeps sign, shrinks by etas[0] (floored at range[0]) and skips
+    the update when it flips. Full-batch training only; the global LR
+    scheduler does not apply (learning_rate seeds the per-element steps)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=True, name=None):
+        if isinstance(learning_rate, LRScheduler):
+            raise TypeError(
+                "Rprop maintains per-element step sizes seeded from a float "
+                "learning_rate; LR schedulers do not apply (reference "
+                "rprop.py: full-batch only)")
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr0 = float(learning_rate)
+        self._lr_min, self._lr_max = (float(x) for x in learning_rate_range)
+        self._eta_minus, self._eta_plus = (float(x) for x in etas)
+
+    def _update_static_key(self):
+        return (self._lr0, self._lr_min, self._lr_max,
+                self._eta_minus, self._eta_plus)
+
+    def _init_state(self, param):
+        return {"prev": jnp.zeros_like(param),
+                "lrs": jnp.full_like(param, self._lr0)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        sign = g * state["prev"]
+        lrs = jnp.where(
+            sign > 0, jnp.minimum(state["lrs"] * self._eta_plus, self._lr_max),
+            jnp.where(sign < 0,
+                      jnp.maximum(state["lrs"] * self._eta_minus, self._lr_min),
+                      state["lrs"]))
+        step_w = jnp.where(sign < 0, jnp.zeros_like(p), jnp.sign(g) * lrs)
+        prev = jnp.where(sign < 0, jnp.zeros_like(g), g)
+        return p - step_w, {"prev": prev, "lrs": lrs}
+
+
 class Adagrad(Optimizer):
     def __init__(self, learning_rate=0.001, epsilon=1e-06, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,

@@ -213,6 +213,188 @@ class TestOptimizers:
                                    np.asarray(opt._states[0]["m"]))
 
 
+class TestOptimizerBreadth:
+    """Step-parity vs numpy for the Lamb/Adamax/Adadelta/ASGD/Rprop tranche
+    (reference python/paddle/optimizer/{lamb,adamax,adadelta,asgd,rprop}.py)."""
+
+    def _run_steps(self, opt, w, grads):
+        outs = []
+        for g in grads:
+            (w * paddle.to_tensor(g)).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            outs.append(w.numpy().copy())
+        return outs
+
+    def _grads(self, n_steps=4, shape=(5,), seed=0):
+        r = np.random.RandomState(seed)
+        return [r.randn(*shape).astype(np.float32) for _ in range(n_steps)]
+
+    def test_lamb_vs_numpy(self):
+        grads = self._grads()
+        w0 = np.random.RandomState(1).randn(5).astype(np.float32)
+        w = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        opt = paddle.optimizer.Lamb(learning_rate=0.01, lamb_weight_decay=0.1,
+                                    parameters=[w])
+        outs = self._run_steps(opt, w, grads)
+        p = w0.astype(np.float64).copy()
+        m = v = np.zeros_like(p)
+        b1, b2, eps, wd, lr = 0.9, 0.999, 1e-6, 0.1, 0.01
+        for t, g in enumerate(grads, 1):
+            g = g.astype(np.float64)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            tr = m / (1 - b1 ** t) / (np.sqrt(v / (1 - b2 ** t)) + eps) + wd * p
+            pn, tn = np.linalg.norm(p), np.linalg.norm(tr)
+            r = pn / tn if (pn > 0 and tn > 0) else 1.0
+            p = p - lr * r * tr
+            np.testing.assert_allclose(outs[t - 1], p, rtol=2e-5, atol=2e-6)
+
+    def test_lamb_exclude_from_weight_decay(self):
+        w = paddle.to_tensor(np.full(3, 5.0, np.float32), stop_gradient=False)
+        w.name = "norm_w"
+        opt = paddle.optimizer.Lamb(
+            learning_rate=0.1, lamb_weight_decay=0.5, parameters=[w],
+            exclude_from_weight_decay_fn=lambda p: "norm" in (p.name or ""))
+        (w * 0.0).sum().backward()
+        opt.step()
+        # zero grad + excluded decay => trust_ratio_div == 0 => no movement
+        np.testing.assert_allclose(w.numpy(), 5.0, rtol=1e-6)
+
+    def test_adamax_vs_numpy(self):
+        grads = self._grads(seed=2)
+        w0 = np.random.RandomState(3).randn(5).astype(np.float32)
+        w = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        opt = paddle.optimizer.Adamax(learning_rate=0.05, parameters=[w])
+        outs = self._run_steps(opt, w, grads)
+        p = w0.astype(np.float64).copy()
+        m = inf = np.zeros_like(p)
+        b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.05
+        for t, g in enumerate(grads, 1):
+            g = g.astype(np.float64)
+            m = b1 * m + (1 - b1) * g
+            inf = np.maximum(np.abs(g), b2 * inf + eps)
+            p = p - lr / (1 - b1 ** t) * m / inf
+            np.testing.assert_allclose(outs[t - 1], p, rtol=2e-5, atol=2e-6)
+
+    def test_adadelta_vs_numpy(self):
+        grads = self._grads(seed=4)
+        w0 = np.random.RandomState(5).randn(5).astype(np.float32)
+        w = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        opt = paddle.optimizer.Adadelta(learning_rate=1.0, parameters=[w])
+        outs = self._run_steps(opt, w, grads)
+        p = w0.astype(np.float64).copy()
+        g2 = dx2 = np.zeros_like(p)
+        rho, eps = 0.95, 1e-6
+        for t, g in enumerate(grads, 1):
+            g = g.astype(np.float64)
+            g2 = rho * g2 + (1 - rho) * g * g
+            upd = -np.sqrt(dx2 + eps) / np.sqrt(g2 + eps) * g
+            dx2 = rho * dx2 + (1 - rho) * upd * upd
+            p = p + upd
+            np.testing.assert_allclose(outs[t - 1], p, rtol=2e-5, atol=2e-6)
+
+    def test_asgd_vs_numpy(self):
+        n = 3
+        grads = self._grads(n_steps=7, seed=6)
+        w0 = np.random.RandomState(7).randn(5).astype(np.float32)
+        w = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        opt = paddle.optimizer.ASGD(learning_rate=0.1, batch_num=n,
+                                    parameters=[w])
+        outs = self._run_steps(opt, w, grads)
+        p = w0.astype(np.float64).copy()
+        d = np.zeros_like(p)
+        ys = np.zeros((n,) + p.shape)
+        for t, g in enumerate(grads, 1):
+            g = g.astype(np.float64)
+            i = (t - 1) % n
+            d = d - ys[i] + g
+            ys[i] = g
+            p = p - 0.1 * d / min(t, n)
+            np.testing.assert_allclose(outs[t - 1], p, rtol=2e-5, atol=2e-6)
+
+    def test_asgd_batch_num_1_is_sgd(self):
+        grads = self._grads(seed=8)
+        w0 = np.zeros(5, np.float32)
+        wa = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        ws = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        oa = paddle.optimizer.ASGD(learning_rate=0.1, parameters=[wa])
+        os_ = paddle.optimizer.SGD(learning_rate=0.1, parameters=[ws])
+        a = self._run_steps(oa, wa, grads)
+        s = self._run_steps(os_, ws, grads)
+        np.testing.assert_allclose(a[-1], s[-1], rtol=1e-6)
+
+    def test_rprop_vs_numpy(self):
+        grads = self._grads(n_steps=6, seed=9)
+        w0 = np.random.RandomState(10).randn(5).astype(np.float32)
+        w = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        opt = paddle.optimizer.Rprop(learning_rate=0.01,
+                                     learning_rate_range=(1e-4, 0.1),
+                                     etas=(0.5, 1.2), parameters=[w])
+        outs = self._run_steps(opt, w, grads)
+        p = w0.astype(np.float64).copy()
+        prev = np.zeros_like(p)
+        lrs = np.full_like(p, 0.01)
+        for t, g in enumerate(grads, 1):
+            g = g.astype(np.float64)
+            sign = g * prev
+            lrs = np.where(sign > 0, np.minimum(lrs * 1.2, 0.1),
+                           np.where(sign < 0, np.maximum(lrs * 0.5, 1e-4),
+                                    lrs))
+            p = p - np.where(sign < 0, 0.0, np.sign(g) * lrs)
+            prev = np.where(sign < 0, 0.0, g)
+            np.testing.assert_allclose(outs[t - 1], p, rtol=2e-5, atol=2e-6)
+
+    def test_new_optimizers_converge_quadratic(self):
+        target = np.array([1.0, -2.0, 3.0], np.float32)
+        for cls, kw in [
+            (paddle.optimizer.Adamax, dict(learning_rate=0.3)),
+            (paddle.optimizer.Adadelta, dict(learning_rate=10.0)),
+            (paddle.optimizer.ASGD, dict(learning_rate=0.1, batch_num=2)),
+            (paddle.optimizer.Rprop, dict(learning_rate=0.01)),
+        ]:
+            w = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+            opt = cls(parameters=[w], **kw)
+            for _ in range(200):
+                loss = ((w - paddle.to_tensor(target)) ** 2.0).sum()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            np.testing.assert_allclose(w.numpy(), target, atol=2e-2,
+                                       err_msg=cls.__name__)
+
+    def test_lamb_converges_from_nonzero_init(self):
+        # lamb steps scale with ||p|| (layer-wise trust ratio), so it needs a
+        # nonzero start; it oscillates at ~lr*||p|| so the tolerance is looser
+        target = np.array([1.0, -2.0, 3.0], np.float32)
+        w = paddle.to_tensor(np.array([2.0, 1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.Lamb(learning_rate=0.01, lamb_weight_decay=0.0,
+                                    parameters=[w])
+        for _ in range(400):
+            loss = ((w - paddle.to_tensor(target)) ** 2.0).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(w.numpy(), target, atol=0.15)
+
+    def test_new_optimizers_multi_precision_master(self):
+        for cls, kw in [
+            (paddle.optimizer.Lamb, {}),
+            (paddle.optimizer.Adamax, {}),
+            (paddle.optimizer.Adadelta, {}),
+            (paddle.optimizer.ASGD, {}),
+            (paddle.optimizer.Rprop, {}),
+        ]:
+            w = paddle.Parameter(np.ones(4, np.float32))
+            w._set_data(w._data.astype(paddle.bfloat16))
+            opt = cls(learning_rate=1e-3, parameters=[w], **kw)
+            (w * 1.0).sum().backward()
+            opt.step()
+            assert opt._masters[0] is not None, cls.__name__
+            assert str(opt._masters[0].dtype) == "float32", cls.__name__
+
+
 class TestLRSchedulers:
     def test_cosine(self):
         s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
