@@ -1,0 +1,118 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Ground-up rebuild of the reference framework's capabilities
+(/root/reference, PaddlePaddle) on JAX/XLA/PJRT with Pallas hand-kernels and
+a GSPMD-first distributed stack. See SURVEY.md for the blueprint.
+
+Public surface mirrors `import paddle`: tensor factory + op library at the
+top level, with nn / optimizer / io / amp / jit / distributed / vision
+subpackages.
+"""
+
+import os as _os
+
+from . import flags  # noqa: F401
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16, float16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_, complex64, complex128, set_default_dtype, get_default_dtype,
+)
+from .core.device import (  # noqa: F401
+    CPUPlace, TPUPlace, Place, set_device, get_device, device_count,
+    is_compiled_with_tpu, synchronize,
+)
+from .core import device  # noqa: F401
+from .core.generator import seed, default_generator  # noqa: F401
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .autograd.engine import no_grad, enable_grad, grad, is_grad_enabled  # noqa: F401
+from .flags import set_flags, get_flags  # noqa: F401
+
+# -- build the YAML-driven op surface -----------------------------------------
+from .ops import dispatcher as _dispatcher
+
+_OPS_YAML = _os.path.join(_os.path.dirname(__file__), "ops", "ops.yaml")
+_ops = _dispatcher.build_ops(_OPS_YAML)
+
+_RENAMES = {"shape_op": "shape", "neg": "neg", "getitem": None, "einsum_impl": None,
+            "cross_entropy_mean": None, "batch_norm_infer": None,
+            "batch_norm_train": None, "interpolate_nearest": None,
+            "interpolate_bilinear": None,
+            # namespaced-only ops (paddle.fft / paddle.signal modules —
+            # top-level names would shadow the submodules)
+            "fft": None, "ifft": None, "rfft": None, "irfft": None,
+            "hfft": None, "ihfft": None, "fft2": None, "ifft2": None,
+            "rfft2": None, "irfft2": None, "fftn": None, "ifftn": None,
+            "fftshift": None, "ifftshift": None, "fftfreq": None,
+            "rfftfreq": None, "frame": None, "stft": None, "istft": None}
+
+for _name, _fn in _ops.items():
+    _public = _RENAMES.get(_name, _name)
+    if _public:
+        globals()[_public] = _fn
+
+
+def einsum(equation, *operands):
+    """paddle.einsum (reference python/paddle/tensor/einsum.py)."""
+    return _ops["einsum_impl"](list(operands), equation=equation)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = []
+    return _ops["gaussian"](shape=shape, mean=float(mean), std=float(std))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+# -- subpackages ---------------------------------------------------------------
+from . import autograd  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from .distributed import DataParallel  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from . import models  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from .framework import save, load  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
+from .hapi import Model, summary  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401
+from .nn.layer_base import Parameter  # noqa: E402,F401
+from . import ops  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from .static import enable_static, disable_static  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+
+__version__ = "0.1.0"
+from .hapi.flops import flops  # noqa: E402,F401
+
+
+def iinfo(dtype):
+    """paddle.iinfo — integer type info (reference pybind iinfo binding)."""
+    import jax.numpy as _jnp
+    from .core import dtype as _dt
+    return _jnp.iinfo(_dt.convert_dtype(dtype))
+
+
+def finfo(dtype):
+    """paddle.finfo — float type info (bfloat16 included)."""
+    import jax.numpy as _jnp
+    from .core import dtype as _dt
+    return _jnp.finfo(_dt.convert_dtype(dtype))

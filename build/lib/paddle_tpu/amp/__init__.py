@@ -1,0 +1,205 @@
+"""AMP: auto-cast + GradScaler (reference python/paddle/amp —
+auto_cast.py:729, grad_scaler.py:579, O1/O2 lists amp_lists.py).
+
+TPU-native: bf16 is the native low-precision type (MXU), no loss scaling
+needed for bf16; GradScaler keeps the fp16 API for parity and becomes a
+near-no-op for bf16. auto_cast installs a dispatcher hook that casts primals
+of white-list ops to the low dtype before kernel selection — the same place
+the reference's generated ad_funcs call AmpAutoCast (eager_amp_auto_cast.h).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Set
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ..ops import dispatcher
+
+
+@jax.jit
+def _fused_unscale(grads, inv):
+    """grads * inv + one global finite flag, compiled as one program."""
+    scaled = tuple(g * inv.astype(g.dtype) for g in grads)
+    finite = jnp.all(jnp.stack(
+        [jnp.all(jnp.isfinite(g)) for g in scaled]))
+    return scaled, ~finite
+
+# O1 lists (reference python/paddle/amp/amp_lists.py white/black lists)
+WHITE_LIST: Set[str] = {
+    "matmul", "bmm", "mv", "linear", "conv2d", "conv1d", "conv2d_transpose",
+    "einsum_impl", "scaled_dot_product_attention", "flash_attention", "addmm",
+}
+BLACK_LIST: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square",
+    "softmax_with_cross_entropy", "cross_entropy_mean", "nll_loss",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits", "kl_div",
+    "layer_norm", "rms_norm", "batch_norm_train", "batch_norm_infer",
+    "group_norm", "instance_norm", "softmax", "log_softmax", "logsumexp",
+    "mean", "sum", "norm", "cosine_similarity",
+}
+
+_state = {"enable": False, "dtype": None, "level": "O1",
+          "custom_white": set(), "custom_black": set()}
+
+
+def _amp_hook(schema, primals):
+    if not _state["enable"]:
+        return primals
+    low = _state["dtype"]
+    name = schema.name
+    white = (name in WHITE_LIST or name in _state["custom_white"])
+    black = (name in BLACK_LIST or name in _state["custom_black"])
+    if _state["level"] == "O2":
+        cast_low = not black
+    else:
+        cast_low = white and not black
+    out = []
+    for p in primals:
+        if jnp.issubdtype(p.dtype, jnp.floating):
+            if cast_low and p.dtype != low:
+                p = p.astype(low)
+            elif not cast_low and black and p.dtype == low:
+                p = p.astype(jnp.float32)
+        out.append(p)
+    return out
+
+
+dispatcher.set_amp_hook(_amp_hook)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    """paddle.amp.auto_cast (reference auto_cast.py:729)."""
+    prev = dict(_state)
+    _state.update(
+        enable=enable,
+        dtype=dtype_mod.convert_dtype(dtype),
+        level=level,
+        custom_white=set(custom_white_list or ()),
+        custom_black=set(custom_black_list or ()),
+    )
+    try:
+        yield
+    finally:
+        _state.clear()
+        _state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models=None, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the low dtype (reference
+    auto_cast.py amp_decorate); optimizer keeps fp32 masters
+    (multi_precision)."""
+    low = dtype_mod.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        if m is not None:
+            m.to(dtype=low)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Loss scaling for fp16 (reference grad_scaler.py:579). For bf16 —
+    the TPU default — scaling is unnecessary: scale stays 1 and this is a
+    pass-through with the same API."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = init_loss_scaling if enable else 1.0
+        self._incr_ratio, self._decr_ratio = incr_ratio, decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = set()  # optimizers already unscaled this cycle
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable or self._scale == 1.0:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        """One fused jitted pass over all grads: unscale + global finite
+        check, with a single host sync (the reference's check_finite_and_
+        unscale kernel, grad_scaler.py:579 — NOT a per-param Python loop,
+        which would serialize the device once per parameter)."""
+        if not self._enable:
+            return
+        if id(optimizer) in self._unscaled:  # guard against double unscale
+            return
+        self._unscaled.add(id(optimizer))
+        inv = 1.0 / self._scale
+        with_grads = [p for p in optimizer._parameter_list
+                      if p.grad is not None]
+        if not with_grads:
+            self._found_inf = False
+            return
+        grads = tuple(p.grad._data for p in with_grads)
+        new_grads, found = _fused_unscale(grads, jnp.float32(inv))
+        for p, g in zip(with_grads, new_grads):
+            p.grad._set_data(g)
+        self._found_inf = bool(found)  # the one host sync per step
+
+    def step(self, optimizer):
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled.discard(id(optimizer))
+        self._update_scale()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self):
+        pass  # paddle calls scaler.update() after step in some recipes
+
+    def _update_scale(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(1.0, self._scale * self._decr_ratio)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good_steps,
+                "bad": self._bad_steps}
+
+    def set_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good"]
+        self._bad_steps = sd["bad"]
+
+from . import debugging  # noqa: E402,F401

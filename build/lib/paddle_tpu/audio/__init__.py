@@ -1,0 +1,8 @@
+"""paddle_tpu.audio — audio features/functionals (SURVEY §2.6 domain libs)."""
+
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,171 @@
+"""Audio DSP functionals (reference python/paddle/audio/functional/
+functional.py + window.py: hz_to_mel/mel_to_hz/compute_fbank_matrix/
+create_dct/power_to_db/get_window)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def hz_to_mel(freq: Union[float, Tensor], htk: bool = False):
+    """Hertz → mel (Slaney by default, HTK optional) — reference
+    functional.py hz_to_mel."""
+    scalar = not isinstance(freq, Tensor)
+    f = jnp.asarray(freq._data if isinstance(freq, Tensor) else freq,
+                    jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep,
+                        mel)
+    return float(mel) if scalar else Tensor(mel)
+
+
+def mel_to_hz(mel: Union[float, Tensor], htk: bool = False):
+    scalar = not isinstance(mel, Tensor)
+    m = jnp.asarray(mel._data if isinstance(mel, Tensor) else mel,
+                    jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                       hz)
+    return float(hz) if scalar else Tensor(hz)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False) -> Tensor:
+    m_min = hz_to_mel(f_min, htk)
+    m_max = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(m_min, m_max, n_mels)
+    return mel_to_hz(Tensor(mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int) -> Tensor:
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney") -> Tensor:
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2] (reference
+    functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)._data
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"
+               ) -> Tensor:
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.py create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+        dct = dct * math.sqrt(2.0 / n_mels)
+    else:
+        dct = dct * 2.0
+    return Tensor(dct)
+
+
+def power_to_db(spect: Tensor, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0) -> Tensor:
+    x = spect._data if isinstance(spect, Tensor) else jnp.asarray(spect)
+    db = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    db = db - 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+    if top_db is not None:
+        db = jnp.maximum(db, db.max() - top_db)
+    return Tensor(db)
+
+
+_WINDOWS = {}
+
+
+def _window(name):
+    def deco(fn):
+        _WINDOWS[name] = fn
+        return fn
+    return deco
+
+
+@_window("hann")
+def _hann(n, fftbins=True):
+    return jnp.hanning(n + 1)[:-1] if fftbins else jnp.hanning(n)
+
+
+@_window("hamming")
+def _hamming(n, fftbins=True):
+    return jnp.hamming(n + 1)[:-1] if fftbins else jnp.hamming(n)
+
+
+@_window("blackman")
+def _blackman(n, fftbins=True):
+    return jnp.blackman(n + 1)[:-1] if fftbins else jnp.blackman(n)
+
+
+@_window("rect")
+def _rect(n, fftbins=True):
+    return jnp.ones(n)
+
+
+@_window("bartlett")
+def _bartlett(n, fftbins=True):
+    return jnp.bartlett(n + 1)[:-1] if fftbins else jnp.bartlett(n)
+
+
+@_window("kaiser")
+def _kaiser(n, fftbins=True, beta=12.0):
+    return jnp.kaiser(n + 1, beta)[:-1] if fftbins else jnp.kaiser(n, beta)
+
+
+@_window("gaussian")
+def _gaussian(n, fftbins=True, std=7.0):
+    m = n + 1 if fftbins else n
+    i = jnp.arange(m) - (m - 1) / 2
+    w = jnp.exp(-0.5 * (i / std) ** 2)
+    return w[:-1] if fftbins else w
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True) -> Tensor:
+    """reference window.py get_window: name or (name, param) tuple."""
+    if isinstance(window, tuple):
+        name, *params = window
+        fn = _WINDOWS.get(name)
+        if fn is None:
+            raise ValueError(f"unknown window '{name}'")
+        return Tensor(fn(win_length, fftbins, *params).astype(jnp.float32))
+    fn = _WINDOWS.get(window)
+    if fn is None:
+        raise ValueError(f"unknown window '{window}' "
+                         f"(have {sorted(_WINDOWS)})")
+    return Tensor(fn(win_length, fftbins).astype(jnp.float32))

@@ -1,0 +1,4 @@
+"""Autograd: tape engine, grad modes, PyLayer (reference python/paddle/autograd)."""
+from .engine import backward, grad, no_grad, enable_grad, is_grad_enabled  # noqa: F401
+from . import functional  # noqa: E402,F401
+from .functional import jacobian, hessian, jvp, vjp, vhp  # noqa: E402,F401

@@ -1,0 +1,129 @@
+"""Functional higher-order AD (reference python/paddle/autograd/functional —
+jacobian/hessian — and python/paddle/incubate/autograd/primapi.py:108
+grad/jvp/vjp).
+
+TPU-native: the user function (built from framework ops) is value-
+transparent over jax arrays, so jax's own transforms (jacrev/jacfwd/jvp/vjp)
+apply directly — no bespoke double-grad engine (the reference needs
+composite grad rules + prim lowering for the same capability)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .engine import no_grad
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp", "vhp"]
+
+
+def _as_arrays(xs):
+    single = not isinstance(xs, (tuple, list))
+    seq = [xs] if single else list(xs)
+    return [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in seq], single
+
+
+def _pure(func, single_in):
+    """Lift a Tensor->Tensor(s) function to arrays->array(s); the tape is
+    disabled — jax traces the derivatives."""
+
+    def f(*arrays):
+        with no_grad():
+            ts = [Tensor(a) for a in arrays]
+            out = func(ts[0]) if single_in else func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return f
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(Tensor, tree)
+
+
+def jacobian(func: Callable, xs, create_graph: bool = False,
+             allow_unused: bool = False, mode: str = "rev"):
+    """d func / d xs. Single input & output → a Tensor [*out_shape,
+    *in_shape]; multiple inputs/outputs → nested tuples (reference
+    autograd/functional.jacobian layout)."""
+    arrays, single = _as_arrays(xs)
+    jac_fn = jax.jacrev if mode == "rev" else jax.jacfwd
+    # single input: scalar argnums — no per-argnums tuple nesting, so a
+    # multi-output func yields (J1, J2, ...) directly
+    argnums = 0 if single else tuple(range(len(arrays)))
+    jac = jac_fn(_pure(func, single), argnums=argnums)(*arrays)
+    return _wrap(jac)
+
+
+def hessian(func: Callable, xs, create_graph: bool = False,
+            allow_unused: bool = False):
+    """d² func / d xs² for a scalar-valued func."""
+    arrays, single = _as_arrays(xs)
+    f = _pure(func, single)
+
+    def scalar(*a):
+        out = f(*a)
+        out = out[0] if isinstance(out, tuple) else out
+        return out.reshape(())
+
+    hes = jax.hessian(scalar, argnums=tuple(range(len(arrays))))(*arrays)
+    out = _wrap(hes)
+    if single:
+        # unwrap ((H,),) nesting from the argnums tuple
+        while isinstance(out, tuple) and len(out) == 1:
+            out = out[0]
+    return out
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), J·v) (reference incubate.autograd
+    jvp)."""
+    arrays, single = _as_arrays(xs)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        tangents, _ = _as_arrays(v)
+    out, tangent_out = jax.jvp(_pure(func, single), tuple(arrays),
+                               tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), vᵀ·J) (reference incubate.autograd
+    vjp)."""
+    arrays, single = _as_arrays(xs)
+    out, vjp_fn = jax.vjp(_pure(func, single), *arrays)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cv, _ = _as_arrays(v)
+        cot = tuple(cv) if isinstance(out, tuple) else cv[0]
+    grads = vjp_fn(cot)
+    grads_t = _wrap(grads if not single else grads[0])
+    return _wrap(out), grads_t
+
+
+def vhp(func: Callable, xs, v=None):
+    """Hessian-vector product for scalar func: returns (func(xs), H·v)."""
+    arrays, single = _as_arrays(xs)
+    f = _pure(func, single)
+
+    def scalar(*a):
+        out = f(*a)
+        out = out[0] if isinstance(out, tuple) else out
+        return out.reshape(())
+
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        tangents, _ = _as_arrays(v)
+    # one traced computation: primal value + grads, jvp'd for the HVP
+    vg = jax.value_and_grad(scalar, argnums=tuple(range(len(arrays))))
+    (out, _), (_, hvp) = jax.jvp(vg, tuple(arrays), tuple(tangents))
+    hvp_t = _wrap(hvp if not single else hvp[0])
+    return Tensor(out), hvp_t

@@ -1,0 +1,1 @@
+"""Core runtime: dtype/device/generator/Tensor (reference paddle/phi/core)."""

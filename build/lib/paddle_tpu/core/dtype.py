@@ -1,0 +1,73 @@
+"""Data types and default-dtype control.
+
+Analog of the reference dtype system (paddle/phi/common/data_type.h,
+python `paddle.float32` etc.). We expose jnp dtypes directly — on TPU the
+set that matters is {bfloat16, float32, int32, ...}; bfloat16 is the
+native matmul type for the MXU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Public dtype singletons (paddle.float32 etc.)
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int": int32,
+    "int64": int64, "long": int64, "uint8": uint8,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype):
+    """Normalize str/np/jnp dtype spec to a canonical jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"unknown dtype string: {dtype!r}")
+        return _STR2DTYPE[dtype]
+    return jnp.dtype(dtype).type
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    dtype = convert_dtype(dtype)
+    if dtype not in (bfloat16, float16, float32, float64):
+        raise ValueError("default dtype must be a floating point type")
+    _default_dtype = dtype
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name if dtype is not None else "None"

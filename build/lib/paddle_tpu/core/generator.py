@@ -1,0 +1,67 @@
+"""Random number generation: stateful facade over functional PRNG keys.
+
+Analog of the reference Generator (paddle/phi/core/generator.h — per-device
+Philox state with seed control). TPU-native design: a single global
+`Generator` holds a threefry key; every random op *consumes* a fresh subkey
+via `next_key()` and receives it as an explicit argument, so recomputation
+in cached VJPs (and under `jax.checkpoint`) is deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._offset = 0
+
+    def manual_seed(self, seed: int) -> "Generator":
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = 0
+        return self
+
+    seed = manual_seed
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self) -> jax.Array:
+        """Split off a fresh subkey (advances state)."""
+        self._key, sub = jax.random.split(self._key)
+        self._offset += 1
+        return sub
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state):
+        self.manual_seed(state["seed"])
+        for _ in range(state["offset"]):
+            self.next_key()
+
+
+_default_generator: Optional[Generator] = None
+
+
+def default_generator() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        _default_generator = Generator(0)
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed(s): reseed the global generator (and numpy for loaders)."""
+    np.random.seed(s % (2**32))
+    return default_generator().manual_seed(s)
+
+
+def next_key() -> jax.Array:
+    return default_generator().next_key()

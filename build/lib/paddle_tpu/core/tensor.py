@@ -1,0 +1,240 @@
+"""Eager Tensor: a mutable handle over an immutable jax.Array.
+
+TPU-native rethink of the reference eager tensor
+(paddle/phi/core/dense_tensor.h:37 DenseTensor + paddle/fluid/eager
+AutogradMeta). The device buffer itself is a functional `jax.Array` (PJRT
+buffer); Python-level mutability (in-place ops, `param.grad`, optimizer
+updates, `__setitem__`) is expressed by *rebinding* `_data` and bumping an
+inplace-version counter, which is exactly the buffer-aliasing discipline
+XLA donation expects.
+
+Autograd metadata lives directly on the tensor (`_node`, `_out_idx`): the
+producing GradNode and which of its outputs this tensor is — the analog of
+AutogradMeta/GradNodeBase edges (paddle/fluid/eager/grad_node_info.h:197).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "_stop_gradient", "_grad", "_node", "_out_idx",
+        "_version", "name", "persistable", "_leaf_hooks", "main_grad",
+        "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            dt = dtype_mod.convert_dtype(dtype)
+            arr = np.asarray(data)
+            if dt is None and arr.dtype == np.float64:
+                dt = dtype_mod.get_default_dtype()
+            data = jnp.asarray(arr, dtype=dt)
+        elif dtype is not None:
+            data = data.astype(dtype_mod.convert_dtype(dtype))
+        self._data = data
+        self._stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._node = None      # producing GradNode (autograd.engine.GradNode)
+        self._out_idx = 0      # index among that node's outputs
+        self._version = 0
+        self.name = name
+        self.persistable = False
+
+    # -- basic properties ----------------------------------------------------
+    @property
+    def data(self) -> jax.Array:
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._set_data(value if isinstance(value, jax.Array) else Tensor(value)._data)
+
+    def _set_data(self, arr: jax.Array):
+        """In-place rebind of the underlying buffer (version bump)."""
+        self._data = arr
+        self._version += 1
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def place(self):
+        from .device import Place
+        devs = list(self._data.devices()) if hasattr(self._data, "devices") else []
+        return Place(devs[0]) if devs else None
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self._stop_gradient = bool(v)
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def inplace_version(self) -> int:
+        return self._version
+
+    # -- conversion ----------------------------------------------------------
+    # numpy must defer to our reflected dunders instead of consuming the
+    # tensor via __array__ (which would silently drop autograd).
+    __array_ufunc__ = None
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from ..ops import dispatcher  # late import; cast records autograd
+        return dispatcher.call_op("cast", self, dtype=dtype)
+
+    cast = astype
+
+    def clone(self) -> "Tensor":
+        from ..ops import dispatcher
+        return dispatcher.call_op("assign", self)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_get(self._data))
+
+    def to(self, device=None, dtype=None) -> "Tensor":
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from .device import Place, _parse_place
+            place = device if isinstance(device, Place) else _parse_place(str(device))
+            out = Tensor(jax.device_put(out._data, place.device), stop_gradient=out.stop_gradient)
+        return out
+
+    # -- autograd ------------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        from ..autograd import engine
+        engine.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad._set_data(jnp.zeros_like(self._grad._data))
+        else:
+            self._grad = None
+
+    clear_grad = clear_gradient
+
+    def register_hook(self, hook):
+        from ..autograd import engine
+        return engine.register_tensor_hook(self, hook)
+
+    # -- python protocol -----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        g = "" if self._stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}{g},\n"
+                f"       {np.array2string(self.numpy(), prefix='       ')})")
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __getitem__(self, idx):
+        from ..ops import dispatcher
+        idx = tuple(idx) if isinstance(idx, list) else idx
+        if _index_has_tensor(idx):
+            idx = jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t, idx,
+                               is_leaf=lambda x: isinstance(x, Tensor))
+        return dispatcher.call_op("getitem", self, index=idx)
+
+    def __setitem__(self, idx, value):
+        value = Tensor(value) if not isinstance(value, Tensor) else value
+        if not self._stop_gradient and self._node is not None:
+            raise RuntimeError("in-place __setitem__ on a non-leaf tensor that requires "
+                               "grad is not supported; use paddle_tpu.where / scatter")
+        self._set_data(self._data.at[idx].set(value._data.astype(self._data.dtype)))
+
+    @property
+    def T(self) -> "Tensor":
+        from ..ops import dispatcher
+        return dispatcher.call_op("transpose", self, perm=tuple(range(self.ndim))[::-1])
+
+    # arithmetic dunders are attached by ops.dispatcher at import time.
+
+
+def _index_has_tensor(idx) -> bool:
+    if isinstance(idx, Tensor):
+        return True
+    if isinstance(idx, tuple):
+        return any(isinstance(i, Tensor) for i in idx)
+    return False
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor — entry point for tensor creation from host data."""
+    t = Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+    if place is not None:
+        t = t.to(device=place)
+        t.stop_gradient = stop_gradient
+    return t

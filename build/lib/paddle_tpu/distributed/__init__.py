@@ -1,0 +1,61 @@
+"""paddle.distributed surface (reference python/paddle/distributed, 133k LoC).
+
+GSPMD-first: ProcessMesh → jax Mesh, Shard/Replicate/Partial → PartitionSpec,
+reshard → device_put; manual strategies (fleet mpu layers, sharding stages,
+PP schedules, SEP ring attention, MoE a2a) are re-expressed as sharding
+annotations + shard_map. See SURVEY.md §2.5 / §7 for the full mapping table.
+"""
+
+from . import checkpoint  # noqa: F401
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from .api import (  # noqa: F401
+    shard_tensor, reshard, shard_layer, shard_optimizer, shard_parameter,
+    dtensor_from_fn, unshard_dtensor, get_placements, is_dist_tensor,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, ParallelEnv, init_parallel_env, get_rank, get_world_size,
+    new_group, barrier, all_reduce, all_gather, broadcast, reduce, scatter,
+    all_to_all, reduce_scatter, send, recv, isend, irecv, P2POp,
+    batch_isend_irecv, all_gather_object, scatter_object_list,
+)
+from .placements import Placement, Partial, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh, create_mesh, get_mesh, set_mesh  # noqa: F401
+from .topology import (  # noqa: F401
+    AXIS_ORDER, CommunicateTopology, HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+)
+
+
+class DataParallel:
+    """paddle.DataParallel (reference python/paddle/distributed/parallel.py:202
+    + EagerReducer reducer.cc): wraps a layer for data parallelism. On the
+    GSPMD mesh this delegates to fleet's replicated-model wrapper; grads are
+    reduced by construction, so there is no bucketed reducer to configure."""
+
+    def __new__(cls, layers, strategy=None, comm_buffer_size=25,
+                last_comm_buffer_size=1, find_unused_parameters=False,
+                group=None):
+        from .topology import get_hybrid_communicate_group
+        if get_hybrid_communicate_group() is None:
+            from . import fleet as fleet_mod
+            fleet_mod.init(is_collective=True)
+        from .fleet import _ReplicatedModelWrapper
+        return _ReplicatedModelWrapper(layers, get_hybrid_communicate_group())
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """reference distributed/spawn.py — single-controller runtime drives all
+    local devices in-process, so spawn degenerates to a direct call."""
+    return func(*args)
+from . import sharding  # noqa: E402,F401
+from .sharding import (  # noqa: E402,F401
+    DygraphShardingOptimizer, group_sharded_parallel, save_group_sharded_model,
+    shard_optimizer_states)
+from . import watchdog  # noqa: E402,F401
+from .watchdog import comm_watchdog  # noqa: E402,F401
+from . import spmd_rules  # noqa: E402,F401
+from .spmd_rules import get_spmd_rule, DistTensorSpec  # noqa: E402,F401
+from . import auto_parallel  # noqa: E402,F401
+from .auto_parallel import (  # noqa: E402,F401
+    DistModel, Engine, Strategy, to_static)

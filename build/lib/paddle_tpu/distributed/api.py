@@ -1,0 +1,155 @@
+"""Semi-auto parallel user API — the DistTensor surface.
+
+Reference: python/paddle/distributed/auto_parallel/api.py
+(shard_tensor:126, reshard:304, shard_layer:403, shard_optimizer:736).
+
+TPU-native: a DistTensor IS an eager Tensor whose jax.Array carries a
+NamedSharding; placement propagation (the reference's InferSpmd + reshard
+12-step dist branch, dist_api_gen.py:47-66) is GSPMD's sharding propagation
+inside each jitted op; explicit `reshard` is `jax.device_put` with the target
+NamedSharding, which XLA lowers to the right collective (all-gather,
+collective-permute, slice...).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding
+
+from ..core.tensor import Tensor
+from .placements import Placement, Partial, Replicate, Shard, placements_to_spec, \
+    spec_to_placements
+from .process_mesh import ProcessMesh
+
+
+def _named_sharding(mesh: ProcessMesh, placements: Sequence[Placement], ndim: int
+                    ) -> NamedSharding:
+    spec = placements_to_spec(placements, mesh.dim_names, ndim)
+    return NamedSharding(mesh.mesh, spec)
+
+
+def shard_tensor(tensor, mesh: ProcessMesh, placements: Sequence[Placement],
+                 stop_gradient: Optional[bool] = None) -> Tensor:
+    """Distribute a tensor over `mesh` per `placements`
+    (reference auto_parallel/api.py:126)."""
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    sharding = _named_sharding(mesh, placements, t.ndim)
+    out = Tensor(jax.device_put(t._data, sharding),
+                 stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient)
+    out.name = t.name
+    return out
+
+
+def reshard(tensor: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]
+            ) -> Tensor:
+    """Move a DistTensor to a new distribution (reference api.py:304 →
+    reshard function registry phi/core/distributed/auto_parallel/reshard/).
+    XLA chooses the collective: s→r = all-gather, r→s = local slice,
+    s→s' = collective-permute/all-to-all."""
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    sharding = _named_sharding(mesh, placements, t.ndim)
+    out = Tensor(jax.device_put(t._data, sharding), stop_gradient=t.stop_gradient)
+    out.name = t.name
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs) -> Tensor:
+    """Create a sharded tensor directly with the target layout (reference
+    api.py dtensor_from_fn) — under jit the init computes shard-locally, so
+    giant params never materialize unsharded."""
+    sharding_holder = {}
+
+    def make():
+        t = fn(*args, **kwargs)
+        return t._data if isinstance(t, Tensor) else t
+
+    probe = jax.eval_shape(make)
+    sharding = _named_sharding(mesh, placements, len(probe.shape))
+    arr = jax.jit(make, out_shardings=sharding)()
+    return Tensor(arr)
+
+
+def get_placements(tensor: Tensor, mesh: Optional[ProcessMesh] = None
+                   ) -> Optional[List[Placement]]:
+    """Introspect a tensor's current placements (dist_attr parity)."""
+    sharding = getattr(tensor._data, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return None
+    names = sharding.mesh.axis_names
+    return spec_to_placements(sharding.spec, names, tensor.ndim)
+
+
+def is_dist_tensor(tensor: Tensor) -> bool:
+    sharding = getattr(tensor._data, "sharding", None)
+    return isinstance(sharding, NamedSharding) and sharding.mesh.size > 1
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """Shard every parameter of `layer` (reference api.py:403). `shard_fn`
+    (name, layer, mesh) customizes per-sublayer; default replicates."""
+    def default_fn(name, sublayer, mesh):
+        for pname, p in sublayer._parameters.items():
+            if p is not None:
+                p._set_data(jax.device_put(
+                    p._data, _named_sharding(mesh, [Replicate()] * mesh.ndim,
+                                             p.ndim)))
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def shard_parameter(param: Tensor, mesh: ProcessMesh,
+                    placements: Sequence[Placement]):
+    """In-place shard one parameter (keeps identity for optimizers)."""
+    param._set_data(jax.device_put(
+        param._data, _named_sharding(mesh, placements, param.ndim)))
+    return param
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference api.py:736. States of params that are already sharded
+    inherit the param sharding automatically. Beyond that:
+
+    - shard_fn given: applied to each param (caller-controlled resharding,
+      reference's custom shard_fn path).
+    - shard_fn None (default): if a hybrid group with sharding_degree > 1 is
+      active, optimizer state (masters + moments) is sharded over the
+      "sharding" mesh axis — real ZeRO stage 1 (reference
+      dygraph_sharding_optimizer.py:48); otherwise a no-op.
+    """
+    if shard_fn is not None:
+        for p in optimizer._parameter_list:
+            shard_fn(p)
+        return optimizer
+    from .topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.axis_degree("sharding") > 1:
+        from .sharding import shard_optimizer_states
+        shard_optimizer_states(optimizer, hcg.mesh.mesh, "sharding")
+    return optimizer
+
+
+def unshard_dtensor(tensor: Tensor) -> Tensor:
+    """Gather to a fully-replicated host-convertible tensor (reference
+    api.py unshard_dtensor)."""
+    arr = tensor._data
+    sharding = getattr(arr, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        arr = jax.device_put(
+            arr, NamedSharding(sharding.mesh,
+                               jax.sharding.PartitionSpec(*([None] * arr.ndim))))
+    return Tensor(arr, stop_gradient=tensor.stop_gradient)

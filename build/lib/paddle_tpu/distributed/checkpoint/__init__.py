@@ -1,0 +1,8 @@
+"""paddle_tpu.distributed.checkpoint — sharded checkpoint with
+reshard-on-load (SURVEY §5 checkpoint/resume)."""
+
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
+from .save_load import load_state_dict, save_state_dict  # noqa: F401
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+           "LocalTensorMetadata", "LocalTensorIndex"]

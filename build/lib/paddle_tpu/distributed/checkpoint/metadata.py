@@ -1,0 +1,73 @@
+"""Checkpoint metadata types (reference
+python/paddle/distributed/checkpoint/metadata.py:20-40 —
+LocalTensorMetadata/LocalTensorIndex/Metadata).
+
+A checkpoint is a directory of per-process shard files plus one
+`metadata.json` describing, for every tensor key, which global-offset boxes
+exist and which file stores each box.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One saved shard of one tensor: its box in the global array."""
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    # key -> all saved shard boxes of that tensor
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    # (key, offset) -> file name holding that box
+    storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
+    flat_mapping: Dict[str, List[str]] = field(default_factory=dict)
+    # number of writer processes in the save that produced this checkpoint;
+    # load unions exactly this many per-rank metadata files, so leftovers
+    # from an older save with a larger world never leak in.
+    world_size: int = 1
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "world_size": self.world_size,
+            "state_dict_metadata": {
+                k: [{"global_offset": list(m.global_offset),
+                     "local_shape": list(m.local_shape),
+                     "dtype": m.dtype} for m in v]
+                for k, v in self.state_dict_metadata.items()},
+            "storage_metadata": [
+                {"tensor_key": idx.tensor_key,
+                 "global_offset": list(idx.global_offset), "file": fname}
+                for idx, fname in self.storage_metadata.items()],
+            "flat_mapping": self.flat_mapping,
+        }, indent=1)
+
+    @staticmethod
+    def from_json(payload: str) -> "Metadata":
+        raw = json.loads(payload)
+        md = Metadata(world_size=raw.get("world_size", 1))
+        for k, v in raw["state_dict_metadata"].items():
+            md.state_dict_metadata[k] = [
+                LocalTensorMetadata(tuple(m["global_offset"]),
+                                    tuple(m["local_shape"]), m["dtype"])
+                for m in v]
+        for e in raw["storage_metadata"]:
+            md.storage_metadata[
+                LocalTensorIndex(e["tensor_key"], tuple(e["global_offset"]))
+            ] = e["file"]
+        md.flat_mapping = raw.get("flat_mapping", {})
+        return md

@@ -1,0 +1,444 @@
+"""Collective communication Python API.
+
+Reference: python/paddle/distributed/communication/* (all_reduce.py,
+all_gather.py, ...) over ProcessGroupNCCL (process_group_nccl.cc).
+
+TPU-native semantics: under a single controller, tensors are global objects
+carrying shardings, so SPMD collectives are *implicit* (GSPMD). This API
+exists for (a) reference parity, (b) explicit cross-axis operations on
+sharded eager tensors, where each call lowers to a tiny jitted shard_map
+with the matching jax collective over the named axis — riding ICI exactly
+like the NCCL ring rides NVLink.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .. import flags
+from . import env
+from .topology import get_hybrid_communicate_group
+
+
+def _watched(fn):
+    """Bracket an eager collective with a watchdog CommTask (reference
+    comm_task_manager.h:37): for sync ops the call blocks inside the task
+    scope, so a DCN/cross-host stall trips the timeout handler instead of
+    hanging silently."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from .watchdog import comm_watchdog
+        mgr = comm_watchdog()
+        with mgr.start_task(f"eager:{fn.__name__}",
+                            timeout_s=float(flags.get_flag("comm_timeout_s")),
+                            rank=env.get_rank()):
+            out = fn(*args, **kwargs)
+            if kwargs.get("sync_op", True):
+                try:
+                    jax.block_until_ready(
+                        out._data if isinstance(out, Tensor) else out)
+                except (AttributeError, TypeError):
+                    pass  # list outputs / None: already synced by impl
+            return out
+    return wrapper
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a mesh axis name (+ degree)."""
+
+    def __init__(self, axis: str, degree: int, ranks=None):
+        self.axis = axis
+        self.nranks = degree
+        self.ranks = ranks or list(range(degree))
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return env.get_rank()
+
+    @property
+    def world_size(self):
+        return env.get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+
+def init_parallel_env() -> ParallelEnv:
+    """reference parallel.py:943 — rendezvous + process-group bootstrap over
+    TCPStore (tcp_store.h:121).
+
+    Multi-host: when the launcher (distributed/launch) exported a world size
+    > 1, this calls ``jax.distributed.initialize(coordinator, n, rank)`` with
+    the envs the launcher set (PADDLE_DIST_COORDINATOR / PADDLE_TRAINERS_NUM
+    / PADDLE_TRAINER_ID), connecting this process to the XLA coordination
+    service — after which ``jax.devices()`` spans every host and GSPMD
+    collectives ride ICI/DCN across them. Must run before the first device
+    use (same ordering contract as the reference's init_parallel_env).
+
+    Single-process launches (world size 1) skip initialization — the single
+    controller already owns all local devices.
+    """
+    import os
+
+    world = env.get_world_size()
+    if world > 1 and not jax.distributed.is_initialized():
+        coordinator = os.environ.get("PADDLE_DIST_COORDINATOR") \
+            or os.environ.get("PADDLE_MASTER")
+        if not coordinator:
+            if "PADDLE_TRAINERS_NUM" not in os.environ:
+                # world size came from a generic WORLD_SIZE leftover (other
+                # launchers export it); without our launcher's envs this is
+                # not a paddle multi-host launch — stay single-process
+                import warnings
+                warnings.warn(
+                    f"init_parallel_env: WORLD_SIZE={world} is set but no "
+                    "coordinator address and no PADDLE_TRAINERS_NUM; "
+                    "ignoring it and initializing single-process.")
+                return ParallelEnv()
+            # a silent skip here would leave jax host-local while the app
+            # believes world_size=N — collectives would compute wrong
+            # (local-only) results and P2P would deadlock the peer host
+            raise RuntimeError(
+                f"init_parallel_env: world size {world} but no coordinator "
+                "address (PADDLE_DIST_COORDINATOR / PADDLE_MASTER). Launch "
+                "through `python -m paddle_tpu.distributed.launch` or export "
+                "the coordinator env.")
+        try:  # CPU backend needs a cross-process collectives impl
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # config knob absent/renamed: TPU path doesn't need it
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=world,
+                                   process_id=env.get_rank())
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    return env.get_rank()
+
+
+def get_world_size(group=None) -> int:
+    return env.get_world_size()
+
+
+def new_group(ranks=None, backend=None, axis: str = "dp") -> Group:
+    return Group(axis, len(ranks) if ranks else get_world_size(), ranks)
+
+
+@_watched
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def _axis_of(group) -> Optional[str]:
+    if isinstance(group, Group):
+        return group.axis
+    if isinstance(group, str):
+        return group
+    return None
+
+
+def _sharded_axes(t: Tensor):
+    sh = getattr(t._data, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None, []
+    names = []
+    for entry in sh.spec:
+        if entry is None:
+            continue
+        names.extend(entry if isinstance(entry, tuple) else (entry,))
+    return sh, names
+
+
+@_watched
+def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
+               sync_op: bool = True):
+    """On a tensor sharded over the group axis: psum/pmax over that axis and
+    return it replicated (paddle mutates in place — we match that)."""
+    axis = _axis_of(group)
+    sh, axes = _sharded_axes(tensor)
+    target = axis if axis in axes else (axes[0] if axes else None)
+    if target is None:
+        return tensor  # replicated already — allreduce is identity
+    mesh = sh.mesh
+
+    def _prod(x, ax):  # no lax.pprod: gather then reduce locally
+        return jnp.prod(jax.lax.all_gather(x, ax), axis=0)
+
+    reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+               "prod": _prod}[
+        "sum" if op in (ReduceOp.SUM, ReduceOp.AVG) else op]
+
+    in_spec = sh.spec
+    out_spec = PartitionSpec(*[
+        _strip_axis(e, target) for e in _pad_spec(in_spec, tensor.ndim)])
+    fn = jax.jit(jax.shard_map(
+        lambda x: reducer(x, target), mesh=mesh,
+        in_specs=(in_spec,), out_specs=out_spec))
+    out = fn(tensor._data)
+    if op == ReduceOp.AVG:
+        out = out / mesh.shape[target]
+    tensor._set_data(out)
+    return tensor
+
+
+def _pad_spec(spec, ndim):
+    entries = list(spec)
+    return entries + [None] * (ndim - len(entries))
+
+
+def _strip_axis(entry, axis):
+    if entry is None:
+        return None
+    if entry == axis:
+        return None
+    if isinstance(entry, tuple):
+        rest = tuple(e for e in entry if e != axis)
+        return rest if len(rest) > 1 else (rest[0] if rest else None)
+    return entry
+
+
+@_watched
+def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
+    """Gather shards into per-rank tensors (reference all_gather.py)."""
+    sh, axes = _sharded_axes(tensor)
+    if not axes:
+        n = (group.nranks if isinstance(group, Group) else 1)
+        tensor_list.extend(Tensor(tensor._data) for _ in range(max(n, 1)))
+        return tensor_list
+    axis = _axis_of(group) or axes[0]
+    mesh = sh.mesh
+    full = jax.device_put(tensor._data, NamedSharding(
+        mesh, PartitionSpec(*([None] * tensor.ndim))))
+    # split along the tensor dim that was sharded by `axis`
+    dim = 0
+    for d, entry in enumerate(_pad_spec(sh.spec, tensor.ndim)):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if entry is not None and axis in names:
+            dim = d
+            break
+    n = mesh.shape[axis]
+    for piece in jnp.split(full, n, axis=dim):
+        tensor_list.append(Tensor(piece))
+    return tensor_list
+
+
+@_watched
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    """Single-controller tensors are already consistent; replicate placement."""
+    sh, axes = _sharded_axes(tensor)
+    if axes:
+        mesh = sh.mesh
+        tensor._set_data(jax.device_put(tensor._data, NamedSharding(
+            mesh, PartitionSpec(*([None] * tensor.ndim)))))
+    return tensor
+
+
+@_watched
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+@_watched
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        stacked = jnp.concatenate([t._data for t in tensor_list], axis=0)
+        tensor._set_data(stacked[: tensor.shape[0]])
+    return tensor
+
+
+@_watched
+def all_to_all(out_tensor_list: List, in_tensor_list: List, group=None,
+               sync_op=True):
+    """Single-controller: transpose of the (rank, chunk) matrix."""
+    n = len(in_tensor_list)
+    for i in range(n):
+        chunks = jnp.split(in_tensor_list[i]._data, n, axis=0)
+        if len(out_tensor_list) < n:
+            out_tensor_list.extend([None] * (n - len(out_tensor_list)))
+    for j in range(n):
+        parts = [jnp.split(in_tensor_list[i]._data, n, axis=0)[j]
+                 for i in range(n)]
+        out_tensor_list[j] = Tensor(jnp.concatenate(parts, axis=0))
+    return out_tensor_list
+
+
+def split(x: Tensor, num_or_sections, axis=0):
+    from ..ops.dispatcher import call_op
+    return call_op("split", x, num_or_sections=num_or_sections, axis=axis)
+
+
+@_watched
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: str = ReduceOp.SUM,
+                   group=None, sync_op: bool = True):
+    """reference communication/reduce_scatter.py. Two input forms:
+
+    * list of per-rank contributions (same shape): elementwise `op`-reduce
+      across the list — a REAL reduction — and the result lands in `tensor`
+      (sharded over the group axis when a topology is active);
+    * a single full tensor (already reduced): resharded so dim 0 is split
+      over the group axis (the scatter half only — eager single-controller
+      arrays cannot carry pending-partial values; compiled code gets the
+      fused reduce-scatter from GSPMD automatically)."""
+    axis = _axis_of(group) or "dp"
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        parts = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                 for t in src]
+        red = {ReduceOp.SUM: jnp.add, ReduceOp.AVG: jnp.add,
+               ReduceOp.MAX: jnp.maximum, ReduceOp.MIN: jnp.minimum,
+               ReduceOp.PROD: jnp.multiply}[op]
+        out = functools.reduce(red, parts)
+        if op == ReduceOp.AVG:
+            out = out / len(parts)
+    else:
+        out = src._data if isinstance(src, Tensor) else jnp.asarray(src)
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        mesh = hcg.mesh.mesh
+        spec = [None] * out.ndim
+        spec[0] = axis
+        out = jax.device_put(out, NamedSharding(mesh, PartitionSpec(*spec)))
+    tensor._set_data(out)
+    return tensor
+
+
+# -- P2P (single-controller semantics) ----------------------------------------
+# Under one controller every "rank" shares the process: send/recv become a
+# tagged in-process queue (exactly how the reference's single-host test
+# harness exercises P2P), and cross-stage transfers inside compiled programs
+# ride ppermute (distributed/pipeline.py). Multi-host eager P2P is out of
+# scope for v1 (documented, PARITY.md §2.5).
+
+_p2p_queues: dict = {}
+_P2P_QUEUE_CAP = 64  # unconsumed sends are a leak — fail loudly, not slowly
+
+
+class P2POp:
+    """reference communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer: int, group=None):
+        self.op = op            # send | recv (function refs accepted)
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+class _Work:
+    def __init__(self):
+        self._done = True
+
+    def is_completed(self):
+        return self._done
+
+    def wait(self):
+        return None
+
+
+def _reject_cross_host_p2p():
+    """The queue lives in THIS process: in a real multi-host launch
+    (jax.distributed initialized) eager send/recv cannot reach the peer —
+    refuse loudly instead of silently deadlocking the other host."""
+    if jax.distributed.is_initialized() and env.get_world_size() > 1:
+        raise RuntimeError(
+            "eager send/recv is in-process only and cannot cross hosts; "
+            "use sharded collectives (all_to_all/ppermute via "
+            "distributed.pipeline) for cross-host transfers")
+
+
+@_watched
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op: bool = True):
+    _reject_cross_host_p2p()
+    q = _p2p_queues.setdefault((env.get_rank(), dst), [])
+    if len(q) >= _P2P_QUEUE_CAP:
+        raise RuntimeError(
+            f"send: {len(q)} unconsumed messages queued to rank {dst} — "
+            f"each send must be paired with a recv (compiled pipelines "
+            f"should use ppermute, not eager P2P)")
+    q.append(jnp.asarray(tensor._data))
+    return _Work()
+
+
+def isend(tensor: Tensor, dst: int = 0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+@_watched
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op: bool = True):
+    _reject_cross_host_p2p()
+    q = _p2p_queues.get((src, env.get_rank()), [])
+    if not q:
+        raise RuntimeError(
+            f"recv: no message queued from rank {src} (single-controller "
+            f"P2P pairs each recv with a prior send)")
+    tensor._set_data(q.pop(0))
+    return _Work()
+
+
+def irecv(tensor: Tensor, src: int = 0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def batch_isend_irecv(p2p_op_list) -> list:
+    """Execute sends first, then receives (reference batched semantics
+    avoid ordering deadlocks the same way)."""
+    sends, recvs = [], []
+    for p in p2p_op_list:
+        name = getattr(p.op, "__name__", str(p.op))
+        if name in ("send", "isend"):
+            sends.append(p)
+        elif name in ("recv", "irecv"):
+            recvs.append(p)
+        else:
+            raise ValueError(f"batch_isend_irecv: unrecognized op {p.op!r}")
+    works = [send(p.tensor, p.peer, p.group) for p in sends]
+    works += [recv(p.tensor, p.peer, p.group) for p in recvs]
+    return works
+
+
+# -- object collectives (host-side pickle, reference *_object APIs) -----------
+
+def all_gather_object(object_list: List, obj, group=None):
+    """Single-controller: every rank holds the same process — the gathered
+    list is world_size copies (multi-host object gather is a TCPStore
+    exchange in the launcher layer)."""
+    object_list.extend([obj] * env.get_world_size())
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None, src=0,
+                        group=None):
+    rank = env.get_rank()
+    if in_object_list is None:
+        raise NotImplementedError(
+            "scatter_object_list: non-src ranks passing None require a "
+            "cross-process object channel; under the single-controller "
+            "runtime every rank supplies in_object_list")
+    if rank >= len(in_object_list):
+        raise ValueError(
+            f"scatter_object_list: rank {rank} but only "
+            f"{len(in_object_list)} objects supplied")
+    out_object_list.append(in_object_list[rank])

@@ -1,0 +1,17 @@
+"""Process-level distributed environment (reference
+python/paddle/distributed/parallel.py get_rank/get_world_size, launcher envs
+PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM set by launch/controllers/collective.py:37).
+
+On TPU a single controller usually drives all local devices; multi-host
+launches set these envs per host process.
+"""
+import os
+
+
+def get_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+
+
+def get_world_size() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM",
+                              os.environ.get("WORLD_SIZE", 1)))

@@ -1,0 +1,257 @@
+"""Elastic training manager (reference fleet/elastic/manager.py:126).
+
+The reference registers nodes in etcd with TTL leases (:221-256) and watches
+membership to decide scale-in/out between --elastic_level bounds. No etcd in
+this stack: nodes heartbeat timestamped keys into the job's TCPStore and
+membership is derived from heartbeat freshness — same TTL-lease semantics,
+one fewer external service.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ...native.tcp_store import TCPStore
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"       # waiting for nodes
+    RESTART = "restart"  # membership changed -> relaunch
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store: TCPStore, node_id: str,
+                 np_min: int, np_max: Optional[int] = None,
+                 ttl: float = 10.0, job_id: str = "default"):
+        self.store = store
+        self.node_id = node_id
+        self.np_min = np_min
+        self.np_max = np_max or np_min
+        self.ttl = ttl
+        self.prefix = f"elastic/{job_id}"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_members: Optional[List[str]] = None
+        self.enabled = np_min > 0
+
+    # -- lease emulation -----------------------------------------------------
+    def register(self):
+        """Announce this node (membership index + first heartbeat) and start
+        the heartbeat lease."""
+        # a relaunched generation must not re-observe its own pre-restart
+        # preemption notice (crash-loop: checkpoint-and-exit every gen)
+        self._clear_own_notice()
+        self.store.set(f"{self.prefix}/nodes/{self.node_id}", self.node_id)
+        self._register_index()
+        self._beat()
+        self._thread = threading.Thread(target=self._beat_loop, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        self.store.set(f"{self.prefix}/beat/{self.node_id}",
+                       repr(time.time()))
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.ttl / 3):
+            try:
+                self._beat()
+            except Exception:
+                return
+
+    # -- membership ----------------------------------------------------------
+    def _known_nodes(self) -> List[str]:
+        count = self.store.get(f"{self.prefix}/index_count", wait=False)
+        n = int(count) if count else 0
+        nodes = []
+        for i in range(1, n + 1):
+            raw = self.store.get(f"{self.prefix}/index/{i}", wait=False)
+            if raw:
+                nodes.append(raw.decode())
+        return nodes
+
+    def _register_index(self):
+        """Atomic membership registration: claim a slot via the store's
+        atomic add, then publish this node's id into it (no lost updates
+        under concurrent joins)."""
+        if self.node_id in self._known_nodes():
+            return
+        slot = self.store.add(f"{self.prefix}/index_count", 1)
+        self.store.set(f"{self.prefix}/index/{slot}", self.node_id)
+
+    def alive_nodes(self) -> List[str]:
+        """Nodes whose lease (heartbeat) is fresh within TTL."""
+        now = time.time()
+        alive = []
+        for n in self._known_nodes():
+            raw = self.store.get(f"{self.prefix}/beat/{n}", wait=False)
+            if raw is not None and now - float(raw) < self.ttl:
+                alive.append(n)
+        return alive
+
+    def pod_status(self) -> str:
+        # nodes under preemption notice leave the membership immediately,
+        # so the next relaunch re-ranks without them (reference scale-in)
+        preempted = set(self.preempted_nodes())
+        alive = [n for n in self.alive_nodes() if n not in preempted]
+        n = len(alive)
+        if n < self.np_min:
+            return ElasticStatus.HOLD
+        if self._last_members is not None and alive != self._last_members:
+            self._last_members = alive
+            return ElasticStatus.RESTART
+        self._last_members = alive
+        return ElasticStatus.COMPLETED
+
+    def wait_for_np(self, timeout: float = 60.0) -> bool:
+        """Block until at least np_min nodes hold fresh leases."""
+        self._register_index()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.alive_nodes()) >= self.np_min:
+                self._last_members = self.alive_nodes()
+                return True
+            time.sleep(min(1.0, self.ttl / 5))
+        return False
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    # -- preemption notices ---------------------------------------------------
+    # TPU-VM preemptions arrive as a SIGTERM (spot/maintenance notice) a few
+    # tens of seconds before the VM dies — the reference handles the analog
+    # via etcd watches + launcher relaunch (manager.py:221-256 + elastic
+    # level). Here a notice (signal or explicit call) is broadcast into the
+    # store so every peer sees it, and the training loop checkpoints and
+    # exits cleanly via should_checkpoint()/is_preempted().
+
+    # Notices expire after `notice_ttl` seconds, so a relaunched generation
+    # (same job_id) resumes training instead of checkpointing forever, and
+    # a node whose maintenance notice was cancelled rejoins membership.
+    notice_ttl: float = 120.0
+
+    def _notice_fresh(self, raw) -> bool:
+        return raw is not None and \
+            time.time() - float(raw) < self.notice_ttl
+
+    def _clear_own_notice(self):
+        try:
+            self.store.delete(f"{self.prefix}/preempt/{self.node_id}")
+        except Exception:
+            pass
+        # preempt_any is NOT deleted here: a check-then-delete would race a
+        # concurrent notify from another node; should_checkpoint verifies
+        # the flag against per-node notices instead
+
+    def notify_preemption(self, node_id: Optional[str] = None):
+        """Record a preemption notice for `node_id` (default: this node)."""
+        nid = node_id or self.node_id
+        now = repr(time.time())
+        self.store.set(f"{self.prefix}/preempt/{nid}", now)
+        # job-wide flag carries the notifier id: should_checkpoint() reads
+        # ONE key on the common path and re-verifies only that node's
+        # notice (so a relaunched node clearing its OWN notice resumes the
+        # job without requiring membership registration of the notifier)
+        self.store.set(f"{self.prefix}/preempt_any", f"{now}|{nid}")
+
+    def preempted_nodes(self) -> List[str]:
+        return [n for n in self._known_nodes()
+                if self._notice_fresh(self.store.get(
+                    f"{self.prefix}/preempt/{n}", wait=False))]
+
+    def is_preempted(self) -> bool:
+        """True when THIS node has received a (fresh) preemption notice."""
+        return self._notice_fresh(self.store.get(
+            f"{self.prefix}/preempt/{self.node_id}", wait=False))
+
+    def should_checkpoint(self) -> bool:
+        """True when any member is under a fresh notice — the whole job
+        should checkpoint now, before membership shrinks. One store read on
+        the common (no-notice) path; when the flag is fresh, the notifier's
+        own per-node key is re-checked (a relaunched node clears its own
+        notice, so the flag alone would over-trigger forever)."""
+        raw = self.store.get(f"{self.prefix}/preempt_any", wait=False)
+        if raw is None:
+            return False
+        try:
+            ts, nid = raw.decode().split("|", 1)
+        except ValueError:
+            ts, nid = raw.decode(), None
+        if not self._notice_fresh(ts.encode()):
+            return False
+        if nid is None:
+            return True
+        return self._notice_fresh(self.store.get(
+            f"{self.prefix}/preempt/{nid}", wait=False))
+
+
+class PreemptionHandler:
+    """Wires an OS preemption signal into the elastic manager.
+
+    reference analog: launcher Master heartbeat watch + etcd lease expiry
+    (launch/controllers/master.py:268-288); on TPU-VMs the earliest signal
+    is SIGTERM.
+
+    The signal handler itself only sets a flag — store I/O from inside a
+    signal handler could deadlock on the TCPStore client's non-reentrant
+    lock (the handler runs in the main thread, possibly mid-request).
+    `process()` does the actual broadcast + callback and belongs in the
+    training loop:
+
+        handler = PreemptionHandler(manager, on_notice=save_ckpt).install()
+        ...
+        if handler.process() or manager.should_checkpoint():  # per step
+            save_ckpt(); exit
+    """
+
+    def __init__(self, manager: ElasticManager,
+                 on_notice: Optional[Callable[[], None]] = None):
+        self.manager = manager
+        self.on_notice = on_notice
+        self._prev_handler = None
+        self._signum = None
+        self._flag = threading.Event()
+        self._processed = False
+        self.notices = 0
+
+    def install(self, signum: Optional[int] = None):
+        import signal
+        self._signum = signum if signum is not None else signal.SIGTERM
+        self._prev_handler = signal.signal(self._signum, self._handle)
+        return self
+
+    def _handle(self, signum, frame):
+        # async-signal-safe: flag only, no locks, no sockets
+        self.notices += 1
+        self._flag.set()
+        if callable(self._prev_handler):
+            self._prev_handler(signum, frame)
+
+    def pending(self) -> bool:
+        return self._flag.is_set() and not self._processed
+
+    def process(self) -> bool:
+        """Broadcast + run the callback if a notice arrived. Returns True
+        when this node is under notice. Call once per training step."""
+        if not self.pending():
+            return self._processed
+        self._processed = True
+        try:
+            self.manager.notify_preemption()
+        except Exception:
+            pass  # store may already be gone; local callback still runs
+        if self.on_notice is not None:
+            self.on_notice()
+        return True
+
+    def uninstall(self):
+        import signal
+        if self._signum is not None and self._prev_handler is not None:
+            signal.signal(self._signum, self._prev_handler)
+            self._signum = None

@@ -1,0 +1,137 @@
+"""Tensor-parallel (mpu) layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding:46, ColumnParallelLinear:335, RowParallelLinear:542,
+ParallelCrossEntropy:743 — whose internals issue explicit c_identity/
+c_split/mp_allreduce collectives (mp_ops.py).
+
+TPU-native: the SAME layer classes, but internals are sharding annotations:
+weights carry a NamedSharding over the 'mp' mesh axis, and every eager op's
+jit is partitioned by GSPMD, which inserts the all-gather/psum the reference
+coded by hand. No collective calls appear in forward().
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from ...nn import initializer as I
+from ...nn.layer_base import Layer
+from ...ops.dispatcher import call_op
+from ..placements import Replicate, Shard
+from ..topology import get_hybrid_communicate_group
+
+
+def _mp_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("fleet.init(is_collective=True) must run before "
+                           "constructing tensor-parallel layers")
+    return hcg.mesh
+
+
+def _shard_param(p: Tensor, tensor_dim: Optional[int], axis: str = "mp"):
+    """Shard param dim `tensor_dim` over mesh axis `axis` (None=replicate)."""
+    mesh = _mp_mesh().mesh
+    spec = [None] * p.ndim
+    if tensor_dim is not None:
+        spec[tensor_dim] = axis
+    p._set_data(jax.device_put(p._data, NamedSharding(mesh, PartitionSpec(*spec))))
+    return p
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp'
+    (reference mp_layers.py:46). GSPMD partitions the gather; out-of-shard
+    ids resolve exactly like the reference's masked-lookup + allreduce."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, 0)
+
+    def forward(self, x):
+        return call_op("embedding", x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """weight [in, out] with out-dim sharded (reference mp_layers.py:335).
+    gather_output=False keeps activations mp-sharded for the following
+    RowParallelLinear — zero communication, as in Megatron."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, 1)
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            _shard_param(self.bias, 0)
+
+    def forward(self, x):
+        out = call_op("linear", x, self.weight, self.bias)
+        if self.gather_output:
+            mesh = _mp_mesh().mesh
+            out = Tensor(
+                jax.device_put(out._data, NamedSharding(
+                    mesh, PartitionSpec(*([None] * out.ndim)))),
+                stop_gradient=out.stop_gradient)
+        return out
+
+
+class RowParallelLinear(Layer):
+    """weight [in, out] with in-dim sharded (reference mp_layers.py:542);
+    the contraction over the sharded dim makes GSPMD emit the mp psum the
+    reference calls mp_allreduce."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, 0)
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            _shard_param(self.bias, None)  # replicated: added after the psum
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            mesh = _mp_mesh().mesh
+            spec = [None] * x.ndim
+            spec[-1] = "mp"
+            x = Tensor(jax.device_put(x._data, NamedSharding(
+                mesh, PartitionSpec(*spec))), stop_gradient=x.stop_gradient)
+        return call_op("linear", x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over class-dim-sharded logits (reference
+    mp_layers.py:743): the log-softmax reduction over the sharded axis
+    becomes a GSPMD psum instead of the hand-written allreduce pair."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return call_op("softmax_with_cross_entropy", input, label,
+                       ignore_index=self.ignore_index)

@@ -1,0 +1,170 @@
+"""PipelineLayer / LayerDesc — pipeline model description API.
+
+Reference counterpart: `fleet/meta_parallel/parallel_layers/pp_layers.py`
+(`LayerDesc:56`, `SharedLayerDesc:76`, `PipelineLayer:237`): users describe
+the model as an ordered list of layer descriptors; the runtime partitions
+them into stages, instantiates only the local stage's layers per process,
+and wires p2p/shared-weight groups.
+
+TPU-first redesign: there is one program over the whole mesh, so
+PipelineLayer instantiates everything, but the homogeneous middle run is
+stored as a LayerStack (stacked parameters, nn/stack.py) whose leading axis
+is sharded over `pp` and executed by the `ppermute` pipeline engine
+(distributed/pipeline.py). Head layers (before the run) and tail layers run
+replicated over pp — the standard embedding-outside-pipeline layout.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+from ...nn.stack import LayerStack, run_with_tape
+from ..topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:56)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a paddle_tpu Layer")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-shared layer (reference pp_layers.py:76 — e.g. tied input and
+    output embeddings). On TPU the sharing is literal: the same Layer object
+    is used at every position with this key; its parameters are replicated
+    over pp (GSPMD derives the grad psum that the reference implements with
+    an explicit allreduce over the shared-comm group)."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr
+                 ="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Pipeline model container (reference pp_layers.py:237).
+
+    layers: list of Layer / LayerDesc / SharedLayerDesc.
+    num_stages: pipeline stages (defaults to the hybrid pp degree).
+    loss_fn: optional criterion used by PipelineParallel.train_batch.
+
+    The longest run of same-class LayerDescs is the pipelined segment; its
+    length must divide evenly by num_stages. Everything before runs as the
+    head, everything after as the tail.
+    """
+
+    def __init__(self, layers: Sequence[Union[Layer, LayerDesc]],
+                 num_stages: Optional[int] = None,
+                 loss_fn: Optional[Callable] = None,
+                 topology=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, **kwargs):
+        super().__init__()
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = (hcg.get_pipe_parallel_world_size()
+                          if hcg is not None else 1)
+        self.num_stages = int(num_stages)
+        self.loss_fn = loss_fn
+        self._recompute = recompute_interval > 0
+
+        descs = list(layers)
+        start, length = self._longest_desc_run(descs)
+        if self.num_stages > 1 and length % self.num_stages != 0:
+            raise ValueError(
+                f"pipelined segment has {length} layers, not divisible by "
+                f"{self.num_stages} stages")
+
+        self.head = _build_segment(descs[:start])
+        self.tail = _build_segment(descs[start + length:])
+        run = descs[start:start + length]
+        if length > 0:
+            it = iter(run)
+
+            def block_fn(_it=it, _first=run[0]):
+                # LayerStack calls block_fn num_layers times; hand out the
+                # descs in order so per-layer args (if any) are honoured
+                try:
+                    d = next(_it)
+                except StopIteration:
+                    d = _first
+                return d.build_layer() if isinstance(d, LayerDesc) else d
+
+            self.stack = LayerStack(block_fn, length, remat=self._recompute)
+        else:
+            self.stack = None
+
+    @staticmethod
+    def _desc_key(d):
+        """Stackability key: same class AND same constructor args (different
+        args mean different param shapes, which cannot share a stack)."""
+        if not isinstance(d, LayerDesc) or isinstance(d, SharedLayerDesc):
+            return None
+        return (d.layer_cls, repr(d.args), repr(sorted(d.kwargs.items())))
+
+    @classmethod
+    def _longest_desc_run(cls, descs) -> tuple:
+        best = (0, 0)
+        i = 0
+        while i < len(descs):
+            j = i
+            key = cls._desc_key(descs[i])
+            if key is not None:
+                while j < len(descs) and cls._desc_key(descs[j]) == key:
+                    j += 1
+            else:
+                j = i + 1
+            if j - i > best[1]:
+                best = (i, j - i)
+            i = j
+        return best
+
+    def get_num_of_stages(self) -> int:
+        return self.num_stages
+
+    def forward(self, x, *args):
+        for lyr in self.head:
+            x = lyr(x)
+        if self.stack is not None:
+            if self.num_stages > 1:
+                x = self._pipelined(x)
+            else:
+                x = self.stack(x)
+        for lyr in self.tail:
+            x = lyr(x)
+        return x
+
+    def _pipelined(self, x):
+        from ..pipeline import pipelined_stack_forward
+        return pipelined_stack_forward(self.stack, x, (), self.num_stages,
+                                       remat=self._recompute)
+
+
+def _build_segment(descs) -> "Layer":
+    from ...nn.layers_common import LayerList
+    built = []
+    shared_cache = {}
+    for d in descs:
+        if isinstance(d, SharedLayerDesc):
+            if d.layer_name not in shared_cache:
+                shared_cache[d.layer_name] = d.build_layer()
+            built.append(shared_cache[d.layer_name])
+        elif isinstance(d, LayerDesc):
+            built.append(d.build_layer())
+        else:
+            built.append(d)
+    return LayerList(built)

@@ -1,0 +1,160 @@
+"""Megatron-style sequence parallelism (reference
+fleet/utils/sequence_parallel_utils.py: ScatterOp :84, GatherOp,
+AllGatherOp, ReduceScatterOp :126, ColumnSequenceParallelLinear :229,
+RowSequenceParallelLinear :339, allreduce hooks :155-191).
+
+TPU-native: SP shards ACTIVATIONS on the sequence dim over the mp axis
+between the TP blocks. The reference's explicit collectives become sharding
+transitions — GSPMD lowers gather(seq)→matmul(col) to an all-gather and
+matmul(row)→scatter(seq) to a reduce-scatter, exactly the Megatron-SP comm
+pattern, scheduled by XLA over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from ...nn import initializer as I
+from ...nn.layer_base import Layer
+from ...ops.dispatcher import call_op
+from .mp_layers import _mp_mesh, _shard_param
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _with_spec(x: Tensor, spec) -> Tensor:
+    mesh = _mp_mesh().mesh
+    out = Tensor(jax.device_put(x._data, NamedSharding(mesh,
+                                                       PartitionSpec(*spec))),
+                 stop_gradient=x.stop_gradient)
+    out._node = x._node
+    out._out_idx = x._out_idx
+    return out
+
+
+def _seq_spec(ndim: int, seq_axis: int, sharded: bool):
+    spec = [None] * ndim
+    if sharded:
+        spec[seq_axis] = "mp"
+    return spec
+
+
+def scatter(x: Tensor, axis: int = 1) -> Tensor:
+    """Split the seq dim across mp (reference ScatterOp.forward — a
+    narrow-slice per rank; here a sharding transition)."""
+    return _with_spec(x, _seq_spec(x.ndim, axis, True))
+
+
+def all_gather(x: Tensor, axis: int = 1) -> Tensor:
+    """Re-materialize the full sequence on every mp rank (AllGatherOp)."""
+    return _with_spec(x, _seq_spec(x.ndim, axis, False))
+
+
+class ScatterOp:
+    """Function-object parity with the reference PyLayer (apply -> forward
+    slices, backward gathers — autograd handled by the sharding transition
+    here)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 1) -> Tensor:
+        return scatter(x, axis)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x: Tensor, axis: int = 1) -> Tensor:
+        return all_gather(x, axis)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp:
+    """Sum partial activations over mp AND shard the seq dim — one sharding
+    transition; GSPMD emits the fused reduce-scatter."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 1) -> Tensor:
+        return scatter(x, axis)
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """reference :229 — input arrives seq-sharded; the matmul against the
+    column-parallel weight consumes the FULL sequence (GSPMD all-gathers it)
+    and leaves features mp-sharded for the RowSequenceParallelLinear."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, 1)
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            _shard_param(self.bias, 0)
+
+    def forward(self, x):
+        # gather the sequence; features come out mp-sharded via the weight
+        x = all_gather(x, axis=1 if x.ndim > 2 else 0)
+        out = call_op("linear", x, self.weight, self.bias)
+        if self.gather_output:
+            out = _with_spec(out, [None] * out.ndim)
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """reference :339 — input features mp-sharded; after the row-parallel
+    matmul the partial sums reduce-scatter onto the sequence dim."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = True,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, 0)
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            _shard_param(self.bias, None)
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            spec = [None] * x.ndim
+            spec[-1] = "mp"
+            x = _with_spec(x, spec)
+        out = call_op("linear", x, self.weight, self.bias)
+        # reduce-scatter: sum over mp + shard the seq dim
+        return scatter(out, axis=1 if out.ndim > 2 else 0)
+
+
+def mark_as_sequence_parallel_parameter(param: Tensor) -> None:
+    """Tag for grad-sync bookkeeping (reference :155): under GSPMD the
+    gradient sharding follows the parameter sharding automatically, so the
+    tag is metadata only."""
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model: Layer,
+                                               accumulation_steps: int = 1,
+                                               fuse: bool = False) -> None:
+    """reference :155-191 installs fused allreduce hooks for SP params; the
+    GSPMD gradient transposition already inserts the equivalent collectives,
+    so this is API parity only — no hooks to install."""

@@ -1,0 +1,6 @@
+"""paddle_tpu.distributed.launch — distributed job launcher (SURVEY §1-L10)."""
+
+from .context import Context  # noqa: F401
+from .controller import CollectiveController, launch  # noqa: F401
+from .job import Container, Pod  # noqa: F401
+from .master import Master  # noqa: F401

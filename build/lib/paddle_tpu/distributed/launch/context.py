@@ -1,0 +1,76 @@
+"""Launch context: CLI args + environment (reference
+python/paddle/distributed/launch/context/args_envs.py:33 — the args/env
+table; envs override defaults, CLI overrides envs)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class Args:
+    master: Optional[str] = None      # ip:port of the rendezvous store
+    nnodes: int = 1
+    node_rank: int = 0
+    nproc_per_node: int = 1
+    job_id: str = "default"
+    log_dir: str = "log"
+    devices: Optional[str] = None
+    run_mode: str = "collective"
+    max_restart: int = 3
+    elastic_level: int = -1           # -1 off, 0 fault-tolerant, 1 elastic
+    elastic_timeout: int = 30
+    training_script: str = ""
+    training_script_args: List[str] = field(default_factory=list)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> Args:
+    env = os.environ
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training (collective mode) across "
+                    "nodes/hosts; rendezvous over the native TCPStore.")
+    p.add_argument("--master",
+                   default=env.get("PADDLE_MASTER"),
+                   help="rendezvous endpoint ip:port (node 0 hosts it)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(env.get("PADDLE_NNODES", 1)))
+    p.add_argument("--node_rank", type=int,
+                   default=int(env.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(env.get("PADDLE_NPROC_PER_NODE", 1)))
+    p.add_argument("--job_id", default=env.get("PADDLE_JOB_ID", "default"))
+    p.add_argument("--log_dir", default=env.get("PADDLE_LOG_DIR", "log"))
+    p.add_argument("--devices", default=env.get("PADDLE_DEVICES"))
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective"])
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int,
+                   default=int(env.get("PADDLE_ELASTIC_LEVEL", -1)))
+    p.add_argument("--elastic_timeout", type=int,
+                   default=int(env.get("PADDLE_ELASTIC_TIMEOUT", 30)))
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    ns = p.parse_args(argv)
+    return Args(**vars(ns))
+
+
+class Context:
+    def __init__(self, argv: Optional[List[str]] = None):
+        self.args = parse_args(argv)
+        self.envs = dict(os.environ)
+        self.node_ip = self.envs.get("POD_IP", "127.0.0.1")
+        self.status = "ready"
+
+    def is_master_node(self) -> bool:
+        return self.args.node_rank == 0

@@ -1,0 +1,144 @@
+"""Collective controller: build this node's Pod and run it to completion.
+
+Reference: launch/controllers/collective.py:22 — CollectiveController.build_pod
+(:37) computes global ranks/endpoints and sets the PADDLE_TRAINER_* envs each
+trainer process reads; the controller then watches children and handles
+restart. TPU addition: coordinator envs for `jax.distributed.initialize`
+(multi-host XLA needs one coordinator), derived from --master.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .context import Context, free_port
+from .job import Container, Pod
+from .master import Master
+
+
+class CollectiveController:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.master: Optional[Master] = None
+        self.pod = Pod(f"pod_{ctx.args.node_rank}")
+        self._generation = 0
+
+    # -- pod construction ----------------------------------------------------
+    def build_pod(self) -> Pod:
+        a = self.ctx.args
+        nproc = a.nproc_per_node
+        if a.nnodes > 1:
+            if not a.master:
+                raise ValueError("--master ip:port is required for multi-node")
+            if self.master is None:  # reused across restarts (server keeps
+                self.master = Master(a.master, a.node_rank, a.nnodes,
+                                     a.job_id)  # its port; see run())
+            # generation comes from the shared store counter so every node
+            # (the failed one and the co-restarting ones) syncs on one tag
+            self._generation = self.master.current_generation()
+            peers = self.master.sync_peers(
+                {"ip": self.ctx.node_ip, "nproc": nproc,
+                 "node_rank": a.node_rank}, generation=self._generation)
+            rank_offset = sum(p["nproc"] for p in peers[:a.node_rank])
+            world = sum(p["nproc"] for p in peers)
+            endpoints = []
+            for p in peers:
+                endpoints += [f"{p['ip']}:trainer{p['node_rank']}_{i}"
+                              for i in range(p["nproc"])]
+            coordinator = a.master
+        else:
+            rank_offset, world = 0, nproc
+            endpoints = [f"{self.ctx.node_ip}:trainer0_{i}"
+                         for i in range(nproc)]
+            coordinator = a.master or f"{self.ctx.node_ip}:{free_port()}"
+
+        self.pod.clear()
+        for local_rank in range(nproc):
+            rank = rank_offset + local_rank
+            env = {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_NNODES": str(a.nnodes),
+                "PADDLE_NODE_RANK": str(a.node_rank),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_MASTER": a.master or coordinator,
+                "PADDLE_JOB_ID": a.job_id,
+                # jax.distributed coordinator (multi-host XLA runtime)
+                "PADDLE_DIST_COORDINATOR": coordinator,
+                "RANK": str(rank),
+                "WORLD_SIZE": str(world),
+            }
+            if a.devices:
+                env["PADDLE_DEVICES"] = a.devices
+            log = os.path.join(a.log_dir,
+                               f"{a.job_id}.{a.node_rank}.{local_rank}.log")
+            self.pod.add(Container(
+                [sys.executable, "-u", a.training_script,
+                 *a.training_script_args],
+                env, log_path=None if world == 1 and nproc == 1 else log))
+        return self.pod
+
+    # -- run loop ------------------------------------------------------------
+    def run(self) -> int:
+        a = self.ctx.args
+        restarts = 0
+        try:
+            while True:
+                self.build_pod()
+                self.pod.deploy()
+                status = self._watch()
+                if status == "done":
+                    return 0
+                if status == "gen_changed":
+                    # a peer failed and bumped the shared generation: rejoin
+                    # the rendezvous (does not consume this node's restarts)
+                    self.ctx.status = "restarting"
+                    self.pod.stop()
+                    continue
+                restarts += 1
+                if restarts > max(a.max_restart, 0) or a.elastic_level < 0:
+                    self.pod.stop()
+                    return 1
+                self.ctx.status = "restarting"
+                self.pod.stop()
+                if self.master is not None:
+                    self.master.bump_generation()  # pull peers into re-sync
+                time.sleep(1.0)
+        finally:
+            if self.master is not None:
+                self.master.close()
+                self.master = None
+
+    def _watch(self) -> str:
+        while True:
+            status = self.pod.poll()
+            if status != "running":
+                if status == "failed":
+                    self.pod.stop()
+                return status
+            if self.master is not None:
+                if self.master.current_generation() != self._generation:
+                    return "gen_changed"
+            time.sleep(0.5)
+
+    def stop(self):
+        self.pod.stop()
+        if self.master is not None:
+            self.master.close()
+            self.master = None
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    """CLI entry (reference launch/main.py:20)."""
+    ctx = Context(argv)
+    ctl = CollectiveController(ctx)
+    try:
+        return ctl.run()
+    except KeyboardInterrupt:
+        ctl.stop()
+        return 130

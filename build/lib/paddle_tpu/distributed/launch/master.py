@@ -1,0 +1,59 @@
+"""Multi-node rendezvous master over the native TCPStore.
+
+Reference: launch/controllers/master.py — an HTTP-KV (or ETCD) service where
+every node registers its endpoints and fetches the full peer list. Here node
+0 hosts the C++ TCPStore (csrc/tcp_store.cc) and peers sync through it:
+register → barrier → fetch-all.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional, Tuple
+
+from ...native.tcp_store import TCPStore
+
+
+class Master:
+    def __init__(self, endpoint: str, node_rank: int, nnodes: int,
+                 job_id: str = "default", timeout: float = 300.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.nnodes = nnodes
+        self.node_rank = node_rank
+        self.prefix = f"paddle_tpu/{job_id}"
+        self.store = TCPStore(host, int(port), is_master=(node_rank == 0),
+                              world_size=nnodes, timeout=timeout)
+
+    def sync_peers(self, payload: dict, generation: int = 0) -> List[dict]:
+        """Register this node's payload; return all nodes' payloads ordered
+        by node_rank once every node has arrived. `generation` namespaces a
+        restart round so stale payloads from a previous deploy are never
+        read (the controller bumps it on every rebuild)."""
+        tag = f"{self.prefix}/g{generation}"
+        self.store.set(f"{tag}/node/{self.node_rank}", json.dumps(payload))
+        self.store.barrier(f"{tag}/sync", self.nnodes, timeout_ms=600_000)
+        peers = []
+        for r in range(self.nnodes):
+            raw = self.store.get(f"{tag}/node/{r}")
+            peers.append(json.loads(raw.decode()))
+        return peers
+
+    def heartbeat(self, ttl_info: Optional[str] = None):
+        """Publish a liveness timestamp. Not called on the controller's hot
+        poll loop — monitors (ElasticManager-style) own the cadence."""
+        self.store.set(f"{self.prefix}/beat/{self.node_rank}",
+                       ttl_info or str(time.time()))
+
+    # -- restart generation (shared across nodes) ----------------------------
+    # A node whose pod failed bumps the counter; every other node observes
+    # the change in its watch loop and co-restarts, so all nodes re-enter
+    # sync_peers with the SAME generation tag.
+    def current_generation(self) -> int:
+        return self.store.add(f"{self.prefix}/generation", 0)
+
+    def bump_generation(self) -> int:
+        return self.store.add(f"{self.prefix}/generation", 1)
+
+    def close(self):
+        self.store.close()

@@ -1,0 +1,411 @@
+"""SPMD pipeline-parallel engine: microbatch rotation over the `pp` mesh axis.
+
+Reference counterpart: the dygraph pipeline runtime
+(`fleet/meta_parallel/pipeline_parallel.py:150,440` 1F1B,
+`:906` interleaved VPP) built on point-to-point isend/irecv between stage
+processes (`pp_utils/p2p_communication.py:313`), plus the static-graph
+FThenB/1F1B schedule passes (`passes/pipeline_scheduler_pass.py:47-465`).
+
+TPU-first redesign: inside a TPU slice there are no independent per-stage
+processes — the schedule must compile into ONE program (SURVEY.md §7
+"Hard parts"). The engine expresses the pipeline as a `lax.scan` over
+`M + S - 1` ticks inside `jax.shard_map` over the `pp` axis:
+
+- each device holds its stage's parameters (the LayerStack leading axis
+  reshaped [S, layers_per_stage, ...] and sharded over `pp`),
+- activations rotate stage->stage+1 with `lax.ppermute` (ICI
+  collective-permute; the p2p isend/irecv analog),
+- stage 0 feeds microbatch t at tick t; the last stage's outputs are
+  collected ticks S-1..T-1; all other positions compute bubble garbage that
+  never reaches an output (same wall-clock as an idle bubble),
+- backward is jax AD through the scan: the transposed program rotates
+  gradients stage->stage-1, which IS the 1F1B cooldown; `jax.checkpoint`
+  around the block bounds live activation memory to one microbatch per
+  stage per in-flight tick.
+
+Other mesh axes (dp/mp/sharding/sep) stay in GSPMD "auto" mode inside the
+shard_map body, so tensor-parallel layers keep working within a stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+_ENGINE_CACHE: dict = {}
+
+
+def pipeline_scan(block_apply: Callable[..., jax.Array],
+                  stacked: Sequence[jax.Array],
+                  x_mb: jax.Array,
+                  shared: tuple,
+                  mesh: Mesh,
+                  num_stages: int,
+                  num_micro: int,
+                  remat: bool = True,
+                  rng_key: jax.Array = None,
+                  cache_key=None,
+                  num_virtual: int = 1) -> jax.Array:
+    """Run the pipelined stack.
+
+    block_apply(leaves, x, shared, key) -> y : one block, pure.
+    stacked: leaves [L, ...] (L = num_stages * layers_per_stage); their
+    leading axis should live pp-sharded at rest (LayerStack does this) —
+    the engine constrains only the stage axis and leaves block dims
+    UNCONSTRAINED so mp/TP shardings propagate from the inputs.
+    x_mb: [M, mb, ...] microbatched activations (post-embedding).
+    num_virtual > 1 selects the interleaved-VPP engine (v chunks per
+    device, reference pipeline_parallel.py:906).
+    Returns [M, mb, ...] outputs (replicated over pp).
+    """
+    S, M, v = num_stages, num_micro, int(num_virtual or 1)
+    L = stacked[0].shape[0]
+    assert L % (S * v) == 0, \
+        f"{L} layers not divisible by {S} stages x {v} virtual stages"
+    if rng_key is None:
+        rng_key = jax.random.key(0)
+
+    # cache compiled engines on the owning object (usually the LayerStack)
+    # so their lifetime matches the model's — no global leak, no id reuse
+    owner = getattr(block_apply, "__self__", None)
+    key = (mesh, S, M, remat, v)
+    if owner is not None:
+        cache = owner.__dict__.setdefault("_pipeline_engine_cache", {})
+    else:
+        cache = _ENGINE_CACHE
+        key = (cache_key, mesh, S, M, remat, v)
+    fn = cache.get(key)
+    if fn is None:
+        if v > 1:
+            fn = _build_vpp_engine(block_apply, mesh, S, M, v, remat)
+        else:
+            fn = _build_engine(block_apply, mesh, S, M, remat)
+        cache[key] = fn
+    return fn(tuple(stacked), x_mb, shared, rng_key)
+
+
+def _build_engine(block_apply, mesh, S, M, remat):
+    T = M + S - 1
+    U = P.UNCONSTRAINED
+
+    def stage_fn(my_leaves, x, shared, key):
+        """Apply this stage's nl blocks (leaves [nl, ...])."""
+        def body(carry, leaves):
+            xx, k = carry
+            k, sub = jax.random.split(k)
+            return (block_apply(leaves, xx, shared, sub), k), None
+
+        if remat:
+            body = jax.checkpoint(body)
+        (y, _), _ = jax.lax.scan(body, (x, key), my_leaves)
+        return y
+
+    def pipelined(leaves, x_mb, shared, rng_key):
+        # per-device view: leaves [1, nl, ...]; x_mb full (pp-replicated)
+        my = tuple(l[0] for l in leaves)
+        stage = jax.lax.axis_index("pp")
+        mb_shape = x_mb.shape[1:]
+        state0 = jnp.zeros(mb_shape, x_mb.dtype)
+        outs0 = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        key0 = jax.random.fold_in(rng_key, stage)
+
+        def tick(carry, t):
+            state, outs = carry
+            inp = jnp.where(stage == 0,
+                            x_mb[jnp.clip(t, 0, M - 1)], state)
+            y = stage_fn(my, inp, shared, jax.random.fold_in(key0, t))
+            # rotate to the next stage (last stage's send is discarded)
+            nxt = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % S) for i in range(S)])
+            oi = jnp.clip(t - (S - 1), 0, M - 1)
+            take = jnp.logical_and(stage == S - 1, t >= S - 1)
+            outs = jnp.where(take, outs.at[oi].set(y), outs)
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (state0, outs0), jnp.arange(T))
+        # replicate the last stage's outputs across pp
+        outs = jax.lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), "pp")
+        return outs
+
+    smapped = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=P(),
+        axis_names=frozenset({"pp"}),
+        check_vma=False,
+    )
+
+    def run(stacked, x_mb, shared, rng_key):
+        # [L, ...] -> [S, nl, ...]: constrain ONLY the stage axis to pp;
+        # block dims stay UNCONSTRAINED so tensor-parallel shardings flow
+        # through from the input arrays
+        st = tuple(
+            jax.lax.with_sharding_constraint(
+                a.reshape((S, a.shape[0] // S) + a.shape[1:]),
+                jax.sharding.NamedSharding(mesh, P("pp", *([U] * a.ndim))))
+            for a in stacked)
+        return smapped(st, x_mb, shared, rng_key)
+
+    # partial-manual shard_map requires a surrounding jit (the eager impl
+    # re-enters with full specs); the jitted engine is cached per
+    # (stack, mesh, schedule) so repeated eager steps don't retrace
+    return jax.jit(run)
+
+
+# -- interleaved VPP (virtual pipeline stages) --------------------------------
+#
+# Reference: `pipeline_parallel.py:906` (interleaved 1F1B) and the static
+# schedule passes (`pipeline_scheduler_pass.py:47-465`). The layer stack is
+# cut into S*v chunks; device d owns chunks {d, d+S, ..., d+(v-1)S}. A
+# microbatch therefore rides the ring v times, and the fill/drain bubble
+# costs (S-1) CHUNK-steps instead of (S-1) full-stage steps — the bubble
+# fraction shrinks ~v-fold at fixed M (the whole point of VPP).
+#
+# The schedule is computed AHEAD OF TIME on the host (greedy list schedule:
+# each tick each device runs the deepest ready chunk-application) and baked
+# into static index tables that drive one lax.scan:
+#   - activations still move with a single ppermute per tick,
+#   - arrivals that cannot be processed immediately park in a small
+#     per-device buffer (slot table, capacity B from the simulation),
+#   - tables say per (tick, device): which buffer slot to store the arrival
+#     in, which slot (or fresh microbatch) to process, which of the device's
+#     v chunks to apply, and whether the result is a finished output.
+# Control flow stays fully static — XLA sees gathers, not branches.
+
+def build_vpp_schedule(S: int, M: int, v: int):
+    """Greedy interleaved schedule. Returns dict of numpy tables
+    [T, S]: recv_slot, src_slot, inject_mb, chunk_sel, out_mb; plus
+    T (ticks), B (buffer slots per device), and per-device busy counts."""
+    import numpy as np
+    K = S * v
+    nxt = [0] * M
+    avail = [0] * M
+    done = [False] * M
+    apps = []          # apps[t][d] = (m, k) | None
+    t = 0
+    while not all(done):
+        row = []
+        for d in range(S):
+            cands = [m for m in range(M)
+                     if not done[m] and nxt[m] % S == d and avail[m] <= t]
+            if cands:
+                m = max(cands, key=lambda mm: nxt[mm])
+                k = nxt[m]
+                row.append((m, k))
+                nxt[m] += 1
+                avail[m] = t + 1
+                if nxt[m] >= K:
+                    done[m] = True
+            else:
+                row.append(None)
+        apps.append(row)
+        t += 1
+        if t > 4 * (M * v + S):   # safety: schedule must terminate
+            raise RuntimeError("VPP schedule did not converge")
+    T = t
+
+    recv_slot = np.full((T, S), -1, np.int32)
+    src_slot = np.full((T, S), -1, np.int32)
+    inject_mb = np.full((T, S), -1, np.int32)
+    chunk_sel = np.zeros((T, S), np.int32)
+    out_mb = np.full((T, S), -1, np.int32)
+
+    # processing tick of each app, for slot lifetime tracking
+    proc_tick = {}
+    for tt, row in enumerate(apps):
+        for d, app in enumerate(row):
+            if app is not None:
+                proc_tick[app] = tt
+
+    B = 0
+    for d in range(S):
+        free: list = []
+        released: dict = {}
+        slot_of = {}
+        used = 0
+        for tt in range(T):
+            free.extend(released.pop(tt, ()))
+            # ring arrival: device d-1 processed (m, k) at tt-1 and k+1
+            # lives on this device (always true: chunks advance round-robin)
+            if tt > 0:
+                prev = apps[tt - 1][(d - 1) % S]
+                if prev is not None and prev[1] + 1 < K:
+                    m, k = prev[0], prev[1] + 1
+                    if free:
+                        slot = free.pop()
+                    else:
+                        slot = used
+                        used += 1
+                    slot_of[(m, k)] = slot
+                    recv_slot[tt, d] = slot
+            app = apps[tt][d]
+            if app is not None:
+                m, k = app
+                chunk_sel[tt, d] = k // S
+                if k == 0:
+                    inject_mb[tt, d] = m
+                else:
+                    slot = slot_of.pop((m, k))
+                    src_slot[tt, d] = slot
+                    released.setdefault(tt + 1, []).append(slot)
+                if k == K - 1:
+                    out_mb[tt, d] = m
+        B = max(B, used)
+    busy = [sum(1 for row in apps if row[d] is not None) for d in range(S)]
+    return {"recv_slot": recv_slot, "src_slot": src_slot,
+            "inject_mb": inject_mb, "chunk_sel": chunk_sel,
+            "out_mb": out_mb, "T": T, "B": max(B, 1), "busy": busy}
+
+
+def vpp_bubble_fraction(S: int, M: int, v: int) -> float:
+    """Idle fraction of the schedule in stage-time units (chunk tick =
+    1/v stage tick). v=1 reproduces the 1F1B rotation bubble
+    (S-1)/(M+S-1)."""
+    sched = build_vpp_schedule(S, M, v)
+    total = sched["T"] * S
+    work = sum(sched["busy"])
+    return 1.0 - work / total
+
+
+def _build_vpp_engine(block_apply, mesh, S, M, v, remat):
+    sched = build_vpp_schedule(S, M, v)
+    T, B = sched["T"], sched["B"]
+    U = P.UNCONSTRAINED
+    tables = tuple(jnp.asarray(sched[k]) for k in
+                   ("recv_slot", "src_slot", "inject_mb", "chunk_sel",
+                    "out_mb"))
+
+    def stage_fn(my_leaves, x, shared, key):
+        def body(carry, leaves):
+            xx, k = carry
+            k, sub = jax.random.split(k)
+            return (block_apply(leaves, xx, shared, sub), k), None
+        if remat:
+            body = jax.checkpoint(body)
+        (y, _), _ = jax.lax.scan(body, (x, key), my_leaves)
+        return y
+
+    def pipelined(leaves, x_mb, shared, rng_key):
+        # per-device view: leaves [v, 1, nl, ...] -> [v, nl, ...]
+        my = tuple(l[:, 0] for l in leaves)
+        stage = jax.lax.axis_index("pp")
+        mb_shape = x_mb.shape[1:]
+        buf0 = jnp.zeros((B,) + mb_shape, x_mb.dtype)
+        ring0 = jnp.zeros(mb_shape, x_mb.dtype)
+        outs0 = jnp.zeros((M,) + mb_shape, x_mb.dtype)
+        key0 = jax.random.fold_in(rng_key, stage)
+
+        def tick(carry, xs):
+            ring, buf, outs = carry
+            t, recv_r, src_r, inj_r, chk_r, out_r = xs
+            rs, ss = recv_r[stage], src_r[stage]
+            im, ck, om = inj_r[stage], chk_r[stage], out_r[stage]
+            # 1. park the ring arrival
+            buf = jnp.where(rs >= 0,
+                            buf.at[jnp.clip(rs, 0, B - 1)].set(ring), buf)
+            # 2. pick this tick's input: fresh microbatch, parked slot, or
+            #    bubble zeros
+            inp = jnp.where(
+                im >= 0, x_mb[jnp.clip(im, 0, M - 1)],
+                jnp.where(ss >= 0, buf[jnp.clip(ss, 0, B - 1)],
+                          jnp.zeros(mb_shape, x_mb.dtype)))
+            # 3. apply the selected local chunk
+            my_chunk = tuple(
+                jnp.take(l, jnp.clip(ck, 0, v - 1), axis=0) for l in my)
+            y = stage_fn(my_chunk, inp, shared, jax.random.fold_in(key0, t))
+            # 4. harvest finished microbatches
+            outs = jnp.where(om >= 0,
+                             outs.at[jnp.clip(om, 0, M - 1)].set(y), outs)
+            # 5. rotate
+            ring = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % S) for i in range(S)])
+            return (ring, buf, outs), None
+
+        (_, _, outs), _ = jax.lax.scan(
+            tick, (ring0, buf0, outs0), (jnp.arange(T),) + tables)
+        last = (S * v - 1) % S   # device holding the final chunk
+        outs = jax.lax.psum(
+            jnp.where(stage == last, outs, jnp.zeros_like(outs)), "pp")
+        return outs
+
+    smapped = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P(None, "pp"), P(), P(), P()),
+        out_specs=P(),
+        axis_names=frozenset({"pp"}),
+        check_vma=False,
+    )
+
+    def run(stacked, x_mb, shared, rng_key):
+        # [L, ...] -> [v, S, nl, ...]: chunk k = j*S + d lands at [j, d];
+        # only the device axis is constrained to pp
+        st = tuple(
+            jax.lax.with_sharding_constraint(
+                a.reshape((v, S, a.shape[0] // (S * v)) + a.shape[1:]),
+                jax.sharding.NamedSharding(
+                    mesh, P(None, "pp", *([U] * a.ndim))))
+            for a in stacked)
+        return smapped(st, x_mb, shared, rng_key)
+
+    return jax.jit(run)
+
+
+def pipelined_stack_forward(stack, x, shared, num_stages: int,
+                            remat: bool, accumulate_steps: int = None):
+    """Shared orchestration for LayerStack-backed pipelined forwards:
+    microbatch -> pipeline_scan -> unmicrobatch, with one eager tape node
+    (nn/stack.py run_with_tape). `x` is a Tensor; `shared` is a tuple of
+    Tensors/arrays/None passed to every block. accumulate_steps defaults
+    from the fleet strategy's pipeline_configs."""
+    from ..core import generator
+    from ..core.tensor import Tensor
+    from ..nn.stack import run_with_tape
+    from . import fleet as fleet_mod
+    from .topology import get_hybrid_communicate_group
+
+    mesh = get_hybrid_communicate_group().mesh.mesh
+    strategy = fleet_mod.get_strategy()
+    if accumulate_steps is None:
+        accumulate_steps = 1 if strategy is None else int(
+            strategy.pipeline_configs.get("accumulate_steps", 1))
+    m = int(accumulate_steps) or 1
+    # interleaved VPP (reference virtual_pp_degree in hybrid pp configs)
+    v = 1 if strategy is None else int(
+        strategy.pipeline_configs.get("virtual_pp_degree", 1))
+    if x.shape[0] % m != 0:
+        raise ValueError(
+            f"batch size {x.shape[0]} is not divisible by accumulate_steps "
+            f"{m} (pipeline microbatching)")
+    rng = generator.next_key()  # once: fwd and vjp recompute share it
+    shared_arrays = tuple(s._data if isinstance(s, Tensor) else s
+                          for s in shared)
+
+    def pure(stacked_arrays, x_arr):
+        x_mb = microbatch(x_arr, m)
+        y = pipeline_scan(stack.apply_block, stacked_arrays, x_mb,
+                          shared_arrays, mesh, num_stages, m,
+                          remat=remat or m > 1, rng_key=rng,
+                          cache_key=id(stack), num_virtual=v)
+        return unmicrobatch(y)
+
+    return run_with_tape("pipeline", pure, stack.stacked_params(), x)
+
+
+def microbatch(x: jax.Array, num_micro: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...]."""
+    B = x.shape[0]
+    assert B % num_micro == 0, f"batch {B} not divisible by {num_micro} microbatches"
+    return x.reshape((num_micro, B // num_micro) + x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [M*mb, ...]."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])

@@ -1,0 +1,119 @@
+"""DistTensor placements (reference paddle/phi/core/distributed/auto_parallel/
+placement_types.h — Shard/Replicate/Partial — and python
+paddle.distributed.{Shard,Replicate,Partial}).
+
+Mapping to the TPU-native sharding model:
+  Shard(d)   on mesh axis a  →  PartitionSpec dim d partitioned over axis a
+  Replicate  on mesh axis a  →  axis a absent from the spec
+  Partial    on mesh axis a  →  pending reduction over a; representable only
+             inside shard_map regions (GSPMD 'unreduced'); eager DistTensors
+             materialize it to Replicate via psum at reshard time.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicate(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Replicate(Placement):
+    def is_replicate(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+def placements_to_spec(placements: Sequence[Placement], axis_names: Sequence[str],
+                       ndim: int) -> PartitionSpec:
+    """[per-mesh-axis placement] -> PartitionSpec (per-tensor-dim axis names).
+
+    This is the core translation between the reference's dims_mapping view
+    (dist_attr.h TensorDistAttr) and GSPMD's PartitionSpec."""
+    if len(placements) != len(axis_names):
+        raise ValueError(
+            f"got {len(placements)} placements for mesh with axes {list(axis_names)}")
+    per_dim: List[List[str]] = [[] for _ in range(ndim)]
+    for axis_name, pl in zip(axis_names, placements):
+        if isinstance(pl, Shard):
+            d = pl.dim % ndim
+            per_dim[d].append(axis_name)
+        elif isinstance(pl, Partial):
+            raise ValueError(
+                "Partial placement cannot be materialized as a NamedSharding; "
+                "reshard to Replicate/Shard first (psum happens automatically)")
+    entries = []
+    for names in per_dim:
+        if not names:
+            entries.append(None)
+        elif len(names) == 1:
+            entries.append(names[0])
+        else:
+            entries.append(tuple(names))
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(spec: PartitionSpec, axis_names: Sequence[str],
+                       ndim: int) -> List[Placement]:
+    """Inverse translation for introspection (dist_attr parity)."""
+    result: List[Placement] = [Replicate() for _ in axis_names]
+    entries = list(spec) + [None] * (ndim - len(list(spec)))
+    for dim, entry in enumerate(entries):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for n in names:
+            result[list(axis_names).index(n)] = Shard(dim)
+    return result

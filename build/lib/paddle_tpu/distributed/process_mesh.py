@@ -1,0 +1,121 @@
+"""ProcessMesh (reference python/paddle/distributed/auto_parallel/
+process_mesh.py + phi ProcessMesh in auto_parallel/process_mesh.h).
+
+Wraps jax.sharding.Mesh 1:1: `mesh.shape` are axis degrees, `dim_names`
+the axis names. On hardware the device order determines which axes ride
+ICI — construct via `create_mesh` to get jax's hardware-aware layout
+(mesh_utils.create_device_mesh) rather than naive reshape.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 _jax_mesh: Optional[Mesh] = None):
+        if _jax_mesh is not None:
+            self._mesh = _jax_mesh
+            self._ids = np.arange(_jax_mesh.size).reshape(_jax_mesh.axis_sizes)
+            return
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        devices = np.asarray(jax.devices())
+        flat = arr.reshape(-1)
+        if flat.max() >= devices.size:
+            raise ValueError(
+                f"mesh references rank {int(flat.max())} but only "
+                f"{devices.size} devices are visible")
+        dev_arr = devices[flat].reshape(arr.shape)
+        self._mesh = Mesh(dev_arr, tuple(dim_names))
+        self._ids = arr
+
+    # -- reference-parity accessors ------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        """The underlying jax Mesh."""
+        return self._mesh
+
+    @property
+    def shape(self) -> List[int]:
+        return [int(s) for s in self._mesh.devices.shape]
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.devices.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._mesh.axis_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(i) for i in self._ids.reshape(-1)]
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self.shape[self.dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index: int = None):
+        """Sub-mesh along one axis (reference process_mesh.py get_mesh_with_dim)."""
+        axis = self.dim_names.index(dim_name)
+        if index is None:
+            # move the axis first, keep as mesh
+            order = [axis] + [i for i in range(self.ndim) if i != axis]
+            arr = np.transpose(self._ids, order)
+            names = [self.dim_names[i] for i in order]
+            return ProcessMesh(arr, names)
+        arr = np.take(self._ids, index, axis=axis)
+        names = [n for i, n in enumerate(self.dim_names) if i != axis]
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+            names = [dim_name]
+        return ProcessMesh(arr, names)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self.shape == other.shape
+                and self.dim_names == other.dim_names
+                and np.array_equal(self._ids, other._ids))
+
+    def __hash__(self):
+        return hash((tuple(self.shape), tuple(self.dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def create_mesh(shape: Sequence[int], dim_names: Sequence[str]) -> ProcessMesh:
+    """Hardware-aware mesh construction: devices laid out so the innermost
+    axes map to ICI neighbors (jax mesh_utils); the analog of topology-aware
+    rank mapping in fleet/base/topology.py."""
+    devs = mesh_utils.create_device_mesh(tuple(shape),
+                                         devices=jax.devices()[:int(np.prod(shape))])
+    return ProcessMesh(None, None, _jax_mesh=Mesh(devs, tuple(dim_names)))
+
+
+def auto_parallel_mesh(*args, **kwargs):  # reference dist.auto_parallel alias
+    return create_mesh(*args, **kwargs)
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh

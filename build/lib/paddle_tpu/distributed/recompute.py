@@ -1,0 +1,61 @@
+"""Activation recomputation (reference
+`python/paddle/distributed/fleet/recompute/recompute.py`).
+
+TPU-native stance: under compiled training (TrainStep / to_static tracing)
+recompute is `jax.checkpoint` — XLA rematerialises the segment in the
+backward pass, trading FLOPs for HBM exactly like the reference's
+RecomputeFunction replays the forward. In pure eager mode the tape already
+holds activations in Python, so the call is a pass-through (the reference's
+eager path also only pays off at scale, where compiled mode is used).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.core
+
+from ..core.tensor import Tensor
+
+
+def _is_tracing(tree) -> bool:
+    leaves = jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+    return any(isinstance(getattr(l, "_data", l), jax.core.Tracer)
+               for l in leaves)
+
+
+def recompute(function, *args, use_reentrant: bool = True, **kwargs):
+    """Run `function(*args, **kwargs)` so its activations are rematerialised
+    during backward when tracing under jit."""
+    if not _is_tracing(args):
+        return function(*args, **kwargs)
+
+    is_t = lambda x: isinstance(x, Tensor)
+    flat, treedef = jax.tree_util.tree_flatten(args, is_leaf=is_t)
+    t_idx = [i for i, l in enumerate(flat) if is_t(l)]
+    datas = tuple(flat[i]._data for i in t_idx)
+    meta = {i: flat[i] for i in t_idx}
+
+    def inner(*arrs):
+        rebuilt = list(flat)
+        for i, a in zip(t_idx, arrs):
+            rebuilt[i] = Tensor(a, stop_gradient=meta[i].stop_gradient)
+        out = function(*jax.tree_util.tree_unflatten(treedef, rebuilt),
+                       **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: t._data if is_t(t) else t, out, is_leaf=is_t)
+
+    out_data = jax.checkpoint(inner)(*datas)
+    return jax.tree_util.tree_map(Tensor, out_data)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference fleet/recompute/recompute_sequential.py analog: chain
+    segments, rematerialising each. `ctx` accepted for API parity (holds
+    preserve_rng_state etc. in the reference; RNG here is functional)."""
+    out = None
+    for i, fn in enumerate(functions):
+        out = recompute(fn, *args, **kwargs) if i == 0 else (
+            recompute(fn, *out) if isinstance(out, tuple)
+            else recompute(fn, out))
+    return out

@@ -1,0 +1,253 @@
+"""ZeRO / group-sharded parallelism with REAL state sharding.
+
+Reference:
+  python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/
+      dygraph_sharding_optimizer.py:48 (stage 1: each rank owns 1/N of the
+      optimizer state; :470 V2 comm overlap)
+  python/paddle/distributed/fleet/meta_parallel/sharding/
+      group_sharded_stage3.py:85 (stage 3: params sharded, gather-on-use)
+  python/paddle/distributed/sharding/group_sharded.py (group_sharded_parallel
+      facade: level "os" / "os_g" / "p_g_os")
+
+TPU-native design (GSPMD, no manual scatter/gather):
+
+* Stage 1/2 ("os", "os_g"): optimizer state (fp32 masters + moments) is
+  placed with a leading-dim ``PartitionSpec`` over the ``sharding`` mesh
+  axis while parameters stay replicated. The fused jitted update consumes
+  replicated grads + sharded state and is constrained to produce replicated
+  params + sharded state — XLA computes the update shard-locally and inserts
+  ONE all-gather for the new params, which is exactly the reference's
+  reduce-scatter-update-allgather ZeRO step. Stage 2's grad sharding is
+  implicit: under a whole-step jit (TrainStep) XLA is free to
+  reduce-scatter grads into the sharded update instead of all-reducing.
+* Stage 3 ("p_g_os"): parameters themselves carry the sharded spec;
+  forward all-gathers weights on use (GSPMD inserts it), and the optimizer
+  state inherits the param sharding automatically.
+
+State memory per device therefore shrinks ~1/sharding_degree
+(tests/test_sharding_stages.py asserts this via addressable_shards).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+def _compose_spec(shape: Sequence[int], existing: PartitionSpec,
+                  mesh: Mesh, axis: str) -> Optional[PartitionSpec]:
+    """Add `axis` to the first dim of `shape` that can absorb it, keeping any
+    existing placements (e.g. a TP-sharded dim keeps its "mp" entry and the
+    state shards over ("mp", "sharding") when divisible)."""
+    axis_deg = dict(zip(mesh.axis_names, mesh.devices.shape))
+    degree = axis_deg[axis]
+    if degree <= 1:
+        return None
+    spec = list(existing) if existing is not None else []
+    spec += [None] * (len(shape) - len(spec))
+    for ent in spec:                      # axis already placed on some dim
+        if axis == ent or (isinstance(ent, tuple) and axis in ent):
+            return None
+    for d in range(len(shape)):
+        ent = spec[d]
+        if ent is None:
+            if shape[d] > 0 and shape[d] % degree == 0:
+                spec[d] = axis
+                return PartitionSpec(*spec)
+        else:
+            cur = ent if isinstance(ent, tuple) else (ent,)
+            cur_deg = 1
+            for a in cur:
+                cur_deg *= axis_deg[a]
+            if shape[d] > 0 and shape[d] % (cur_deg * degree) == 0:
+                spec[d] = cur + (axis,)
+                return PartitionSpec(*spec)
+    return None
+
+
+def sharding_of(arr):
+    """The array's NamedSharding, or None (single-device / other)."""
+    s = getattr(arr, "sharding", None)
+    return s if isinstance(s, NamedSharding) else None
+
+
+def pin(x, sh):
+    """with_sharding_constraint when a target sharding is known — used by
+    the fused optimizer update and TrainStep to hold the ZeRO fixed point
+    (sharded state stays sharded, replicated params stay replicated)."""
+    return jax.lax.with_sharding_constraint(x, sh) if sh is not None else x
+
+
+def _existing_spec(arr) -> Optional[PartitionSpec]:
+    sh = getattr(arr, "sharding", None)
+    return sh.spec if isinstance(sh, NamedSharding) else None
+
+
+def state_sharding_for(arr, mesh: Mesh, axis: str = "sharding"
+                       ) -> Optional[NamedSharding]:
+    """The NamedSharding a param's optimizer state should carry under ZeRO
+    stage 1, or None if no dim is divisible (state stays replicated)."""
+    if axis not in mesh.axis_names:
+        return None
+    spec = _compose_spec(arr.shape, _existing_spec(arr), mesh, axis)
+    if spec is None:
+        return None
+    return NamedSharding(mesh, spec)
+
+
+def shard_optimizer_states(optimizer, mesh: Mesh, axis: str = "sharding"):
+    """Configure `optimizer` so masters+moments are sharded over `axis`
+    (ZeRO stage 1). Works before OR after the first step: existing state is
+    resharded in place; future state is created sharded.
+
+    This is the engine behind DygraphShardingOptimizer and
+    fleet.distributed_optimizer(strategy.hybrid_configs sharding_degree>1).
+    """
+    shardings = dict(getattr(optimizer, "_state_shardings", None) or {})
+    for i, p in enumerate(optimizer._parameter_list):
+        ns = state_sharding_for(p._data, mesh, axis)
+        if ns is None:
+            continue
+        shardings[id(p)] = ns
+        # reshard any already-materialized state
+        if i < len(optimizer._masters) and optimizer._masters[i] is not None:
+            optimizer._masters[i] = jax.device_put(optimizer._masters[i], ns)
+        if i < len(optimizer._states) and optimizer._states[i] is not None:
+            optimizer._states[i] = jax.tree.map(
+                lambda a: jax.device_put(a, ns) if a.shape == p._data.shape
+                else a, optimizer._states[i])
+    optimizer._state_shardings = shardings
+    optimizer._sharding_version = getattr(optimizer, "_sharding_version", 0) + 1
+    return optimizer
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 sharding optimizer (reference
+    dygraph_sharding_optimizer.py:48). Construction configures state
+    sharding on the inner optimizer and returns IT — the engine consumes
+    optimizer attributes directly, so no wrapper indirection is needed."""
+
+    def __new__(cls, optimizer, hcg=None, axis: str = "sharding"):
+        if hcg is None:
+            from .topology import get_hybrid_communicate_group
+            hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            raise RuntimeError("DygraphShardingOptimizer needs an initialized "
+                               "hybrid communicate group (fleet.init)")
+        return shard_optimizer_states(optimizer, hcg.mesh.mesh, axis)
+
+
+def shard_model_params(model: Layer, mesh: Mesh, axis: str = "sharding"):
+    """Stage 3: place every param with `axis` composed into its spec
+    (gather-on-use; reference group_sharded_stage3.py:85). Params without a
+    divisible dim stay as they are."""
+    for p in model.parameters():
+        spec = _compose_spec(p._data.shape, _existing_spec(p._data), mesh, axis)
+        if spec is not None:
+            p._set_data(jax.device_put(p._data,
+                                       NamedSharding(mesh, spec)))
+    return model
+
+
+class _GroupShardedModel(Layer):
+    """Input wrapper for standalone group_sharded_parallel: shards the batch
+    dim of inputs over `axis` (data parallelism across the sharded group)."""
+
+    def __init__(self, layers: Layer, mesh: Mesh, axis: str):
+        super().__init__()
+        self._layers = layers
+        self._mesh = mesh
+        self._axis = axis
+
+    def forward(self, *inputs, **kwargs):
+        def shard_batch(t):
+            if not isinstance(t, Tensor) or t.ndim == 0:
+                return t
+            spec = [None] * t.ndim
+            spec[0] = self._axis
+            return Tensor(jax.device_put(t._data, NamedSharding(
+                self._mesh, PartitionSpec(*spec))),
+                stop_gradient=t.stop_gradient)
+
+        inputs = tuple(shard_batch(t) for t in inputs)
+        kwargs = {k: shard_batch(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._sub_layers["_layers"], name)
+
+
+def group_sharded_parallel(model: Layer, optimizer, level: str,
+                           scaler=None, group=None, offload: bool = False,
+                           sync_buffers: bool = False, buffer_max_size=None,
+                           segment_size=None, sync_comm: bool = False,
+                           dp_group=None, exclude_layer=None):
+    """paddle.distributed.sharding.group_sharded_parallel.
+
+    level: "os" (stage 1, optimizer-state sharding), "os_g" (stage 2 — on
+    TPU grads shard implicitly under the whole-step jit, so os_g == os in
+    configuration), "p_g_os" (stage 3, param sharding with gather-on-use).
+
+    `group` may be a jax Mesh (defaults to the hybrid group's mesh, or a
+    1-axis mesh named "sharding" over all devices). offload / buffer /
+    segment knobs are GPU memory-pool tuning with no TPU analog; accepted
+    and ignored.
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be os/os_g/p_g_os, got {level!r}")
+    axis = "sharding"
+    if isinstance(group, Mesh):
+        mesh = group
+        axis = group.axis_names[0] if axis not in group.axis_names else axis
+    else:
+        from .topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            mesh = hcg.mesh.mesh
+            if hcg.get_sharding_parallel_world_size() <= 1:
+                # reference group=None semantics: shard over the world/dp
+                # group. A dp-only fleet (sharding_degree 1) must not be a
+                # silent no-op — ride the dp axis; error if nothing to ride.
+                if hcg.get_data_parallel_world_size() > 1:
+                    axis = "dp"
+                else:
+                    raise ValueError(
+                        "group_sharded_parallel: hybrid topology has "
+                        "sharding_degree 1 and dp_degree 1 — no axis to "
+                        "shard over; set sharding_degree in hybrid_configs "
+                        "or pass an explicit mesh via `group`")
+        else:
+            import numpy as _np
+            # classic Mesh (Auto axis types): GSPMD resolves param-vs-batch
+            # axis conflicts by gathering on use; make_mesh's Explicit axes
+            # would reject them (sharding-in-types)
+            mesh = Mesh(_np.array(jax.devices()), ("sharding",))
+    if level == "p_g_os":
+        shard_model_params(model, mesh, axis)
+        # state inherits the param sharding automatically; also record it so
+        # fresh masters are placed sharded even for fp32 params
+    shard_optimizer_states(optimizer, mesh, axis)
+    wrapped = _GroupShardedModel(model, mesh, axis)
+    if scaler is not None:
+        return wrapped, optimizer, scaler
+    return wrapped, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Reference save_group_sharded_model: gathers shards and saves a plain
+    state_dict (our paddle.save already gathers via device_get)."""
+    import paddle_tpu as paddle
+    target = model
+    while isinstance(target, _GroupShardedModel):
+        target = target._sub_layers["_layers"]
+    paddle.save(target.state_dict(), output + ".pdparams")
+    if optimizer is not None:
+        paddle.save(optimizer.state_dict(), output + ".pdopt")

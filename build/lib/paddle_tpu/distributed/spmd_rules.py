@@ -1,0 +1,737 @@
+"""SPMD sharding-propagation rules — device-free pure functions.
+
+Reference: paddle/phi/infermeta/spmd_rules/ (per-op rules registered in
+rules.cc; tested as pure functions in test/auto_parallel/spmd_rules/
+test_matmul_rule.py:26-61 — construct DistTensorSpec + mesh, call
+infer_forward, assert dims_mappings). The generated dist API runs them as
+step 1 of the 12-step dist branch (dist_api_gen.py): InferSpmd → reshard
+inputs to what the rule demands → local kernel → stamp output dist_attr.
+
+TPU mapping: a rule's output is exactly the `PartitionSpec` the op's output
+should carry under GSPMD, and the "required input dims_mapping" is the
+`with_sharding_constraint` each input gets. dims_mapping semantics match the
+reference: dims_mapping[i] = mesh axis index sharding tensor dim i, or -1
+for not-sharded; `partial_on` = mesh axes whose reduction is pending.
+"""
+
+from __future__ import annotations
+
+import string
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass
+class DistTensorSpec:
+    shape: Tuple[int, ...]
+    dims_mapping: List[int]
+    partial_on: Set[int] = field(default_factory=set)
+
+    def __post_init__(self):
+        self.shape = tuple(self.shape)
+        self.dims_mapping = list(self.dims_mapping)
+        if len(self.dims_mapping) != len(self.shape):
+            raise ValueError(
+                f"dims_mapping rank {len(self.dims_mapping)} != tensor rank "
+                f"{len(self.shape)}")
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def copy(self) -> "DistTensorSpec":
+        return DistTensorSpec(self.shape, list(self.dims_mapping),
+                              set(self.partial_on))
+
+
+@dataclass
+class SpmdInfo:
+    """Result of a rule: the dist attrs inputs MUST be reshard-ed to, and the
+    dist attrs outputs come out with."""
+    input_specs: List[DistTensorSpec]
+    output_specs: List[DistTensorSpec]
+
+
+_RULES: Dict[str, "SpmdRule"] = {}
+
+
+class SpmdRule:
+    def __init__(self, name: str, forward: Callable):
+        self.name = name
+        self._forward = forward
+
+    def infer_forward(self, *specs, **attrs) -> SpmdInfo:
+        return self._forward(*specs, **attrs)
+
+
+def register_spmd_rule(name: str):
+    def deco(fn):
+        _RULES[name] = SpmdRule(name, fn)
+        return fn
+    return deco
+
+
+def get_spmd_rule(name: str) -> SpmdRule:
+    """Per-op rule, or the variadic replicated fallback (reference
+    dist_api_gen.py:105) when no rule is registered."""
+    return _RULES.get(name, _RULES["__default__"])
+
+
+def has_spmd_rule(name: str) -> bool:
+    return name in _RULES
+
+
+# -- helpers ------------------------------------------------------------------
+
+def _merge_dim(candidates: Sequence[int]) -> int:
+    """Merge one tensor dim's mappings across inputs: first non-(-1) wins;
+    conflicting axes resolve to the first (others get resharded)."""
+    for c in candidates:
+        if c != -1:
+            return c
+    return -1
+
+
+def _dedup(mapping: List[int]) -> List[int]:
+    """A mesh axis may shard at most one tensor dim; later repeats drop."""
+    seen: Set[int] = set()
+    out = []
+    for m in mapping:
+        if m != -1 and m in seen:
+            out.append(-1)
+        else:
+            out.append(m)
+            if m != -1:
+                seen.add(m)
+    return out
+
+
+def _einsum_infer(notation: str, specs: List[DistTensorSpec],
+                  out_subs: str) -> Tuple[List[List[int]], List[int], Set[int]]:
+    """Shared einsum-notation propagation core (the reference builds most
+    rules this way, spmd_rules/utils.cc): map each letter to a merged mesh
+    axis; contracted letters sharded on an axis leave the output partial."""
+    in_subs = notation.split(",")
+    letter_map: Dict[str, int] = {}
+    for subs, spec in zip(in_subs, specs):
+        for i, letter in enumerate(subs):
+            cur = letter_map.get(letter, -1)
+            letter_map[letter] = _merge_dim([cur, spec.dims_mapping[i]])
+    # required inputs: every occurrence of a letter uses the merged axis
+    req_inputs = []
+    for subs, spec in zip(in_subs, specs):
+        req_inputs.append(_dedup([letter_map[l] for l in subs]))
+    out_mapping = _dedup([letter_map.get(l, -1) for l in out_subs])
+    # contracted (not in output) letters with a mesh axis → partial output
+    partial = {letter_map[l] for subs in in_subs for l in subs
+               if l not in out_subs and letter_map[l] != -1}
+    return req_inputs, out_mapping, partial
+
+
+# -- rules --------------------------------------------------------------------
+
+@register_spmd_rule("__default__")
+def _default_replicated(*specs: DistTensorSpec, **attrs) -> SpmdInfo:
+    ins = [DistTensorSpec(s.shape, [-1] * s.ndim) for s in specs]
+    return SpmdInfo(ins, [])
+
+
+@register_spmd_rule("matmul")
+def _matmul(x: DistTensorSpec, y: DistTensorSpec,
+            trans_x: bool = False, trans_y: bool = False) -> SpmdInfo:
+    """spmd_rules/matmul.cc: batch dims merge, k-contraction makes the
+    output Partial on k's axis."""
+    xs, ys = x.copy(), y.copy()
+    if trans_x:
+        xs.shape = xs.shape[:-2] + (xs.shape[-1], xs.shape[-2])
+        xs.dims_mapping[-2], xs.dims_mapping[-1] = (
+            xs.dims_mapping[-1], xs.dims_mapping[-2])
+    if trans_y:
+        ys.shape = ys.shape[:-2] + (ys.shape[-1], ys.shape[-2])
+        ys.dims_mapping[-2], ys.dims_mapping[-1] = (
+            ys.dims_mapping[-1], ys.dims_mapping[-2])
+    nb = max(xs.ndim, ys.ndim) - 2
+    letters = string.ascii_lowercase
+    batch = letters[:nb]
+    xn = batch[nb - (xs.ndim - 2):] + "mk" if xs.ndim > 2 else "mk"
+    yn = batch[nb - (ys.ndim - 2):] + "kn" if ys.ndim > 2 else "kn"
+    on = batch + "mn"
+    req, out_map, partial = _einsum_infer(f"{xn},{yn}", [xs, ys], on)
+    # un-transpose the required mappings back to caller layout
+    if trans_x:
+        req[0][-2], req[0][-1] = req[0][-1], req[0][-2]
+    if trans_y:
+        req[1][-2], req[1][-1] = req[1][-1], req[1][-2]
+    # numpy-style batch broadcasting: per-dim max of right-aligned batches
+    xb, yb = xs.shape[:-2], ys.shape[:-2]
+    batch_shape = []
+    for i in range(nb):
+        xd = xb[i - (nb - len(xb))] if i >= nb - len(xb) else 1
+        yd = yb[i - (nb - len(yb))] if i >= nb - len(yb) else 1
+        batch_shape.append(max(xd, yd))
+    out_shape = tuple(batch_shape) + (xs.shape[-2], ys.shape[-1])
+    return SpmdInfo(
+        [DistTensorSpec(x.shape, req[0]), DistTensorSpec(y.shape, req[1])],
+        [DistTensorSpec(out_shape, out_map, partial)])
+
+
+@register_spmd_rule("elementwise")
+def _elementwise(*specs: DistTensorSpec, **attrs) -> SpmdInfo:
+    """Broadcast-aware unary/binary/n-ary elementwise propagation
+    (spmd_rules/elementwise.cc + default_data_parallel)."""
+    out_ndim = max(s.ndim for s in specs)
+    out_shape = []
+    out_map = []
+    for d in range(out_ndim):
+        cands, dim_size = [], 1
+        for s in specs:
+            sd = d - (out_ndim - s.ndim)
+            if sd < 0:
+                continue
+            if s.shape[sd] != 1:
+                dim_size = max(dim_size, s.shape[sd])
+                cands.append(s.dims_mapping[sd])
+        out_shape.append(dim_size)
+        out_map.append(_merge_dim(cands))
+    out_map = _dedup(out_map)
+    req = []
+    for s in specs:
+        m = []
+        for sd in range(s.ndim):
+            d = sd + (out_ndim - s.ndim)
+            m.append(out_map[d] if s.shape[sd] != 1 else -1)
+        req.append(DistTensorSpec(s.shape, _dedup(m)))
+    return SpmdInfo(req, [DistTensorSpec(tuple(out_shape), out_map)])
+
+
+@register_spmd_rule("reduction")
+def _reduction(x: DistTensorSpec, axis=None, keepdim: bool = False,
+               **attrs) -> SpmdInfo:
+    """spmd_rules/reduction.cc: reduced dims sharded on a mesh axis produce a
+    Partial output on that axis."""
+    if axis is None:
+        axes = list(range(x.ndim))
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+    axes = [a % x.ndim for a in axes]
+    out_map, out_shape, partial = [], [], set()
+    for d in range(x.ndim):
+        if d in axes:
+            if x.dims_mapping[d] != -1:
+                partial.add(x.dims_mapping[d])
+            if keepdim:
+                out_shape.append(1)
+                out_map.append(-1)
+        else:
+            out_shape.append(x.shape[d])
+            out_map.append(x.dims_mapping[d])
+    return SpmdInfo([x.copy()],
+                    [DistTensorSpec(tuple(out_shape), out_map, partial)])
+
+
+@register_spmd_rule("embedding")
+def _embedding(table: DistTensorSpec, ids: DistTensorSpec,
+               **attrs) -> SpmdInfo:
+    """spmd_rules/embedding.cc: row-sharded table (vocab-parallel) yields a
+    Partial output; column sharding propagates to the feature dim."""
+    row_axis, col_axis = table.dims_mapping
+    out_shape = ids.shape + (table.shape[1],)
+    out_map = _dedup(list(ids.dims_mapping) + [col_axis])
+    partial = {row_axis} if row_axis != -1 else set()
+    return SpmdInfo([table.copy(), ids.copy()],
+                    [DistTensorSpec(out_shape, out_map, partial)])
+
+
+@register_spmd_rule("layer_norm")
+def _layer_norm(x: DistTensorSpec, scale: DistTensorSpec,
+                bias: DistTensorSpec, begin_norm_axis: int = -1,
+                **attrs) -> SpmdInfo:
+    """spmd_rules/layer_norm.cc: normalized dims must be whole per shard —
+    their sharding is cleared; leading (batch/seq) sharding flows through."""
+    bna = begin_norm_axis % x.ndim
+    req_x = [m if d < bna else -1 for d, m in enumerate(x.dims_mapping)]
+    req_x = _dedup(req_x)
+    mean_shape = x.shape[:bna]
+    mean_map = req_x[:bna]
+    return SpmdInfo(
+        [DistTensorSpec(x.shape, req_x),
+         DistTensorSpec(scale.shape, [-1] * scale.ndim),
+         DistTensorSpec(bias.shape, [-1] * bias.ndim)],
+        [DistTensorSpec(x.shape, req_x),
+         DistTensorSpec(mean_shape, mean_map),
+         DistTensorSpec(mean_shape, list(mean_map))])
+
+
+@register_spmd_rule("rms_norm")
+def _rms_norm(x: DistTensorSpec, scale: DistTensorSpec,
+              **attrs) -> SpmdInfo:
+    """spmd_rules/rms_norm.cc: like layer_norm over the last dim."""
+    req_x = _dedup(x.dims_mapping[:-1] + [-1])
+    return SpmdInfo(
+        [DistTensorSpec(x.shape, req_x),
+         DistTensorSpec(scale.shape, [-1] * scale.ndim)],
+        [DistTensorSpec(x.shape, list(req_x))])
+
+
+@register_spmd_rule("softmax")
+def _softmax(x: DistTensorSpec, axis: int = -1, **attrs) -> SpmdInfo:
+    """spmd_rules/softmax.cc: the softmax axis must be unsharded."""
+    ax = axis % x.ndim
+    req = list(x.dims_mapping)
+    req[ax] = -1
+    return SpmdInfo([DistTensorSpec(x.shape, req)],
+                    [DistTensorSpec(x.shape, list(req))])
+
+
+@register_spmd_rule("cross_entropy_with_softmax")
+def _cross_entropy(logits: DistTensorSpec, label: DistTensorSpec,
+                   **attrs) -> SpmdInfo:
+    """spmd_rules/cross_entropy_with_softmax.cc: class-dim sharding is the
+    ParallelCrossEntropy case — loss comes out Partial on that axis."""
+    class_axis = logits.dims_mapping[-1]
+    req_logits = logits.copy()
+    # labels share batch-dim sharding; size-1 dims (hard-label [b, s, 1]
+    # layout) and any accidental class-axis copy stay unsharded
+    req_label = DistTensorSpec(
+        label.shape,
+        _dedup([-1 if label.shape[d] == 1 else logits.dims_mapping[d]
+                for d in range(len(label.shape))]))
+    loss_shape = logits.shape[:-1]
+    loss_map = list(req_logits.dims_mapping[:-1])
+    partial = {class_axis} if class_axis != -1 else set()
+    return SpmdInfo(
+        [req_logits, req_label],
+        [DistTensorSpec(logits.shape, list(logits.dims_mapping)),  # softmax
+         DistTensorSpec(loss_shape, loss_map, partial)])
+
+
+@register_spmd_rule("flash_attention")
+def _flash_attention(q: DistTensorSpec, k: DistTensorSpec, v: DistTensorSpec,
+                     causal: bool = True, **attrs) -> SpmdInfo:
+    """spmd_rules/flash_attention.cc: [b, s, h, d] — batch and head sharding
+    propagate; head_dim must be whole; q's seq sharding is the
+    sequence-parallel (ring attention) case and stays on q/out while k/v hold
+    their own seq sharding (rotated at runtime by the ring kernel)."""
+    b = _merge_dim([q.dims_mapping[0], k.dims_mapping[0], v.dims_mapping[0]])
+    h = _merge_dim([q.dims_mapping[2], k.dims_mapping[2], v.dims_mapping[2]])
+    sq = q.dims_mapping[1]
+    skv = _merge_dim([k.dims_mapping[1], v.dims_mapping[1]])
+    req_q = _dedup([b, sq, h, -1])
+    req_kv = _dedup([b, skv, h, -1])
+    return SpmdInfo(
+        [DistTensorSpec(q.shape, req_q),
+         DistTensorSpec(k.shape, list(req_kv)),
+         DistTensorSpec(v.shape, list(req_kv))],
+        [DistTensorSpec(q.shape, list(req_q))])
+
+
+@register_spmd_rule("transpose")
+def _transpose(x: DistTensorSpec, perm: Sequence[int] = (), **attrs
+               ) -> SpmdInfo:
+    perm = list(perm) or list(reversed(range(x.ndim)))
+    out_shape = tuple(x.shape[p] for p in perm)
+    out_map = [x.dims_mapping[p] for p in perm]
+    return SpmdInfo([x.copy()], [DistTensorSpec(out_shape, out_map)])
+
+
+@register_spmd_rule("reshape")
+def _reshape(x: DistTensorSpec, shape: Sequence[int] = (), **attrs
+             ) -> SpmdInfo:
+    """spmd_rules/reshape.cc via dim_trans (MakeReshapeDimTrans): walk both
+    shapes grouping equal-product runs — 1:1 dims keep sharding, flatten
+    groups keep the leading factor's sharding, split groups keep it on the
+    leading chunk; mixed groups are cleared."""
+    out_shape = list(shape)
+    neg = [i for i, s in enumerate(out_shape) if s == -1]
+    total = 1
+    for s in x.shape:
+        total *= s
+    if neg:
+        known = 1
+        for s in out_shape:
+            if s != -1:
+                known *= s
+        out_shape[neg[0]] = total // known
+    out_dims: List = []
+    i = j = 0
+    while i < x.ndim or j < len(out_shape):
+        # skip/emit size-1 alignment trivially inside the grouping below
+        pi, pj = 1, 1
+        gi, gj = [], []
+        # grow groups until products match
+        if i < x.ndim:
+            pi *= x.shape[i]; gi.append(i); i += 1
+        if j < len(out_shape):
+            pj *= out_shape[j]; gj.append(j); j += 1
+        while pi != pj:
+            if pi < pj and i < x.ndim:
+                pi *= x.shape[i]; gi.append(i); i += 1
+            elif pj < pi and j < len(out_shape):
+                pj *= out_shape[j]; gj.append(j); j += 1
+            else:
+                break
+        if not gj:
+            # leftover input dims with no output group (trailing unit dims,
+            # e.g. (N,1)->(N,)): consumed with nothing to emit; a size-1 dim
+            # cannot carry a shard so no req update is needed
+            continue
+        if len(gi) == 1 and len(gj) == 1 and pi == pj:
+            out_dims.append(("dim", gi[0]))
+        elif len(gj) == 1 and gi and pi == pj:
+            out_dims.append(("flatten", gi))
+        elif len(gi) == 1 and pi == pj:
+            # the sharding keeper is the first non-unit chunk (a size-1
+            # leading chunk cannot carry a shard)
+            src = gi[0]
+            keeper = next((oj for oj in gj if out_shape[oj] > 1), gj[0])
+            for oj in gj:
+                out_dims.append(("split", src, out_shape[oj], oj == keeper))
+        else:  # uneven factorization / trailing unit dims: clear
+            for oj in gj:
+                out_dims.append(("const", out_shape[oj]))
+    info = dim_trans_infer(x, out_dims)
+    # a split keeps sharding only if the shard count divides the chunk; the
+    # leading-chunk rule above is the reference's behavior (dim_trans.cc)
+    return info
+
+
+@register_spmd_rule("concat")
+def _concat(*specs: DistTensorSpec, axis: int = 0, **attrs) -> SpmdInfo:
+    ax = axis % specs[0].ndim
+    merged = [_merge_dim([s.dims_mapping[d] for s in specs])
+              for d in range(specs[0].ndim)]
+    merged[ax] = -1  # concat axis must be whole
+    merged = _dedup(merged)
+    req = [DistTensorSpec(s.shape, list(merged)) for s in specs]
+    out_shape = list(specs[0].shape)
+    out_shape[ax] = sum(s.shape[ax] for s in specs)
+    return SpmdInfo(req, [DistTensorSpec(tuple(out_shape), list(merged))])
+
+
+@register_spmd_rule("split")
+def _split(x: DistTensorSpec, num_or_sections=2, axis: int = 0,
+           **attrs) -> SpmdInfo:
+    ax = axis % x.ndim
+    req = list(x.dims_mapping)
+    req[ax] = -1
+    n = (num_or_sections if isinstance(num_or_sections, int)
+         else len(num_or_sections))
+    if isinstance(num_or_sections, int):
+        sizes = [x.shape[ax] // n] * n
+    else:
+        sizes = list(num_or_sections)
+    outs = []
+    for sz in sizes:
+        shp = list(x.shape)
+        shp[ax] = sz
+        outs.append(DistTensorSpec(tuple(shp), list(req)))
+    return SpmdInfo([DistTensorSpec(x.shape, req)], outs)
+
+
+@register_spmd_rule("fused_rope")
+def _fused_rope(q: DistTensorSpec, k: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/fused_rope.cc: rotary embedding is positionwise — any
+    batch/seq/head sharding passes through, head_dim must be whole."""
+    def clamp(s):
+        m = list(s.dims_mapping)
+        m[-1] = -1
+        return DistTensorSpec(s.shape, _dedup(m))
+    rq, rk = clamp(q), clamp(k)
+    return SpmdInfo([rq, rk],
+                    [DistTensorSpec(q.shape, list(rq.dims_mapping)),
+                     DistTensorSpec(k.shape, list(rk.dims_mapping))])
+
+
+# -- dim-trans machinery (spmd_rules/dim_trans.cc) ---------------------------
+#
+# Shape-changing ops (reshape/flatten/squeeze/unsqueeze) are described as a
+# per-output-dim transformation over input dims; sharding propagates to an
+# output dim when it is built from a single input dim or is the LEADING
+# factor of a flatten group (the reference's Flatten/Split/InputDim scheme).
+
+def dim_trans_infer(x: DistTensorSpec, out_dims: List) -> SpmdInfo:
+    """out_dims: one entry per output dim —
+       ("dim", i)          output dim IS input dim i
+       ("flatten", [i,..]) output dim merges input dims (leading dim's
+                           sharding survives; the rest must be whole)
+       ("const", size)     new size-`size` dim (unsharded)
+       ("split", i, size, leading)  a chunk of input dim i; only the
+                           leading chunk keeps i's sharding
+    """
+    req = list(x.dims_mapping)
+    out_map: List[int] = []
+    out_shape: List[int] = []
+    for ent in out_dims:
+        kind = ent[0]
+        if kind == "dim":
+            i = ent[1]
+            out_map.append(x.dims_mapping[i])
+            out_shape.append(x.shape[i])
+        elif kind == "flatten":
+            idxs = ent[1]
+            sz = 1
+            for i in idxs:
+                sz *= x.shape[i]
+            out_shape.append(sz)
+            out_map.append(x.dims_mapping[idxs[0]])
+            for i in idxs[1:]:
+                req[i] = -1     # non-leading factors must be whole per shard
+        elif kind == "const":
+            out_shape.append(ent[1])
+            out_map.append(-1)
+        elif kind == "split":
+            _, i, size, leading = ent
+            out_shape.append(size)
+            if leading:
+                out_map.append(x.dims_mapping[i])
+            else:
+                out_map.append(-1)
+        else:
+            raise ValueError(kind)
+    return SpmdInfo([DistTensorSpec(x.shape, _dedup(req))],
+                    [DistTensorSpec(tuple(out_shape), _dedup(out_map))])
+
+
+@register_spmd_rule("flatten")
+def _flatten(x: DistTensorSpec, start_axis: int = 0, stop_axis: int = -1,
+             **attrs) -> SpmdInfo:
+    """spmd_rules/flatten.cc via dim_trans: flattened group keeps the
+    leading dim's sharding."""
+    sa, so = start_axis % x.ndim, stop_axis % x.ndim
+    out_dims: List = [("dim", i) for i in range(sa)]
+    out_dims.append(("flatten", list(range(sa, so + 1))))
+    out_dims += [("dim", i) for i in range(so + 1, x.ndim)]
+    return dim_trans_infer(x, out_dims)
+
+
+@register_spmd_rule("squeeze")
+def _squeeze(x: DistTensorSpec, axis=None, **attrs) -> SpmdInfo:
+    """spmd_rules/squeeze.cc: size-1 dims drop; others pass through."""
+    if axis is None:
+        drop = {i for i, s in enumerate(x.shape) if s == 1}
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        drop = {a % x.ndim for a in axes if x.shape[a % x.ndim] == 1}
+    out_dims = [("dim", i) for i in range(x.ndim) if i not in drop]
+    return dim_trans_infer(x, out_dims)
+
+
+@register_spmd_rule("unsqueeze")
+def _unsqueeze(x: DistTensorSpec, axis=0, **attrs) -> SpmdInfo:
+    """spmd_rules/unsqueeze.cc: inserted size-1 dims are unsharded."""
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    out_ndim = x.ndim + len(axes)
+    axes = sorted(a % out_ndim for a in axes)
+    out_dims: List = []
+    src = 0
+    for d in range(out_ndim):
+        if d in axes:
+            out_dims.append(("const", 1))
+        else:
+            out_dims.append(("dim", src))
+            src += 1
+    return dim_trans_infer(x, out_dims)
+
+
+# -- identity-propagation & misc rules ---------------------------------------
+
+def _identity_rule(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    return SpmdInfo([x.copy()],
+                    [DistTensorSpec(x.shape, list(x.dims_mapping),
+                                    set(x.partial_on))])
+
+
+@register_spmd_rule("cast")
+def _cast(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/cast.cc: dtype change, sharding unchanged."""
+    return _identity_rule(x)
+
+
+@register_spmd_rule("scale")
+def _scale(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/scale.cc: elementwise affine, sharding unchanged."""
+    return _identity_rule(x)
+
+
+@register_spmd_rule("pow")
+def _pow(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/pow.cc: elementwise, sharding unchanged."""
+    return _identity_rule(x)
+
+
+@register_spmd_rule("full_like")
+def _full_like(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/full_like.cc: value-independent fill — output replicated
+    (the cheap choice: a fill needs no communication either way)."""
+    return SpmdInfo([x.copy()], [DistTensorSpec(x.shape, [-1] * x.ndim)])
+
+
+@register_spmd_rule("numel")
+def _numel(x: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/numel.cc: scalar metadata output, replicated."""
+    return SpmdInfo([x.copy()], [DistTensorSpec((), [])])
+
+
+@register_spmd_rule("triu")
+def _triu(x: DistTensorSpec, diagonal: int = 0, **attrs) -> SpmdInfo:
+    """spmd_rules/triu.cc: the two matrix dims are unsharded (the mask is
+    positional over the full matrix); batch dims pass through."""
+    req = _dedup(list(x.dims_mapping[:-2]) + [-1, -1])
+    return SpmdInfo([DistTensorSpec(x.shape, req)],
+                    [DistTensorSpec(x.shape, list(req))])
+
+
+@register_spmd_rule("slice")
+def _slice(x: DistTensorSpec, axes=(), **attrs) -> SpmdInfo:
+    """spmd_rules/slice.cc: sliced axes must be whole per shard; the rest
+    propagate. Output shape is not computable without starts/ends, so the
+    output spec reuses x.shape (callers use the mappings)."""
+    req = list(x.dims_mapping)
+    for a in axes:
+        req[a % x.ndim] = -1
+    req = _dedup(req)
+    return SpmdInfo([DistTensorSpec(x.shape, req)],
+                    [DistTensorSpec(x.shape, list(req))])
+
+
+@register_spmd_rule("stack")
+def _stack(*specs: DistTensorSpec, axis: int = 0, **attrs) -> SpmdInfo:
+    """spmd_rules/stack.cc: inputs merge; the new axis is unsharded."""
+    nd = specs[0].ndim
+    ax = axis % (nd + 1)
+    merged = _dedup([_merge_dim([s.dims_mapping[d] for s in specs])
+                     for d in range(nd)])
+    req = [DistTensorSpec(s.shape, list(merged)) for s in specs]
+    out_map = merged[:ax] + [-1] + merged[ax:]
+    out_shape = (specs[0].shape[:ax] + (len(specs),) + specs[0].shape[ax:])
+    return SpmdInfo(req, [DistTensorSpec(out_shape, out_map)])
+
+
+@register_spmd_rule("tile")
+def _tile(x: DistTensorSpec, repeat_times=(), **attrs) -> SpmdInfo:
+    """spmd_rules/tile.cc: dims with repeat 1 keep sharding; repeated dims
+    and broadcast (new leading) dims are unsharded."""
+    rt = list(repeat_times)
+    if len(rt) < x.ndim:          # paddle pads short repeat_times in front
+        rt = [1] * (x.ndim - len(rt)) + rt
+    bcast = len(rt) - x.ndim
+    req = list(x.dims_mapping)
+    for i in range(x.ndim):
+        if rt[bcast + i] != 1:
+            req[i] = -1
+    req = _dedup(req)
+    out_map = [-1] * len(rt)
+    out_shape = []
+    for i in range(len(rt)):
+        if i < bcast:
+            out_shape.append(rt[i])
+        else:
+            out_map[i] = req[i - bcast] if rt[i] == 1 else -1
+            out_shape.append(x.shape[i - bcast] * rt[i])
+    return SpmdInfo([DistTensorSpec(x.shape, req)],
+                    [DistTensorSpec(tuple(out_shape), _dedup(out_map))])
+
+
+@register_spmd_rule("where")
+def _where(cond: DistTensorSpec, x: DistTensorSpec, y: DistTensorSpec,
+           **attrs) -> SpmdInfo:
+    """spmd_rules/where.cc: ternary broadcast elementwise."""
+    return _elementwise(cond, x, y)
+
+
+@register_spmd_rule("default_data_parallel")
+def _default_dp(*specs: DistTensorSpec, n_outputs: int = 1,
+                **attrs) -> SpmdInfo:
+    """spmd_rules/default_data_parallel.cc: merge the batch (0th) axis over
+    all inputs; everything else replicated; outputs batch-sharded."""
+    b = _merge_dim([s.dims_mapping[0] for s in specs if s.ndim > 0])
+    req = [DistTensorSpec(s.shape, _dedup([b] + [-1] * (s.ndim - 1))
+                          if s.ndim else []) for s in specs]
+    outs = [DistTensorSpec(specs[0].shape,
+                           _dedup([b] + [-1] * (specs[0].ndim - 1)))
+            for _ in range(n_outputs)]
+    return SpmdInfo(req, outs)
+
+
+@register_spmd_rule("optimizer")
+def _optimizer(param: DistTensorSpec, grad: DistTensorSpec,
+               *moments: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/optimizer.cc (AdamInferSpmdDynamic): param/grad merge
+    elementwise; every moment aligns to the merged param mapping (ZeRO
+    state follows the param shards); scalars stay replicated."""
+    merged = _dedup([_merge_dim([p, g]) for p, g in
+                     zip(param.dims_mapping, grad.dims_mapping)])
+    req = [DistTensorSpec(param.shape, list(merged)),
+           DistTensorSpec(grad.shape, list(merged))]
+    outs = [DistTensorSpec(param.shape, list(merged))]
+    for m in moments:
+        mapping = list(merged) if m.ndim == param.ndim else [-1] * m.ndim
+        req.append(DistTensorSpec(m.shape, mapping))
+        outs.append(DistTensorSpec(m.shape, list(mapping)))
+    return SpmdInfo(req, outs)
+
+
+@register_spmd_rule("fused_linear_param_grad_add")
+def _fused_linear_param_grad_add(x: DistTensorSpec, dout: DistTensorSpec,
+                                 dweight: Optional[DistTensorSpec] = None,
+                                 dbias: Optional[DistTensorSpec] = None,
+                                 **attrs) -> SpmdInfo:
+    """spmd_rules/fused_linear_param_grad_add.cc: dweight = x^T @ dout over
+    the flattened batch/row dims — any mesh axis sharding those dims leaves
+    dweight/dbias Partial on it; k/n shardings propagate to dweight."""
+    k_axis = x.dims_mapping[-1]
+    n_axis = dout.dims_mapping[-1]
+    partial = set()
+    for m in list(x.dims_mapping[:-1]) + list(dout.dims_mapping[:-1]):
+        if m != -1:
+            partial.add(m)
+    dw_map = _dedup([k_axis, n_axis])
+    dw_shape = (x.shape[-1], dout.shape[-1])
+    db_shape = (dout.shape[-1],)
+    req = [x.copy(), dout.copy()]
+    outs = [DistTensorSpec(dw_shape, dw_map, set(partial)),
+            DistTensorSpec(db_shape, [dw_map[1]], set(partial))]
+    return SpmdInfo(req, outs)
+
+
+@register_spmd_rule("replicated")
+def _replicated(*specs: DistTensorSpec, **attrs) -> SpmdInfo:
+    """spmd_rules/replicated.cc: force everything replicated (the explicit
+    form of the __default__ fallback, with outputs)."""
+    n_outputs = attrs.get("n_outputs", 1)
+    ins = [DistTensorSpec(s.shape, [-1] * s.ndim) for s in specs]
+    outs = [DistTensorSpec(specs[0].shape, [-1] * specs[0].ndim)
+            for _ in range(n_outputs)]
+    return SpmdInfo(ins, outs)
+
+
+# -- reshard planning ---------------------------------------------------------
+
+def plan_reshard(src: Sequence, dst: Sequence) -> List[str]:
+    """Name the collective sequence converting placements src → dst on one
+    mesh axis list — the registry-dispatch analog of the reference's
+    ReshardFunctions (reshard/*_reshard_function.cc: r↔s, p↔r, p→s, s↔p,
+    s→s ...). Execution on TPU is a single `device_put`/sharding constraint
+    (GSPMD emits these exact collectives); the plan is what tests assert and
+    what the profiler labels transfers with."""
+    from .placements import Partial, Replicate, Shard
+    steps: List[str] = []
+    for i, (a, b) in enumerate(zip(src, dst)):
+        if a == b:
+            continue
+        if isinstance(a, Partial) and isinstance(b, Replicate):
+            steps.append(f"all_reduce(axis={i})")          # PToR
+        elif isinstance(a, Partial) and isinstance(b, Shard):
+            steps.append(f"reduce_scatter(axis={i}, dim={b.dim})")  # PToS
+        elif isinstance(a, Shard) and isinstance(b, Replicate):
+            steps.append(f"all_gather(axis={i}, dim={a.dim})")      # SToR
+        elif isinstance(a, Replicate) and isinstance(b, Shard):
+            steps.append(f"slice(axis={i}, dim={b.dim})")           # RToS
+        elif isinstance(a, Shard) and isinstance(b, Shard):
+            steps.append(f"all_to_all(axis={i}, from_dim={a.dim}, "
+                         f"to_dim={b.dim})")                        # SToS
+        elif isinstance(a, Replicate) and isinstance(b, Partial):
+            steps.append(f"zero_pad(axis={i})")                     # RToP
+        else:
+            steps.append(f"unsupported({a}->{b})")
+    return steps

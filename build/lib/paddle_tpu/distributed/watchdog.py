@@ -1,0 +1,129 @@
+"""Collective hang watchdog (reference
+phi/core/distributed/comm_task_manager.h:37 + comm_task.h:127 IsTimeout —
+async detection of stuck NCCL collectives with store-based error fan-out).
+
+TPU shape: ICI collectives are compiler-scheduled and cannot hang
+independently, but DCN-crossing steps and eager cross-host collectives can.
+Callers bracket such regions with `comm_watchdog.start_task(...)`; a scan
+thread flags tasks that outlive their timeout, fires registered handlers, and
+(if a store is attached) publishes the failure so every rank learns which
+rank/op stalled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class CommTask:
+    _next_id = 0
+    _id_lock = threading.Lock()
+
+    def __init__(self, name: str, timeout_s: float, rank: int):
+        with CommTask._id_lock:
+            CommTask._next_id += 1
+            self.task_id = CommTask._next_id
+        self.name = name
+        self.timeout_s = timeout_s
+        self.rank = rank
+        self.start = time.monotonic()
+        self.done = False
+
+    def is_timeout(self) -> bool:
+        return (not self.done and
+                time.monotonic() - self.start > self.timeout_s)
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.start
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # finish on the manager that created this task (set by start_task),
+        # not the global singleton
+        self._mgr.finish_task(self)
+        return False
+
+
+class CommTaskManager:
+    def __init__(self, scan_interval: float = 0.5):
+        self._tasks: Dict[int, CommTask] = {}
+        self._lock = threading.Lock()
+        self._handlers: List[Callable[[CommTask], None]] = []
+        self._timed_out: List[CommTask] = []
+        self._scan_interval = scan_interval
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._store = None
+
+    def attach_store(self, store, rank: int = 0):
+        """Publish timeouts into a TCPStore so peers see who stalled."""
+        self._store = (store, rank)
+
+    def add_handler(self, fn: Callable[[CommTask], None]):
+        self._handlers.append(fn)
+
+    def start_task(self, name: str, timeout_s: float = 600.0,
+                   rank: int = 0) -> CommTask:
+        t = CommTask(name, timeout_s, rank)
+        t._mgr = self
+        with self._lock:
+            self._tasks[t.task_id] = t
+            self._ensure_thread()
+        return t
+
+    def finish_task(self, t: CommTask):
+        t.done = True
+        with self._lock:
+            self._tasks.pop(t.task_id, None)
+
+    def timed_out_tasks(self) -> List[CommTask]:
+        with self._lock:
+            return list(self._timed_out)
+
+    def _ensure_thread(self):
+        # caller holds self._lock. A stopped manager (shutdown) restarts on
+        # the next task; an idle-but-alive thread just keeps scanning — the
+        # 2 Hz wakeup is cheaper than any park/handoff race.
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._scan_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    def _scan_loop(self):
+        stop = self._stop  # bound once: shutdown() swaps no state under us
+        while not stop.wait(self._scan_interval):
+            with self._lock:
+                overdue = [t for t in self._tasks.values() if t.is_timeout()]
+                for t in overdue:
+                    self._tasks.pop(t.task_id, None)
+                    self._timed_out.append(t)
+            for t in overdue:
+                if self._store is not None:
+                    store, rank = self._store
+                    try:
+                        store.set(f"comm_error/{rank}/{t.name}",
+                                  f"timeout after {t.elapsed():.1f}s")
+                    except Exception:
+                        pass
+                for fn in self._handlers:
+                    fn(t)
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+_manager: Optional[CommTaskManager] = None
+
+
+def comm_watchdog() -> CommTaskManager:
+    global _manager
+    if _manager is None:
+        _manager = CommTaskManager()
+    return _manager

@@ -1,0 +1,32 @@
+"""paddle_tpu.distribution — probability distributions (reference
+python/paddle/distribution: 20+ distributions, transforms, KL registry)."""
+
+from .continuous import (Beta, Cauchy, Exponential, Gamma, Gumbel,  # noqa: F401
+                         Laplace, LogNormal, Normal, Uniform)
+from .discrete import (Bernoulli, Binomial, Categorical,  # noqa: F401
+                       ContinuousBernoulli, Geometric, Multinomial, Poisson)
+from .distribution import Distribution  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .multivariate import Dirichlet, MultivariateNormal  # noqa: F401
+from .transform import (AbsTransform, AffineTransform,  # noqa: F401
+                        ChainTransform, ExpTransform, IndependentTransform,
+                        PowerTransform, ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform)
+from .wrappers import (ExponentialFamily, Independent,  # noqa: F401
+                       TransformedDistribution)
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Independent",
+    "TransformedDistribution",
+    "Normal", "Uniform", "Exponential", "Laplace", "LogNormal", "Cauchy",
+    "Gumbel", "Gamma", "Beta",
+    "Bernoulli", "Binomial", "Categorical", "ContinuousBernoulli",
+    "Geometric", "Multinomial", "Poisson",
+    "Dirichlet", "MultivariateNormal",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "kl_divergence", "register_kl",
+]

@@ -1,0 +1,379 @@
+"""Continuous univariate distributions (reference python/paddle/
+distribution/{normal,uniform,exponential,laplace,lognormal,cauchy,gumbel,
+gamma,beta}.py). All math composes framework ops so log_prob/rsample are
+tape-recorded and jit-traceable."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _broadcast_shape, _t
+
+__all__ = ["Normal", "Uniform", "Exponential", "Laplace", "LogNormal",
+           "Cauchy", "Gumbel", "Gamma", "Beta"]
+
+_LOG_2PI = math.log(2.0 * math.pi)
+_EULER = 0.5772156649015329
+
+
+def _jax_sample(fn, shape):
+    """Draw with raw jax.random through the stateful generator (used where
+    the op library has no sampler, e.g. gamma); non-reparameterized."""
+    import jax
+
+    from ..core.generator import default_generator
+    key = default_generator().next_key()
+    return Tensor(fn(key, shape))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shape(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc * paddle.ones_like(self.scale)
+
+    @property
+    def variance(self):
+        return paddle.square(self.scale) * paddle.ones_like(self.loc)
+
+    @property
+    def stddev(self):
+        return self.scale * paddle.ones_like(self.loc)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out = self._extend_shape(shape)
+        eps = paddle.randn(list(out))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = paddle.square(self.scale)
+        return (-paddle.square(value - self.loc) / (2.0 * var)
+                - paddle.log(self.scale) - 0.5 * _LOG_2PI)
+
+    def entropy(self):
+        return (0.5 + 0.5 * _LOG_2PI
+                + paddle.log(self.scale * paddle.ones_like(self.loc)))
+
+    def cdf(self, value):
+        value = _t(value)
+        return 0.5 * (1.0 + paddle.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2.0))))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(_broadcast_shape(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        return paddle.square(self.high - self.low) / 12.0
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        value = _t(value)
+        inside = paddle.logical_and(value >= self.low, value < self.high)
+        dens = -paddle.log(self.high - self.low) * paddle.ones_like(value)
+        neg_inf = paddle.full_like(dens, -np.inf)
+        return paddle.where(inside, dens, neg_inf)
+
+    def entropy(self):
+        return paddle.log(self.high - self.low)
+
+    def cdf(self, value):
+        value = _t(value)
+        return paddle.clip((value - self.low) / (self.high - self.low),
+                           0.0, 1.0)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / paddle.square(self.rate)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        return -paddle.log1p(-u) / self.rate
+
+    def log_prob(self, value):
+        value = _t(value)
+        return paddle.log(self.rate) - self.rate * value
+
+    def entropy(self):
+        return 1.0 - paddle.log(self.rate)
+
+    def cdf(self, value):
+        return 1.0 - paddle.exp(-self.rate * _t(value))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shape(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc * paddle.ones_like(self.scale)
+
+    @property
+    def variance(self):
+        return 2.0 * paddle.square(self.scale) * paddle.ones_like(self.loc)
+
+    @property
+    def stddev(self):
+        return math.sqrt(2.0) * self.scale * paddle.ones_like(self.loc)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        # inverse-CDF on u ~ U(-1/2, 1/2)
+        u = paddle.rand(list(self._extend_shape(shape))) - 0.5
+        return self.loc - self.scale * paddle.sign(u) * paddle.log1p(
+            -2.0 * paddle.abs(u))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return (-paddle.abs(value - self.loc) / self.scale
+                - paddle.log(2.0 * self.scale))
+
+    def entropy(self):
+        return 1.0 + paddle.log(2.0 * self.scale * paddle.ones_like(self.loc))
+
+    def cdf(self, value):
+        value = _t(value)
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * paddle.sign(z) * paddle.expm1(-paddle.abs(z))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shape(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return paddle.exp(self.loc + paddle.square(self.scale) / 2.0)
+
+    @property
+    def variance(self):
+        s2 = paddle.square(self.scale)
+        return paddle.expm1(s2) * paddle.exp(2.0 * self.loc + s2)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        eps = paddle.randn(list(self._extend_shape(shape)))
+        return paddle.exp(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        value = _t(value)
+        logv = paddle.log(value)
+        var = paddle.square(self.scale)
+        return (-paddle.square(logv - self.loc) / (2.0 * var) - logv
+                - paddle.log(self.scale) - 0.5 * _LOG_2PI)
+
+    def entropy(self):
+        return (self.loc + 0.5 + 0.5 * _LOG_2PI
+                + paddle.log(self.scale * paddle.ones_like(self.loc)))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shape(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        return self.loc + self.scale * paddle.tan(math.pi * (u - 0.5))
+
+    def log_prob(self, value):
+        value = _t(value)
+        z = (value - self.loc) / self.scale
+        return (-math.log(math.pi) - paddle.log(self.scale)
+                - paddle.log1p(paddle.square(z)))
+
+    def entropy(self):
+        return math.log(4.0 * math.pi) + paddle.log(
+            self.scale * paddle.ones_like(self.loc))
+
+    def cdf(self, value):
+        value = _t(value)
+        return paddle.atan((value - self.loc) / self.scale) / math.pi + 0.5
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_broadcast_shape(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * _EULER
+
+    @property
+    def variance(self):
+        return paddle.square(self.scale) * (math.pi ** 2) / 6.0
+
+    @property
+    def stddev(self):
+        return self.scale * math.pi / math.sqrt(6.0)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        return self.loc - self.scale * paddle.log(-paddle.log(u))
+
+    def log_prob(self, value):
+        value = _t(value)
+        z = (value - self.loc) / self.scale
+        return -(z + paddle.exp(-z)) - paddle.log(self.scale)
+
+    def entropy(self):
+        return paddle.log(self.scale * paddle.ones_like(self.loc)) \
+            + 1.0 + _EULER
+
+    def cdf(self, value):
+        value = _t(value)
+        return paddle.exp(-paddle.exp(-(value - self.loc) / self.scale))
+
+
+class Gamma(Distribution):
+    """concentration/rate parameterization (reference gamma.py)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(_broadcast_shape(self.concentration.shape,
+                                          self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / paddle.square(self.rate)
+
+    def sample(self, shape=()):
+        import jax
+        a = np.broadcast_to(np.asarray(self.concentration._data),
+                            self.batch_shape)
+        out = self._extend_shape(shape)
+        s = _jax_sample(
+            lambda key, sh: jax.random.gamma(
+                key, np.broadcast_to(a, sh).astype(np.float32)), out)
+        return s / self.rate
+
+    def log_prob(self, value):
+        value = _t(value)
+        a, r = self.concentration, self.rate
+        return (a * paddle.log(r) + (a - 1.0) * paddle.log(value)
+                - r * value - paddle.lgamma(a))
+
+    def entropy(self):
+        a, r = self.concentration, self.rate
+        return (a - paddle.log(r) + paddle.lgamma(a)
+                + (1.0 - a) * paddle.digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(_broadcast_shape(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (paddle.square(s) * (s + 1.0))
+
+    def sample(self, shape=()):
+        import jax
+        a = np.broadcast_to(np.asarray(self.alpha._data), self.batch_shape)
+        b = np.broadcast_to(np.asarray(self.beta._data), self.batch_shape)
+        out = self._extend_shape(shape)
+        return _jax_sample(
+            lambda key, sh: jax.random.beta(
+                key, np.broadcast_to(a, sh).astype(np.float32),
+                np.broadcast_to(b, sh).astype(np.float32)), out)
+
+    def _lbeta(self):
+        return (paddle.lgamma(self.alpha) + paddle.lgamma(self.beta)
+                - paddle.lgamma(self.alpha + self.beta))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return ((self.alpha - 1.0) * paddle.log(value)
+                + (self.beta - 1.0) * paddle.log1p(-value) - self._lbeta())
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        s = a + b
+        return (self._lbeta() - (a - 1.0) * paddle.digamma(a)
+                - (b - 1.0) * paddle.digamma(b)
+                + (s - 2.0) * paddle.digamma(s))

@@ -1,0 +1,353 @@
+"""Discrete distributions (reference python/paddle/distribution/
+{bernoulli,binomial,categorical,continuous_bernoulli,geometric,multinomial,
+poisson}.py)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _broadcast_shape, _t
+
+__all__ = ["Bernoulli", "Binomial", "Categorical", "ContinuousBernoulli",
+           "Geometric", "Multinomial", "Poisson"]
+
+
+def _xlogy(x, y):
+    """x*log(y) with 0*log(0)=0."""
+    safe = paddle.where(x == 0.0, paddle.ones_like(y), y)
+    return paddle.where(x == 0.0, paddle.zeros_like(x),
+                        x * paddle.log(safe))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        p = paddle.broadcast_to(self.probs,
+                                list(self._extend_shape(shape))) \
+            if self._extend_shape(shape) != tuple(self.probs.shape) \
+            else self.probs
+        return paddle.bernoulli(p)
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-sigmoid relaxation (reference bernoulli.py rsample)."""
+        out = list(self._extend_shape(shape))
+        u = paddle.rand(out)
+        logits = paddle.log(self.probs) - paddle.log1p(-self.probs)
+        noise = paddle.log(u) - paddle.log1p(-u)
+        return paddle.sigmoid((logits + noise) / temperature)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return _xlogy(value, self.probs) + _xlogy(1.0 - value,
+                                                  1.0 - self.probs)
+
+    def entropy(self):
+        p = self.probs
+        return -(_xlogy(p, p) + _xlogy(1.0 - p, 1.0 - p))
+
+    def cdf(self, value):
+        value = _t(value)
+        zeros = paddle.zeros_like(self.probs * value)
+        ones = paddle.ones_like(self.probs * value)
+        mid = (1.0 - self.probs) * paddle.ones_like(value)
+        return paddle.where(value < 0.0, zeros,
+                            paddle.where(value < 1.0, mid, ones))
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(λ) (reference continuous_bernoulli.py) — the [0,1]-supported
+    exponential-family relaxation with normalizer C(λ)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _outside(self):
+        lo, hi = self._lims
+        return paddle.logical_or(self.probs < lo, self.probs > hi)
+
+    def _log_norm(self):
+        """log C(λ); Taylor-safe near λ=1/2."""
+        p = self.probs
+        safe = paddle.where(self._outside(), p,
+                            paddle.full_like(p, 0.25))
+        log_norm = paddle.log(
+            paddle.abs(paddle.log1p(-safe) - paddle.log(safe))) - \
+            paddle.log(paddle.abs(1.0 - 2.0 * safe))
+        taylor = math.log(2.0) + 4.0 / 3.0 * paddle.square(p - 0.5)
+        return paddle.where(self._outside(), log_norm, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = paddle.where(self._outside(), p, paddle.full_like(p, 0.25))
+        m = safe / (2.0 * safe - 1.0) + 1.0 / (
+            2.0 * paddle.atanh(1.0 - 2.0 * safe))
+        taylor = 0.5 + (p - 0.5) / 3.0
+        return paddle.where(self._outside(), m, taylor)
+
+    @property
+    def variance(self):
+        p = self.probs
+        safe = paddle.where(self._outside(), p, paddle.full_like(p, 0.25))
+        v = safe * (safe - 1.0) / paddle.square(1.0 - 2.0 * safe) + 1.0 / \
+            paddle.square(2.0 * paddle.atanh(1.0 - 2.0 * safe))
+        taylor = 1.0 / 12.0 - paddle.square(p - 0.5) / 15.0
+        return paddle.where(self._outside(), v, taylor)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        p = self.probs
+        safe = paddle.where(self._outside(), p, paddle.full_like(p, 0.25))
+        # F^-1(u) = log1p(u*expm1(-r))/(-r), r = log((1-p)/p)
+        neg_r = paddle.log(safe) - paddle.log1p(-safe)
+        icdf = paddle.log1p(u * paddle.expm1(neg_r)) / neg_r
+        return paddle.where(self._outside(), icdf, u)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return (_xlogy(value, self.probs)
+                + _xlogy(1.0 - value, 1.0 - self.probs) + self._log_norm())
+
+    def entropy(self):
+        # E[-log p(X)] in closed form via mean
+        m = self.mean
+        p = self.probs
+        return -(m * (paddle.log(p) - paddle.log1p(-p))
+                 + paddle.log1p(-p) + self._log_norm())
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(_broadcast_shape(self.total_count.shape,
+                                          self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        import jax
+
+        from ..core.generator import default_generator
+        key = default_generator().next_key()
+        out = self._extend_shape(shape)
+        n = np.broadcast_to(np.asarray(self.total_count._data), out)
+        p = np.broadcast_to(np.asarray(self.probs._data), out)
+        draw = jax.random.binomial(key, n.astype(np.float32),
+                                   p.astype(np.float32), shape=out)
+        return Tensor(draw.astype(np.float32))
+
+    def log_prob(self, value):
+        value = _t(value)
+        n, p = self.total_count, self.probs
+        log_comb = (paddle.lgamma(n + 1.0) - paddle.lgamma(value + 1.0)
+                    - paddle.lgamma(n - value + 1.0))
+        return log_comb + _xlogy(value, p) + _xlogy(n - value, 1.0 - p)
+
+    def entropy(self):
+        """Exact by support summation (total_count must be host-concrete)."""
+        n_max = int(np.max(np.asarray(self.total_count._data)))
+        ks = paddle.arange(0, n_max + 1).astype("float32")
+        ks = paddle.reshape(ks, [n_max + 1] + [1] * len(self.batch_shape))
+        lp = self.log_prob(ks)
+        valid = ks <= self.total_count * paddle.ones(list(self.batch_shape))
+        plogp = paddle.where(valid, paddle.exp(lp) * lp,
+                             paddle.zeros_like(lp))
+        return -paddle.sum(plogp, axis=0)
+
+
+class Categorical(Distribution):
+    """Unnormalized-logits parameterization (reference categorical.py)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        shape = tuple(self.logits.shape)
+        self._num_categories = shape[-1]
+        super().__init__(shape[:-1])
+
+    @property
+    def probs_param(self):
+        return paddle.softmax(self.logits, axis=-1)
+
+    def probs(self, value):
+        p = self.probs_param
+        value = _t(value).astype("int32")  # x64 disabled on TPU/JAX
+        return self._gather_last(p, value)
+
+    def _gather_last(self, table, value):
+        """table: batch+(k,); value: sample+batch -> sample+batch."""
+        target = tuple(value.shape) + (self._num_categories,)
+        table = paddle.broadcast_to(table, list(target))
+        return paddle.take_along_axis(
+            table, paddle.unsqueeze(value, -1), axis=-1).squeeze(-1)
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no scalar mean")
+
+    def sample(self, shape=()):
+        logits = self.logits
+        flat = paddle.reshape(logits, [-1, self._num_categories])
+        n = int(np.prod(shape)) if shape else 1
+        draws = paddle.multinomial(paddle.softmax(flat, axis=-1),
+                                   num_samples=n, replacement=True)
+        out = tuple(shape) + self.batch_shape
+        draws = paddle.reshape(paddle.transpose(draws, [1, 0]),
+                               list(out) if out else [1])
+        if not out:
+            draws = draws.squeeze(0)
+        return draws
+
+    def log_prob(self, value):
+        logp = paddle.log_softmax(self.logits, axis=-1)
+        value = _t(value).astype("int32")  # x64 disabled on TPU/JAX
+        return self._gather_last(logp, value)
+
+    def entropy(self):
+        logp = paddle.log_softmax(self.logits, axis=-1)
+        p = paddle.softmax(self.logits, axis=-1)
+        return -paddle.sum(p * logp, axis=-1)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0,1,...} (failures before first success)."""
+
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / paddle.square(self.probs)
+
+    @property
+    def stddev(self):
+        return paddle.sqrt(self.variance)
+
+    def sample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        return paddle.floor(paddle.log1p(-u) / paddle.log1p(-self.probs))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return value * paddle.log1p(-self.probs) + paddle.log(self.probs)
+
+    def pmf(self, k):
+        return paddle.exp(self.log_prob(_t(float(k))))
+
+    def entropy(self):
+        p = self.probs
+        q = 1.0 - p
+        return -(q * paddle.log(q) + p * paddle.log(p)) / p
+
+    def cdf(self, value):
+        value = _t(value)
+        return 1.0 - paddle.exp((value + 1.0) * paddle.log1p(-self.probs))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = tuple(self.probs.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        k = self.probs.shape[-1]
+        flat = paddle.reshape(self.probs, [-1, k])
+        n_batch = int(np.prod(shape)) if shape else 1
+        counts = []
+        for _ in range(n_batch):
+            draws = paddle.multinomial(flat, num_samples=self.total_count,
+                                       replacement=True)  # [B, n]
+            onehot = paddle.one_hot(draws, k)              # [B, n, k]
+            counts.append(paddle.sum(onehot, axis=1))      # [B, k]
+        out = paddle.stack(counts, axis=0)  # [prod(shape), B, k]
+        final = tuple(shape) + self.batch_shape + self.event_shape
+        return paddle.reshape(out, list(final) if final else [k])
+
+    def log_prob(self, value):
+        value = _t(value)
+        n = paddle.sum(value, axis=-1)
+        return (paddle.lgamma(n + 1.0)
+                - paddle.sum(paddle.lgamma(value + 1.0), axis=-1)
+                + paddle.sum(_xlogy(value, self.probs), axis=-1))
+
+    def entropy(self):
+        raise NotImplementedError(
+            "Multinomial entropy has no closed form")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        r = paddle.broadcast_to(self.rate, list(self._extend_shape(shape))) \
+            if self._extend_shape(shape) != tuple(self.rate.shape) \
+            else self.rate
+        return paddle.poisson(r)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return (_xlogy(value, self.rate) - self.rate
+                - paddle.lgamma(value + 1.0))
+
+    def entropy(self):
+        """Support summation up to a high quantile (reference poisson.py
+        sums to rate + 30*sqrt(rate))."""
+        r = np.asarray(self.rate._data)
+        n_max = int(np.max(r + 30.0 * np.sqrt(np.maximum(r, 1.0))) + 1)
+        ks = paddle.arange(0, n_max + 1).astype("float32")
+        ks = paddle.reshape(ks, [n_max + 1] + [1] * len(self.batch_shape))
+        lp = self.log_prob(ks)
+        return -paddle.sum(paddle.exp(lp) * lp, axis=0)

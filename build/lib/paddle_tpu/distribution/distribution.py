@@ -1,0 +1,82 @@
+"""Distribution base class (reference python/paddle/distribution/
+distribution.py:50 — batch_shape/event_shape, sample/log_prob/entropy/kl
+contract).
+
+TPU-native: parameters live as framework Tensors and the math composes
+framework ops, so `rsample`/`log_prob` are recorded on the autograd tape
+(pathwise gradients work) and everything traces cleanly under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution"]
+
+
+def _t(x, dtype="float32") -> Tensor:
+    """Coerce number / array / Tensor to a framework Tensor."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x, dtype=dtype))
+
+
+def _broadcast_shape(*shapes) -> Tuple[int, ...]:
+    return tuple(np.broadcast_shapes(*shapes))
+
+
+class Distribution:
+    def __init__(self, batch_shape: Sequence[int] = (),
+                 event_shape: Sequence[int] = ()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Tuple[int, ...]:
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        raise NotImplementedError
+
+    def rsample(self, shape: Sequence[int] = ()) -> Tensor:
+        raise NotImplementedError
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        import paddle_tpu as paddle
+        return paddle.exp(self.log_prob(value))
+
+    def probs(self, value) -> Tensor:  # legacy alias (reference :120)
+        return self.prob(value)
+
+    def kl_divergence(self, other: "Distribution") -> Tensor:
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape: Sequence[int]) -> Tuple[int, ...]:
+        return tuple(sample_shape) + self.batch_shape + self.event_shape
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}(batch_shape={self.batch_shape}, "
+                f"event_shape={self.event_shape})")

@@ -1,0 +1,163 @@
+"""KL divergence registry (reference python/paddle/distribution/kl.py:
+register_kl decorator + dispatch over (type(p), type(q)) with MRO walk)."""
+
+from __future__ import annotations
+
+import math
+
+import paddle_tpu as paddle
+
+from .continuous import (Beta, Cauchy, Exponential, Gamma, Gumbel, Laplace,
+                         LogNormal, Normal, Uniform)
+from .discrete import Bernoulli, Categorical, Geometric, Poisson
+from .distribution import Distribution
+from .multivariate import Dirichlet, MultivariateNormal
+
+__all__ = ["register_kl", "kl_divergence"]
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    # most-derived match over both MROs (reference kl.py dispatch)
+    matches = [(cp, cq) for (cp, cq) in _REGISTRY
+               if isinstance(p, cp) and isinstance(q, cq)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    best = min(matches, key=lambda m: (type(p).__mro__.index(m[0]),
+                                       type(q).__mro__.index(m[1])))
+    return _REGISTRY[best](p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = paddle.square(p.scale / q.scale)
+    t1 = paddle.square((p.loc - q.loc) / q.scale)
+    return 0.5 * (var_ratio + t1 - 1.0 - paddle.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return paddle.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    ratio = q.rate / p.rate
+    return ratio - 1.0 - paddle.log(ratio)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    # log(b2/b1) + |d|/b2 + (b1/b2) e^{-|d|/b1} - 1
+    scale_ratio = p.scale / q.scale
+    delta = paddle.abs(p.loc - q.loc)
+    return (scale_ratio * paddle.exp(-delta / p.scale) + delta / q.scale
+            - paddle.log(scale_ratio) - 1.0)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    a = p.probs
+    b = q.probs
+    eps = 1e-7
+    a = paddle.clip(a, eps, 1.0 - eps)
+    b = paddle.clip(b, eps, 1.0 - eps)
+    return a * (paddle.log(a) - paddle.log(b)) + (1.0 - a) * (
+        paddle.log1p(-a) - paddle.log1p(-b))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    logp = paddle.log_softmax(p.logits, axis=-1)
+    logq = paddle.log_softmax(q.logits, axis=-1)
+    return paddle.sum(paddle.exp(logp) * (logp - logq), axis=-1)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geo_geo(p, q):
+    return (-p.entropy()
+            - paddle.log1p(-q.probs) / p.probs * (1.0 - p.probs)
+            - paddle.log(q.probs))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    ap, bp = p.concentration, p.rate
+    aq, bq = q.concentration, q.rate
+    return ((ap - aq) * paddle.digamma(ap) - paddle.lgamma(ap)
+            + paddle.lgamma(aq) + aq * (paddle.log(bp) - paddle.log(bq))
+            + ap * (bq / bp - 1.0))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def lbeta(a, b):
+        return paddle.lgamma(a) + paddle.lgamma(b) - paddle.lgamma(a + b)
+    sp = p.alpha + p.beta
+    return (lbeta(q.alpha, q.beta) - lbeta(p.alpha, p.beta)
+            + (p.alpha - q.alpha) * paddle.digamma(p.alpha)
+            + (p.beta - q.beta) * paddle.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * paddle.digamma(sp))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dir_dir(p, q):
+    a = p.concentration
+    b = q.concentration
+    a0 = paddle.sum(a, axis=-1, keepdim=True)
+    return (paddle.lgamma(paddle.sum(a, axis=-1))
+            - paddle.lgamma(paddle.sum(b, axis=-1))
+            - paddle.sum(paddle.lgamma(a), axis=-1)
+            + paddle.sum(paddle.lgamma(b), axis=-1)
+            + paddle.sum((a - b) * (paddle.digamma(a)
+                                    - paddle.digamma(a0)), axis=-1))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return p.rate * (paddle.log(p.rate) - paddle.log(q.rate)) \
+        - p.rate + q.rate
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    # equals KL of the underlying normals
+    var_ratio = paddle.square(p.scale / q.scale)
+    t1 = paddle.square((p.loc - q.loc) / q.scale)
+    return 0.5 * (var_ratio + t1 - 1.0 - paddle.log(var_ratio))
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    # E_p[log p - log q]; closed form via MGF of Gumbel
+    _EULER = 0.5772156649015329
+    ratio = p.scale / q.scale
+    loc_diff = (p.loc - q.loc) / q.scale
+    return (paddle.log(q.scale) - paddle.log(p.scale)
+            + _EULER * (ratio - 1.0) + loc_diff
+            + paddle.exp(-loc_diff + paddle.lgamma(ratio + 1.0)) - 1.0)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    k = float(p.event_shape[0])
+    half_logdet_p = paddle.sum(paddle.log(paddle.diagonal(
+        p._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+    half_logdet_q = paddle.sum(paddle.log(paddle.diagonal(
+        q._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+    # tr(Sq^-1 Sp) via triangular solves: M = Lq^-1 Lp
+    m = paddle.triangular_solve(q._scale_tril, p._scale_tril, upper=False)
+    tr = paddle.sum(paddle.square(m), axis=[-2, -1])
+    diff = paddle.unsqueeze(q.loc - p.loc, -1)
+    y = paddle.triangular_solve(q._scale_tril, diff, upper=False)
+    maha = paddle.sum(paddle.square(paddle.squeeze(y, -1)), axis=-1)
+    return 0.5 * (2.0 * (half_logdet_q - half_logdet_p) - k + tr + maha)

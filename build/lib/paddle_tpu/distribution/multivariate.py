@@ -1,0 +1,124 @@
+"""Multivariate distributions (reference python/paddle/distribution/
+{dirichlet,multivariate_normal}.py)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _t
+
+__all__ = ["Dirichlet", "MultivariateNormal"]
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / paddle.sum(self.concentration, axis=-1,
+                                               keepdim=True)
+
+    @property
+    def variance(self):
+        a0 = paddle.sum(self.concentration, axis=-1, keepdim=True)
+        m = self.concentration / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def sample(self, shape=()):
+        import jax
+
+        from ..core.generator import default_generator
+        key = default_generator().next_key()
+        a = np.asarray(self.concentration._data, dtype=np.float32)
+        full = tuple(shape) + self.batch_shape + self.event_shape
+        draw = jax.random.dirichlet(key, np.broadcast_to(a, full))
+        return Tensor(draw)
+
+    def log_prob(self, value):
+        value = _t(value)
+        a = self.concentration
+        return (paddle.sum((a - 1.0) * paddle.log(value), axis=-1)
+                + paddle.lgamma(paddle.sum(a, axis=-1))
+                - paddle.sum(paddle.lgamma(a), axis=-1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = paddle.sum(a, axis=-1)
+        k = float(self.event_shape[0])
+        log_b = (paddle.sum(paddle.lgamma(a), axis=-1)
+                 - paddle.lgamma(a0))
+        return (log_b + (a0 - k) * paddle.digamma(a0)
+                - paddle.sum((a - 1.0) * paddle.digamma(a), axis=-1))
+
+
+class MultivariateNormal(Distribution):
+    """loc + covariance_matrix parameterization (reference
+    multivariate_normal.py; Cholesky internally)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = _t(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError("give exactly one of covariance_matrix / "
+                             "scale_tril")
+        if covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self._scale_tril = paddle.cholesky(self.covariance_matrix)
+        else:
+            self._scale_tril = _t(scale_tril)
+            self.covariance_matrix = paddle.matmul(
+                self._scale_tril, paddle.matrix_transpose(self._scale_tril))
+        event = tuple(self.loc.shape)[-1:]
+        batch = tuple(np.broadcast_shapes(
+            tuple(self.loc.shape)[:-1],
+            tuple(self.covariance_matrix.shape)[:-2]))
+        super().__init__(batch, event)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return paddle.diagonal(self.covariance_matrix, axis1=-2, axis2=-1)
+
+    @property
+    def stddev(self):
+        return paddle.sqrt(self.variance)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out = self._extend_shape(shape)
+        eps = paddle.randn(list(out))
+        return self.loc + paddle.squeeze(
+            paddle.matmul(self._scale_tril, paddle.unsqueeze(eps, -1)), -1)
+
+    def log_prob(self, value):
+        value = _t(value)
+        diff = value - self.loc
+        # solve L y = diff  => y = L^{-1} diff; maha = |y|^2
+        y = paddle.triangular_solve(self._scale_tril,
+                                    paddle.unsqueeze(diff, -1), upper=False)
+        maha = paddle.sum(paddle.square(paddle.squeeze(y, -1)), axis=-1)
+        half_logdet = paddle.sum(paddle.log(paddle.diagonal(
+            self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        k = float(self.event_shape[0])
+        return -0.5 * (k * _LOG_2PI + maha) - half_logdet
+
+    def entropy(self):
+        half_logdet = paddle.sum(paddle.log(paddle.diagonal(
+            self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        k = float(self.event_shape[0])
+        return 0.5 * k * (1.0 + _LOG_2PI) + half_logdet

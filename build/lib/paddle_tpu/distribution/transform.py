@@ -1,0 +1,321 @@
+"""Bijective transforms (reference python/paddle/distribution/transform.py:
+Transform base + Abs/Affine/Chain/Exp/Independent/Power/Reshape/Sigmoid/
+Softmax/Stack/StickBreaking/Tanh transforms)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from .distribution import _t
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+class Transform:
+    _event_dim = 0  # event dims consumed by one application
+
+    @property
+    def event_dim(self):
+        return self._event_dim
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(_t(x))
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * _t(x)
+
+    def inverse(self, y):
+        return (_t(y) - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return paddle.log(paddle.abs(self.scale)) * paddle.ones_like(_t(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return paddle.exp(_t(x))
+
+    def inverse(self, y):
+        return paddle.log(_t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return paddle.pow(_t(x), self.power)
+
+    def inverse(self, y):
+        return paddle.pow(_t(y), 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        return paddle.log(paddle.abs(self.power
+                                     * paddle.pow(x, self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return paddle.sigmoid(_t(x))
+
+    def inverse(self, y):
+        y = _t(y)
+        return paddle.log(y) - paddle.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        return -paddle.softplus(-x) - paddle.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return paddle.tanh(_t(x))
+
+    def inverse(self, y):
+        return paddle.atanh(_t(y))
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        return 2.0 * (math.log(2.0) - x - paddle.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    """Non-injective |x| (reference AbsTransform: inverse picks +branch)."""
+
+    def forward(self, x):
+        return paddle.abs(_t(x))
+
+    def inverse(self, y):
+        return _t(y)  # positive branch
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError("AbsTransform is not injective")
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    @property
+    def event_dim(self):
+        return max((t.event_dim for t in self.transforms), default=0)
+
+    def forward(self, x):
+        x = _t(x)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        y = _t(y)
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            # sum extra event dims down to this chain's event ndim
+            extra = self.event_dim - t.event_dim
+            for _ in range(extra):
+                ld = paddle.sum(ld, axis=-1)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Promote the rightmost `reinterpreted_batch_ndims` dims to event dims
+    (log-det sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    @property
+    def event_dim(self):
+        return self.base.event_dim + self.reinterpreted_batch_ndims
+
+    def forward(self, x):
+        return self.base.forward(_t(x))
+
+    def inverse(self, y):
+        return self.base.inverse(_t(y))
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(_t(x))
+        for _ in range(self.reinterpreted_batch_ndims):
+            ld = paddle.sum(ld, axis=-1)
+        return ld
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != \
+                int(np.prod(self.out_event_shape)):
+            raise ValueError("in/out event sizes differ")
+
+    @property
+    def event_dim(self):
+        return len(self.in_event_shape)
+
+    def forward(self, x):
+        x = _t(x)
+        batch = tuple(x.shape)[: len(tuple(x.shape))
+                               - len(self.in_event_shape)]
+        return paddle.reshape(x, list(batch + self.out_event_shape))
+
+    def inverse(self, y):
+        y = _t(y)
+        batch = tuple(y.shape)[: len(tuple(y.shape))
+                               - len(self.out_event_shape)]
+        return paddle.reshape(y, list(batch + self.in_event_shape))
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        batch = tuple(x.shape)[: len(tuple(x.shape))
+                               - len(self.in_event_shape)]
+        return paddle.zeros(list(batch))
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape)[:-n] + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape)[:-n] + self.in_event_shape
+
+
+class SoftmaxTransform(Transform):
+    """x -> softmax(x); not bijective (inverse = log, normalized)."""
+
+    _event_dim = 1
+
+    def forward(self, x):
+        return paddle.softmax(_t(x), axis=-1)
+
+    def inverse(self, y):
+        y = paddle.log(_t(y))
+        return y - paddle.mean(y, axis=-1, keepdim=True)
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform is not injective")
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] along slice i of `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fn_name, x):
+        parts = paddle.unstack(x, axis=self.axis)
+        outs = [getattr(t, fn_name)(p)
+                for t, p in zip(self.transforms, parts)]
+        return paddle.stack(outs, axis=self.axis)
+
+    def forward(self, x):
+        return self._map("forward", _t(x))
+
+    def inverse(self, y):
+        return self._map("inverse", _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", _t(x))
+
+
+class StickBreakingTransform(Transform):
+    """R^K -> (K+1)-simplex via stick breaking (reference
+    StickBreakingTransform)."""
+
+    _event_dim = 1
+
+    def forward(self, x):
+        x = _t(x)
+        k = tuple(x.shape)[-1]
+        offset = paddle.arange(k, 0, -1).astype(x.dtype)
+        z = paddle.sigmoid(x - paddle.log(offset))
+        z_cumprod = paddle.cumprod(1.0 - z, dim=-1)
+        lead = paddle.ones_like(z[..., :1])
+        pad_cum = paddle.concat([lead, z_cumprod], axis=-1)
+        pad_z = paddle.concat([z, paddle.ones_like(z[..., :1])], axis=-1)
+        return pad_z * pad_cum
+
+    def inverse(self, y):
+        y = _t(y)
+        y_crop = y[..., :-1]
+        # remaining stick before breaking piece k: 1 - sum_{i<k} y_i
+        remain = 1.0 - paddle.cumsum(y_crop, axis=-1) + y_crop
+        k = tuple(y_crop.shape)[-1]
+        offset = paddle.arange(k, 0, -1).astype(y.dtype)
+        z = y_crop / remain
+        return paddle.log(z) - paddle.log1p(-z) + paddle.log(offset)
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        k = tuple(x.shape)[-1]
+        offset = paddle.arange(k, 0, -1).astype(x.dtype)
+        t = x - paddle.log(offset)
+        z = paddle.sigmoid(t)
+        # log|dy/dx| = sum log z_k + log(1-z_k) cumulated stick
+        log_stick = paddle.cumsum(paddle.log1p(-z), axis=-1)
+        lead = paddle.zeros_like(log_stick[..., :1])
+        prev_stick = paddle.concat([lead, log_stick[..., :-1]], axis=-1)
+        return paddle.sum(paddle.logsigmoid(t) + paddle.logsigmoid(-t)
+                          + prev_stick, axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape)[:-1] + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)[:-1] + (shape[-1] - 1,)

@@ -1,0 +1,22 @@
+"""paddle.fft namespace (reference python/paddle/fft.py)."""
+
+from .ops.dispatcher import get_op as _get_op
+
+fft = _get_op("fft")
+ifft = _get_op("ifft")
+rfft = _get_op("rfft")
+irfft = _get_op("irfft")
+hfft = _get_op("hfft")
+ihfft = _get_op("ihfft")
+fft2 = _get_op("fft2")
+ifft2 = _get_op("ifft2")
+rfft2 = _get_op("rfft2")
+irfft2 = _get_op("irfft2")
+fftn = _get_op("fftn")
+ifftn = _get_op("ifftn")
+fftshift = _get_op("fftshift")
+ifftshift = _get_op("ifftshift")
+fftfreq = _get_op("fftfreq")
+rfftfreq = _get_op("rfftfreq")
+
+__all__ = [n for n in dir() if not n.startswith("_")]

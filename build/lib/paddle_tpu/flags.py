@@ -1,0 +1,116 @@
+"""Process-wide runtime flag registry.
+
+TPU-native analog of the reference's exported-flags system
+(paddle/common/flags.cc:31 `PHI_DEFINE_EXPORTED_*`, ~135 flags with `FLAGS_*`
+env override, surfaced to Python via `paddle.set_flags`/`get_flags`).
+
+The registry is dual-homed: the Python dict is authoritative for the eager
+layer, and every definition/mutation is mirrored into the native C++ registry
+(csrc/flags.cc, bound via paddle_tpu.native) once that library loads, so C++
+runtime components read the same flags. Flags may be seeded from the
+environment (`FLAGS_<name>=...`) and mutated at runtime via :func:`set_flags`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    ctype: type
+    value: Any = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_NATIVE = None  # ctypes lib once paddle_tpu.native loads
+
+
+def _mirror_one(lib, f: "_Flag") -> None:
+    ctype_name = {bool: "bool", int: "int", float: "double"}.get(
+        f.ctype, "string")
+    lib.PT_RegisterFlag(f.name.encode(), ctype_name.encode(),
+                        str(f.default).encode(), f.help.encode())
+    lib.PT_SetFlag(f.name.encode(), str(f.value).encode())
+
+
+def _mirror_native(lib):
+    global _NATIVE
+    _NATIVE = lib
+    for f in _REGISTRY.values():
+        _mirror_one(lib, f)
+
+
+def _parse_env(raw: str, ctype: type) -> Any:
+    if ctype is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ctype(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    """Register a flag; environment variable ``FLAGS_<name>`` overrides default."""
+    ctype = type(default)
+    value = default
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        value = _parse_env(env, ctype)
+    _REGISTRY[name] = _Flag(name, default, help, ctype, value)
+    if _NATIVE is not None:
+        _mirror_one(_NATIVE, _REGISTRY[name])
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        n = n.removeprefix("FLAGS_")
+        if n not in _REGISTRY:
+            raise ValueError(f"unknown flag: {n}")
+        out["FLAGS_" + n] = _REGISTRY[n].value
+    return out
+
+
+def get_flag(name: str) -> Any:
+    return _REGISTRY[name.removeprefix("FLAGS_")].value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for k, v in flags.items():
+        k = k.removeprefix("FLAGS_")
+        if k not in _REGISTRY:
+            raise ValueError(f"unknown flag: {k}")
+        f = _REGISTRY[k]
+        if isinstance(v, f.ctype):
+            f.value = v
+        elif isinstance(v, str):
+            f.value = _parse_env(v, f.ctype)  # 'false'/'0' must not read True
+        else:
+            f.value = f.ctype(v)
+        if _NATIVE is not None:
+            _NATIVE.PT_SetFlag(k.encode(), str(f.value).encode())
+
+
+# -- Core flags (subset mirroring paddle/common/flags.cc) ---------------------
+define_flag("check_nan_inf", False, "check every op output for NaN/Inf (eager)")
+define_flag("eager_op_jit", True, "jit-compile each eager op (per-op XLA cache)")
+define_flag("use_pallas_kernels", True, "route hot ops to Pallas hand kernels")
+define_flag("benchmark", False, "block on every op for accurate timing")
+define_flag("comm_timeout_s", 600.0,
+            "eager collective / train-step watchdog timeout (seconds); the "
+            "FLAGS_nccl_blocking_wait analog for DCN stalls")
+define_flag("low_precision_op_list", 0, "log ops run in low precision under AMP")
+define_flag("default_dtype", "float32", "default floating-point dtype")
+define_flag("seed", 0, "global random seed")
+
+
+# Mirror into the native C++ registry (csrc/flags.cc) once it loads; until
+# then the Python dict is the sole home (no toolchain required to import).
+from .native import on_load as _native_on_load  # noqa: E402
+
+_native_on_load(_mirror_native)

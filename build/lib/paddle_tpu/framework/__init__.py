@@ -1,0 +1,63 @@
+"""Framework-level utilities: save/load (reference python/paddle/framework/
+io.py:721 paddle.save, :960 paddle.load — pickled state dicts)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_host(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    try:
+        import jax
+        if isinstance(obj, jax.Array):
+            return _TensorPayload(np.asarray(obj))
+    except ImportError:
+        pass
+    return obj
+
+
+class _TensorPayload:
+    """Marks arrays that were device tensors so load() restores Tensor."""
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+
+
+def _from_host(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _from_host(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_host(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    """paddle.save: pickles a (nested) state structure; device tensors are
+    pulled to host numpy."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_host(obj, return_numpy)

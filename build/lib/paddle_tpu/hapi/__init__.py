@@ -1,0 +1,6 @@
+"""High-level API (reference python/paddle/hapi): Model.fit + callbacks."""
+
+from . import callbacks  # noqa: F401
+from .model import Model, summary  # noqa: F401
+
+__all__ = ["Model", "summary", "callbacks"]

@@ -1,0 +1,397 @@
+"""High-level Model API (reference python/paddle/hapi/model.py:1051 —
+Model.prepare/fit/evaluate/predict/save/load/summary).
+
+TPU-native notes: the train/eval batch paths run through the eager engine
+(jit-per-op XLA); `prepare(..., jit=True)` additionally compiles the whole
+train step into one donated XLA program via jit.TrainStep — the analog of
+the reference's `Model` static-graph mode, minus the separate Program
+world.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric import Metric
+from ..nn.layer_base import Layer
+from . import callbacks as cbks_mod
+
+__all__ = ["Model", "summary"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+class Model:
+    """Network wrapper with train/eval/predict loops (reference Model)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._optimizer = None
+        self._train_step = None   # compiled TrainStep when jit=True
+        self._jit = False
+        self.stop_training = False
+
+    # ------------------------------------------------------------------ mode
+    @property
+    def mode(self):
+        return "train" if self.network.training else "eval"
+
+    def train(self):
+        self.network.train()
+
+    def eval(self):
+        self.network.eval()
+
+    # --------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit=False):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer)
+                                     or callable(loss)):
+            raise TypeError("loss must be a Layer or a callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle_tpu.metric."
+                                f"Metric")
+        self._jit = bool(jit)
+        if amp_configs not in (None, "O0", False):
+            self._amp_level = amp_configs if isinstance(amp_configs, str) \
+                else amp_configs.get("level", "O1")
+        else:
+            self._amp_level = None
+        return self
+
+    def _loss_value(self, outputs, labels):
+        loss = self._loss(*outputs, *labels)
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0]
+        return loss
+
+    # ----------------------------------------------------------- batch steps
+    def train_batch(self, inputs, labels=None, update=True):
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) before train_batch"
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+
+        if self._jit and update:
+            if self._train_step is None:
+                from ..jit.api import TrainStep
+
+                def _scalar_loss(*args):
+                    loss = self._loss(*args)
+                    if isinstance(loss, (list, tuple)):
+                        loss = loss[0]
+                    return loss
+
+                self._train_step = TrainStep(self.network, _scalar_loss,
+                                             self._optimizer,
+                                             amp_level=self._amp_level)
+            loss = self._train_step(tuple(inputs), tuple(labels))
+            lv = float(loss._data if isinstance(loss, Tensor) else loss)
+            if not self._metrics:
+                return self._with_metric_results(None, labels, [lv])
+            # metrics need network outputs, which the compiled step does not
+            # expose — pay one extra no-grad forward for them, in eval mode
+            # so BatchNorm stats / dropout are not perturbed a second time
+            from ..autograd.engine import no_grad
+            self.network.eval()
+            try:
+                with no_grad():
+                    outputs = _to_list(self.network(*inputs))
+            finally:
+                self.network.train()
+            return self._with_metric_results(outputs, labels, [lv])
+
+        if not update:  # loss/metrics only, no parameter change
+            from ..autograd.engine import no_grad
+            with no_grad():
+                outputs = _to_list(self.network(*inputs))
+                loss = self._loss_value(outputs, labels)
+            return self._with_metric_results(outputs, labels,
+                                             [float(np.asarray(loss._data))])
+
+        outputs = self._forward_amp(inputs)
+        loss = self._loss_value(outputs, labels)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return self._with_metric_results(outputs, labels,
+                                         [float(np.asarray(loss._data))])
+
+    def _forward_amp(self, inputs):
+        if self._amp_level:
+            from .. import amp as amp_mod
+            with amp_mod.auto_cast(level=self._amp_level):
+                return _to_list(self.network(*inputs))
+        return _to_list(self.network(*inputs))
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        from ..autograd.engine import no_grad
+        with no_grad():
+            outputs = self._forward_amp(inputs)
+            metrics = []
+            if self._loss is not None and labels:
+                loss = self._loss_value(outputs, labels)
+                metrics.append(float(np.asarray(loss._data)))
+        return self._with_metric_results(outputs, labels, metrics)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        from ..autograd.engine import no_grad
+        with no_grad():
+            outputs = _to_list(self.network(*inputs))
+        return [np.asarray(o._data) for o in outputs]
+
+    def _with_metric_results(self, outputs, labels, losses):
+        if outputs is None:
+            return losses if len(losses) != 1 else losses[0]
+        metric_vals = []
+        for m in self._metrics:
+            computed = m.compute(*outputs, *labels)
+            r = m.update(*_to_list(computed))
+            metric_vals.append(r)
+        if metric_vals:
+            return losses, metric_vals
+        return losses if len(losses) != 1 else losses[0]
+
+    # ------------------------------------------------------------- data prep
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        from ..io import DataLoader, Dataset, IterableDataset
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, (Dataset, IterableDataset)):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split_batch(batch, n_labels):
+        batch = _to_list(batch)
+        if n_labels and len(batch) > n_labels:
+            return batch[:-n_labels], batch[-n_labels:]
+        if len(batch) >= 2:
+            return batch[:-1], batch[-1:]
+        return batch, []
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        assert train_data is not None, "train_data must be given"
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers, False)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        metric_names = ["loss"] + [n for m in self._metrics
+                                   for n in _to_list(m.name())]
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=metric_names)
+        self.stop_training = False
+        cbks.on_train_begin()
+        n_labels = len(self._labels)
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbs = self._split_batch(batch, n_labels)
+                res = self.train_batch(ins, lbs)
+                logs = self._update_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbks, n_labels)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return self
+
+    def _update_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple) and len(res) == 2 \
+                and isinstance(res[0], list):
+            losses, metric_vals = res
+            logs["loss"] = losses[0] if losses else None
+            for m, v in zip(self._metrics, metric_vals):
+                names = _to_list(m.name())
+                vals = _to_list(m.accumulate())
+                for n, vv in zip(names, vals):
+                    logs[n] = vv
+        elif isinstance(res, list):
+            if res:
+                logs["loss"] = res[0]
+        else:
+            logs["loss"] = res
+        return logs
+
+    def _run_eval(self, eval_loader, cbks, n_labels):
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        loss_sum, loss_n = 0.0, 0
+        for step, batch in enumerate(eval_loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbs = self._split_batch(batch, n_labels)
+            res = self.eval_batch(ins, lbs)
+            logs = self._update_logs(res)
+            if "loss" in logs:
+                loss_sum += logs["loss"]
+                loss_n += 1
+            cbks.on_eval_batch_end(step, logs)
+        if loss_n:  # epoch-mean loss, not last-batch (monitored by
+            logs["loss"] = loss_sum / loss_n  # EarlyStopping/ReduceLR)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers,
+                                   False)
+        metric_names = ["loss"] + [n for m in self._metrics
+                                   for n in _to_list(m.name())]
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=metric_names, mode="eval",
+            steps=len(loader) if hasattr(loader, "__len__") else None)
+        return self._run_eval(loader, cbks, len(self._labels))
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers,
+                                   False)
+        cbks = cbks_mod.config_callbacks(callbacks, model=self,
+                                         verbose=verbose, mode="predict")
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            ins = _to_list(batch)
+            # predict data may still carry labels: keep declared inputs if
+            # specs were given, else trim to the network's positional arity
+            if self._inputs:
+                ins = ins[:len(self._inputs)]
+            elif self._labels:
+                ins, _ = self._split_batch(batch, len(self._labels))
+            else:
+                ins = ins[:self._forward_arity(len(ins))]
+            out = self.predict_batch(ins)
+            outputs.append(out)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end()
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([b[i] for b in outputs], axis=0)
+                    for i in range(n_out)]
+        return outputs
+
+    def _forward_arity(self, have: int) -> int:
+        """How many of `have` batch elements the network's forward can
+        take positionally (*args -> all of them)."""
+        import inspect
+        try:
+            sig = inspect.signature(self.network.forward)
+        except (TypeError, ValueError):
+            return have
+        n = 0
+        for p in sig.parameters.values():
+            if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                return have
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                n += 1
+        return min(have, n)
+
+    # ------------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        from ..framework import save as fsave
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import load as fload
+        params = fload(path + ".pdparams")
+        if skip_mismatch:
+            own = self.network.state_dict()
+            params = {k: v for k, v in params.items()
+                      if k in own and tuple(np.shape(v)) ==
+                      tuple(own[k].shape)}
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+
+def summary(net: Layer, input_size=None, dtype=None):
+    """Layer-by-layer parameter summary (reference hapi/model_summary.py).
+    Returns {'total_params': N, 'trainable_params': N} and prints a table.
+    """
+    rows = []
+    total, trainable = 0, 0
+    for name, sub in net.named_sublayers(include_self=True):
+        own = [p for p in sub.parameters(include_sublayers=False)]
+        if not own:
+            continue
+        n = sum(int(np.prod(p.shape)) for p in own)
+        t = sum(int(np.prod(p.shape)) for p in own if not p.stop_gradient)
+        rows.append((name or sub.__class__.__name__,
+                     sub.__class__.__name__, n))
+        total += n
+        trainable += t
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    print(f"{'Layer':<{width}}{'Type':<24}{'Params':>12}")
+    print("-" * (width + 36))
+    for name, typ, n in rows:
+        print(f"{name:<{width}}{typ:<24}{n:>12,}")
+    print("-" * (width + 36))
+    print(f"Total params: {total:,}  Trainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

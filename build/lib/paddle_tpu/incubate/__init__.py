@@ -1,0 +1,9 @@
+"""paddle_tpu.incubate — experimental/advanced APIs (SURVEY §2.6: fused
+transformer layers, ASP sparsity, LookAhead, autotune)."""
+
+from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
+from . import nn  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["nn", "asp", "autotune", "LookAhead", "ModelAverage"]

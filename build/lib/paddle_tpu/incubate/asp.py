@@ -1,0 +1,86 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp (ASPHelper, prune_model,
+decorate): magnitude-based 2:4 pruning masks applied to weight matrices,
+re-applied after every optimizer step so pruned entries stay zero.
+
+TPU note: n:m sparsity has no MXU speedup today; the value is model
+compression research parity. Masking is a multiply — XLA fuses it into the
+consumer matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+_masks: Dict[int, jnp.ndarray] = {}  # id(param) -> mask
+
+
+def compute_nm_mask(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-|w| of every m consecutive elements (last dim)."""
+    shape = w.shape
+    flat = np.abs(w.reshape(-1, m))
+    order = np.argsort(-flat, axis=1)
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, order[:, :n], True, axis=1)
+    return mask.reshape(shape)
+
+
+def check_sparsity(w: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    """True if every m-block of w has at most n nonzeros."""
+    if w.size % m:
+        return False
+    blocks = w.reshape(-1, m)
+    return bool(((blocks != 0).sum(axis=1) <= n).all())
+
+
+def calculate_density(w: np.ndarray) -> float:
+    return float((np.asarray(w) != 0).mean())
+
+
+def _prunable(name: str, param: Tensor) -> bool:
+    return param.ndim == 2 and param.shape[-1] % 4 == 0 and \
+        "bias" not in name
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d") -> Dict[str, float]:
+    """Apply n:m masks to all prunable weights in place; remember the masks
+    so `decorate`d optimizers re-apply them after each step."""
+    report = {}
+    for name, param in model.named_parameters():
+        if not _prunable(name, param):
+            continue
+        w = np.asarray(param.numpy())
+        mask = compute_nm_mask(w, n, m)
+        param._set_data(jnp.asarray(w * mask))
+        _masks[id(param)] = jnp.asarray(mask, dtype=param._data.dtype)
+        report[name] = calculate_density(w * mask)
+    return report
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-mask pruned params after the update
+    (reference ASPHelper.decorate → OptimizerWithSparsityGuarantee)."""
+    inner_step = optimizer.step
+
+    def masked_step(*args, **kwargs):
+        out = inner_step(*args, **kwargs)
+        for p in optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._set_data(p._data * mask)
+        return out
+
+    optimizer.step = masked_step
+    return optimizer
+
+
+def reset_excluded_layers(model: Optional[Layer] = None):
+    _masks.clear()

@@ -1,0 +1,41 @@
+"""Autotune config facade (reference python/paddle/incubate/autotune.py
+set_config + phi/kernels/autotune/switch_autotune.cc).
+
+On TPU the kernel-algo search the reference caches (cuDNN algos, transpose
+schedules) is owned by XLA's autotuner; this facade keeps the API and wires
+the knobs that do exist here: Pallas-kernel routing and dataloader tuning.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from .. import flags
+
+_config: Dict[str, Dict[str, Any]] = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False, "tuning_steps": 500},
+}
+
+
+def set_config(config: Optional[Dict[str, Any]] = None) -> None:
+    """paddle.incubate.autotune.set_config parity; `config` may also be a
+    path to a JSON file (reference behavior)."""
+    if config is None:
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for key, val in config.items():
+        if key not in _config:
+            raise ValueError(f"unknown autotune domain '{key}' "
+                             f"(have {sorted(_config)})")
+        _config[key].update(val)
+    # kernel autotuning toggles the Pallas hand-kernel routing
+    flags.set_flags({"use_pallas_kernels": bool(_config["kernel"]["enable"])})
+
+
+def get_config() -> Dict[str, Dict[str, Any]]:
+    return {k: dict(v) for k, v in _config.items()}

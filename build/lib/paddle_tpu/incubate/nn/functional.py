@@ -1,0 +1,77 @@
+"""incubate.nn.functional — fused-op functional API (reference
+python/paddle/incubate/nn/functional: fused_layer_norm, fused_rms_norm,
+fused_rotary_position_embedding, fused_dropout_add, swiglu, ... binding the
+phi/kernels/fusion/gpu kernels).
+
+TPU: the "fusion" is XLA's; these wrappers route to the same registered ops
+the layers use (rms_norm/rope are Pallas-capable) and exist for source-level
+parity with reference code."""
+
+from __future__ import annotations
+
+from ...ops.dispatcher import call_op
+
+__all__ = [
+    "fused_layer_norm", "fused_rms_norm",
+    "fused_rotary_position_embedding", "fused_dropout_add", "swiglu",
+    "fused_linear", "fused_bias_act",
+]
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     residual_alpha=1.0, begin_norm_axis=1, **kwargs):
+    """Signature order matches the reference fused_layer_norm (..., epsilon,
+    residual_alpha, begin_norm_axis) so positionally-ported calls bind
+    correctly; residual_alpha only matters with the residual input the
+    reference fuses (not modeled here — XLA fuses the add anyway)."""
+    return call_op("layer_norm", x, norm_weight, norm_bias, epsilon=epsilon,
+                   begin_norm_axis=begin_norm_axis)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """Reference signature (x, norm_weight, norm_bias, epsilon,
+    begin_norm_axis, ...) — all forwarded to the rms_norm kernel."""
+    return call_op("rms_norm", x, norm_weight, norm_bias, epsilon=epsilon,
+                   begin_norm_axis=begin_norm_axis)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """reference fused_rope: applies rotary embedding to each of q/k/v that
+    is passed (the reference rotates v too when given)."""
+    out = call_op("rope", q, k, cos=cos, sin=sin, position_ids=position_ids,
+                  rotate_half_style=use_neox_rotary_style)
+    q_out, k_out = out if isinstance(out, (list, tuple)) else (out, None)
+    v_out = None
+    if v is not None:
+        v_out = call_op("rope", v, None, cos=cos, sin=sin,
+                        position_ids=position_ids,
+                        rotate_half_style=use_neox_rotary_style)
+    return q_out, k_out, v_out
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    """dropout(x) + y in one graph (fused_dropout_add_kernel)."""
+    return call_op("dropout", x, p=p, training=training, mode=mode) + y
+
+
+def swiglu(x, y=None):
+    """reference phi swiglu: silu(x) * y (y defaults to the second half of
+    x's last dim)."""
+    if y is None:
+        x, y = call_op("chunk", x, chunks=2, axis=-1)
+    return call_op("swiglu", x, y)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    if transpose_weight:
+        weight = call_op("transpose", weight, perm=[1, 0])
+    return call_op("linear", x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu"):
+    if bias is not None:
+        x = x + bias
+    return call_op(act_method, x)

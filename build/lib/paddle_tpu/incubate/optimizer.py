@@ -1,0 +1,94 @@
+"""Incubate optimizers: LookAhead, ModelAverage (reference
+python/paddle/incubate/optimizer/lookahead.py, modelaverage.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class LookAhead:
+    """k-step lookahead wrapper: slow weights interpolate toward the fast
+    optimizer's weights every k steps (reference lookahead.py LookAhead)."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step_num = 0
+        self._slow: Dict[int, jnp.ndarray] = {}
+        self._parameter_list = inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        for p in self._parameter_list:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                # copy: the inner optimizer's jitted step donates the param
+                # buffer, so a bare reference would go stale next step
+                slow = jnp.copy(p._data)  # first sync: slow = fast
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            # distinct buffer for the param: the next inner step donates
+            # p._data, which must never alias our retained slow copy
+            p._set_data(jnp.copy(slow))
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running average of parameters applied at eval time (reference
+    modelaverage.py): sums params each step; apply()/restore() swap the
+    averaged weights in and out."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters: Optional[List[Tensor]] = None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000):
+        self.params = list(parameters or [])
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.average_window_rate = average_window_rate
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._count = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+
+    def step(self):
+        self._count += 1
+        for p in self.params:
+            acc = self._sum.get(id(p))
+            self._sum[id(p)] = (jnp.copy(p._data) if acc is None
+                                else acc + p._data)  # copy: donation safety
+
+    def apply(self, need_restore: bool = True):
+        if self._count == 0:
+            return
+        for p in self.params:
+            if need_restore:
+                self._backup[id(p)] = jnp.copy(p._data)
+            p._set_data(self._sum[id(p)] / self._count)
+
+    def restore(self):
+        for p in self.params:
+            saved = self._backup.pop(id(p), None)
+            if saved is not None:
+                p._set_data(saved)

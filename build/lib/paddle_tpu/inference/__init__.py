@@ -1,0 +1,190 @@
+"""paddle_tpu.inference — the deployment predictor API (SURVEY §2.8).
+
+Reference: paddle/fluid/inference AnalysisPredictor
+(api/analysis_predictor.h:100 — load .pdmodel/.pdiparams → IR passes →
+executor; ZeroCopyRun at analysis_predictor.cc:2322) with its Python wrapper
+paddle.inference.{Config, create_predictor}.
+
+TPU-native: the saved program (static.save_inference_model artifact) replays
+under one jax.jit — XLA's pass pipeline IS the analysis/optimization stage
+(fusion, layout, memory planning). Input/output handles hold device buffers
+(ZeroCopy semantics); `Predictor.export_compiled` serializes the lowered
+StableHLO (jax.export) as the AOT executable bundle.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import static as static_mod
+from ..static.executor import _replay
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor"]
+
+
+class Config:
+    """AnalysisConfig parity (api/paddle_analysis_config.h)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accept either a path prefix or explicit .pdmodel/.pdiparams pair
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_prefix = prog_file
+        self.params_file = params_file
+        self._memory_optim = True
+        self._ir_optim = True
+        self.device = "tpu"
+        self._threads = 1
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.model_prefix = prog_file.removesuffix(".pdmodel")
+        self.params_file = params_file
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._threads = n
+
+    def disable_gpu(self):
+        self.device = "cpu"
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self.device = "tpu"  # accelerator path (TPU here)
+
+
+class Tensor:
+    """ZeroCopy input/output handle: owns a device buffer."""
+
+    def __init__(self, name: str, predictor: "Predictor", is_input: bool):
+        self.name = name
+        self._predictor = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, data: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError(f"'{self.name}' is an output handle")
+        self._predictor._inputs[self.name] = jnp.asarray(np.asarray(data))
+
+    def copy_to_cpu(self) -> np.ndarray:
+        out = self._predictor._outputs.get(self.name)
+        if out is None:
+            raise RuntimeError(f"output '{self.name}' not produced; call "
+                               f"run() first")
+        return np.asarray(out)
+
+    def shape(self) -> List[int]:
+        arr = (self._predictor._inputs if self._is_input
+               else self._predictor._outputs).get(self.name)
+        return list(arr.shape) if arr is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self.config = config
+        exe = static_mod.Executor()
+        program, feeds, fetches = static_mod.load_inference_model(
+            config.model_prefix, exe)
+        self._program = program
+        self._feed_names = feeds
+        self._fetch_names = fetches
+        self._params = {p.name: exe.scope.vars[p.name]
+                        for p in program.parameters()
+                        if exe.scope.var(p.name) is not None}
+        self._inputs: Dict[str, jax.Array] = {}
+        self._outputs: Dict[str, jax.Array] = {}
+        self._compiled: Dict[Tuple, Any] = {}
+
+    # -- handle API (AnalysisPredictor::GetInputHandle etc.) -----------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        if name not in self._feed_names:
+            raise KeyError(f"no input '{name}' (have {self._feed_names})")
+        return Tensor(name, self, is_input=True)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        if name not in self._fetch_names:
+            raise KeyError(f"no output '{name}' (have {self._fetch_names})")
+        return Tensor(name, self, is_input=False)
+
+    # -- execution -----------------------------------------------------------
+    def _get_compiled(self, shapes_key: Tuple):
+        fn = self._compiled.get(shapes_key)
+        if fn is None:
+            feed_names = tuple(self._feed_names)
+            param_items = tuple(sorted(self._params.items()))
+            fetch_names = tuple(self._fetch_names)
+            program = self._program
+
+            def run_fn(feed_vals):
+                env = dict(zip(feed_names, feed_vals))
+                env.update(param_items)
+                env = _replay(program, env, jax.random.key(0))
+                return [env[n] for n in fetch_names]
+
+            fn = jax.jit(run_fn)
+            self._compiled[shapes_key] = fn
+        return fn
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None
+            ) -> Optional[List[np.ndarray]]:
+        """ZeroCopyRun (handles) or the list-in/list-out convenience form."""
+        direct = inputs is not None
+        if direct:
+            for n, arr in zip(self._feed_names, inputs):
+                self._inputs[n] = jnp.asarray(np.asarray(arr))
+        missing = [n for n in self._feed_names if n not in self._inputs]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        feed_vals = [self._inputs[n] for n in self._feed_names]
+        key = tuple((a.shape, str(a.dtype)) for a in feed_vals)
+        outs = self._get_compiled(key)(feed_vals)
+        self._outputs = dict(zip(self._fetch_names, outs))
+        if direct:
+            return [np.asarray(o) for o in outs]
+        return None
+
+    # -- AOT bundle ----------------------------------------------------------
+    def export_compiled(self, path: str,
+                        example_inputs: List[np.ndarray]) -> str:
+        """Serialize the lowered StableHLO executable for this input
+        signature (jax.export) — the AOT artifact an embedding C++ runtime
+        loads through PJRT (reference analog: the TensorRT-engine cache)."""
+        from jax import export as jax_export
+        feed_vals = [jnp.asarray(np.asarray(a)) for a in example_inputs]
+        key = tuple((a.shape, str(a.dtype)) for a in feed_vals)
+        # jit of list-arg fn: wrap to positional for export stability
+        fn = self._get_compiled(key)
+        exported = jax_export.export(fn)(feed_vals)
+        blob = exported.serialize()
+        with open(path, "wb") as f:
+            f.write(blob)
+        return path
+
+    @staticmethod
+    def load_compiled(path: str):
+        """Returns a callable running the serialized executable."""
+        from jax import export as jax_export
+        with open(path, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        return lambda feed_vals: exported.call(
+            [jnp.asarray(np.asarray(a)) for a in feed_vals])
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

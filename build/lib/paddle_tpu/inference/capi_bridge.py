@@ -1,0 +1,115 @@
+"""Bridge module for the C inference API (csrc/inference_capi.cc).
+
+Reference counterpart: `paddle/fluid/inference/capi_exp/` — the C ABI over
+AnalysisPredictor (survey §2.8 stance: "C API only"). The C library embeds
+CPython and calls these functions; handles are plain ints so the C side
+never owns PyObject lifetimes. All array data crosses as raw bytes +
+shape/dtype metadata.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import numpy as np
+
+_registry: Dict[int, object] = {}
+_next_id = [1]
+_lock = threading.Lock()
+_last_error = [""]
+
+
+def _set_err(msg: str) -> int:
+    _last_error[0] = str(msg)
+    return -1
+
+
+def last_error() -> str:
+    return _last_error[0]
+
+
+def create(prog_file: str, params_file: str = "") -> int:
+    try:
+        from . import Config, Predictor
+        cfg = Config(prog_file, params_file or None)
+        pred = Predictor(cfg)
+        with _lock:
+            h = _next_id[0]
+            _next_id[0] += 1
+            _registry[h] = {"pred": pred, "outputs": {}}
+        return h
+    except Exception as e:  # noqa: BLE001 — C boundary: stringify everything
+        return _set_err(e)
+
+
+def destroy(h: int) -> int:
+    with _lock:
+        _registry.pop(h, None)
+    return 0
+
+
+def input_names(h: int) -> str:
+    try:
+        return ";".join(_registry[h]["pred"].get_input_names())
+    except Exception as e:
+        _set_err(e)
+        return ""
+
+
+def output_names(h: int) -> str:
+    try:
+        return ";".join(_registry[h]["pred"].get_output_names())
+    except Exception as e:
+        _set_err(e)
+        return ""
+
+
+def set_input(h: int, name: str, shape_csv: str, dtype: str,
+              data: bytes) -> int:
+    try:
+        shape = tuple(int(s) for s in shape_csv.split(",") if s != "")
+        arr = np.frombuffer(data, dtype=np.dtype(dtype)).reshape(shape)
+        _registry[h]["pred"].get_input_handle(name).copy_from_cpu(arr)
+        return 0
+    except Exception as e:
+        return _set_err(e)
+
+
+def run(h: int) -> int:
+    try:
+        entry = _registry[h]
+        entry["pred"].run()
+        entry["outputs"].clear()
+        return 0
+    except Exception as e:
+        return _set_err(e)
+
+
+def _output_array(h: int, name: str) -> np.ndarray:
+    entry = _registry[h]
+    if name not in entry["outputs"]:
+        out = entry["pred"].get_output_handle(name).copy_to_cpu()
+        entry["outputs"][name] = np.ascontiguousarray(out)
+    return entry["outputs"][name]
+
+
+def output_meta(h: int, name: str) -> str:
+    """'dtype|nbytes|d0,d1,...' or '' on error."""
+    try:
+        a = _output_array(h, name)
+        return f"{a.dtype.name}|{a.nbytes}|" + \
+            ",".join(str(d) for d in a.shape)
+    except Exception as e:
+        _set_err(e)
+        return ""
+
+
+def output_bytes(h: int, name: str):
+    """Raw output buffer, or None on error (a legitimately empty output is
+    b'' — the C side maps None to rc -1 so the two are distinguishable)."""
+    try:
+        return _output_array(h, name).tobytes()
+    except Exception as e:
+        _set_err(e)
+        return None

@@ -1,0 +1,426 @@
+"""paddle.io: datasets, samplers, DataLoader.
+
+Reference: python/paddle/io (reader.py:216 DataLoader → C++ blocking queue,
+multiprocess workers in io/dataloader/dataloader_iter.py:201).
+
+TPU-native: the loader produces host numpy batches; transfer overlaps with
+compute via a background prefetch thread feeding a bounded queue (the
+blocking-queue analog). `num_workers > 0` spawns real worker PROCESSES
+(the `_DataLoaderIterMultiProcess` analog): index batches fan out over
+per-worker queues, collated numpy batches come back on a shared result
+queue and are reassembled in order — Python-heavy transforms escape the
+GIL. `persistent_workers=True` keeps the pool alive across epochs.
+IterableDataset keeps the thread path (a process pool would duplicate the
+stream; the reference splits via worker_info, which map-style covers here).
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing as mp
+import os
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import generator
+from ..core.tensor import Tensor
+
+
+class Dataset:
+    """Map-style dataset (reference io/dataset.py)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset is not subscriptable")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        arrays = [t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+                  for t in tensors]
+        n = arrays[0].shape[0]
+        assert all(a.shape[0] == n for a in arrays)
+        self.arrays = arrays
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.arrays)
+
+    def __len__(self):
+        return self.arrays[0].shape[0]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset, self.indices = dataset, list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    n = len(dataset)
+    if sum(lengths) != n:
+        raise ValueError("sum of lengths must equal dataset size")
+    perm = np.random.permutation(n)
+    out, ofs = [], 0
+    for L in lengths:
+        out.append(Subset(dataset, perm[ofs:ofs + L].tolist()))
+        ofs += L
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(np.random.randint(0, n, self.num_samples).tolist())
+        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class DistributedBatchSampler(Sampler):
+    """Shards batches across data-parallel ranks (reference
+    io/dataloader/batch_sampler.py DistributedBatchSampler). On the GSPMD
+    path a single process feeds the global batch, so rank/nranks default to
+    the trivial (0, 1); multi-host input pipelines set them per host."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        super().__init__(dataset)
+        from ..distributed import env as dist_env
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else dist_env.get_world_size()
+        self.local_rank = rank if rank is not None else dist_env.get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.data_source)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(indices)
+        step = self.batch_size * self.nranks
+        if self.drop_last:
+            indices = indices[: (n // step) * step]  # equal batches per rank
+        else:
+            total = int(np.ceil(n / step)) * step
+            pad = total - n
+            if pad:
+                indices = np.concatenate([indices, indices[:pad]])
+        shard = indices[self.local_rank::self.nranks]
+        for i in range(0, len(shard) - self.batch_size + 1, self.batch_size):
+            yield shard[i:i + self.batch_size].tolist()
+
+    def __len__(self):
+        n = len(self.data_source)
+        step = self.batch_size * self.nranks
+        if self.drop_last:
+            return n // step
+        return int(np.ceil(n / step))
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
+                 drop_last=False):
+        self.sampler = sampler or (RandomSampler(dataset) if shuffle
+                                   else SequenceSampler(dataset))
+        self.batch_size, self.drop_last = batch_size, drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else \
+            (n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch: List):
+    """Stack samples into numpy batches, mirroring paddle's default collate."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic, int, float)):
+        return np.stack([np.asarray(s) for s in batch])
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch])
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn([s[i] for s in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    return batch
+
+
+def _worker_loop(dataset, index_q, data_q, collate_fn, init_fn,
+                 worker_id, num_workers, base_seed):
+    """Worker-process body (reference io/dataloader/worker.py _worker_loop):
+    pull index batches, collate samples, push (seq, batch) back. Runs until
+    it sees the None sentinel."""
+    np.random.seed((base_seed + worker_id) % (2 ** 31))
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        while True:
+            item = index_q.get()
+            if item is None:
+                break
+            epoch, seq, idxs = item
+            try:
+                batch = collate_fn([dataset[i] for i in idxs])
+                data_q.put((epoch, seq, batch, None))
+            except Exception:
+                data_q.put((epoch, seq, None, traceback.format_exc()))
+    except KeyboardInterrupt:
+        pass
+
+
+class _WorkerPool:
+    """Spawns `num_workers` processes; dispatches (seq, indices), yields
+    collated batches in order (seq-based reassembly)."""
+
+    def __init__(self, dataset, collate_fn, num_workers, worker_init_fn,
+                 prefetch_factor, timeout):
+        # fork keeps the dataset un-pickled and matches the reference's
+        # Linux default; workers only touch numpy, never the device runtime
+        ctx = mp.get_context(
+            os.environ.get("PADDLE_TPU_WORKER_START_METHOD", "fork"))
+        self.num_workers = num_workers
+        self.timeout = timeout or None
+        self.prefetch = prefetch_factor
+        self.data_q = ctx.Queue()
+        self.index_qs = [ctx.Queue() for _ in range(num_workers)]
+        base_seed = int(np.random.randint(0, 2 ** 31))
+        self.procs = []
+        for w in range(num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, self.index_qs[w], self.data_q, collate_fn,
+                      worker_init_fn, w, num_workers, base_seed),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+        self._closed = False
+        self._epoch = 0
+        atexit.register(self.shutdown)
+
+    def run_epoch(self, index_iter):
+        """Generator over collated batches, in sampler order. Messages carry
+        an epoch tag so results from an earlier abandoned epoch (caller
+        broke out of the loop mid-stream) are discarded, not miscounted."""
+        self._epoch += 1
+        epoch = self._epoch
+        seq_out = 0          # next seq to yield
+        buffered = {}        # seq -> batch (arrived out of order)
+        pending = 0
+        it = iter(enumerate(index_iter))
+        limit = self.num_workers * self.prefetch
+
+        def dispatch():
+            nonlocal pending
+            try:
+                seq, idxs = next(it)
+            except StopIteration:
+                return False
+            self.index_qs[seq % self.num_workers].put((epoch, seq, idxs))
+            pending += 1
+            return True
+
+        for _ in range(limit):
+            if not dispatch():
+                break
+        while pending > 0 or seq_out in buffered:
+            while seq_out in buffered:
+                yield buffered.pop(seq_out)
+                seq_out += 1
+                dispatch()
+            if pending == 0:
+                break
+            try:
+                ep, seq, batch, err = self.data_q.get(timeout=self.timeout)
+            except queue.Empty:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker timed out after {self.timeout}s")
+            if ep != epoch:
+                continue        # leftover from an abandoned epoch
+            pending -= 1
+            if err is not None:
+                self.shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            buffered[seq] = batch
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        atexit.unregister(self.shutdown)   # don't pin retired pools forever
+        for q in self.index_qs:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool: Optional[_WorkerPool] = None
+        if isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+            self.num_workers = 0  # stream datasets stay on the thread path
+        else:
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.shutdown()
+
+    def _produce(self):
+        if self.batch_sampler is None:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for idxs in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def _iter_multiprocess(self):
+        if self._pool is None or self._pool._closed:
+            self._pool = _WorkerPool(self.dataset, self.collate_fn,
+                                     self.num_workers, self.worker_init_fn,
+                                     self.prefetch_factor, self.timeout)
+        pool = self._pool
+        try:
+            for batch in pool.run_epoch(iter(self.batch_sampler)):
+                yield _to_tensors(batch)
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+                self._pool = None
+
+    def __iter__(self):
+        if self.num_workers > 0 and self.batch_sampler is not None:
+            yield from self._iter_multiprocess()
+            return
+        src = self._produce()
+        if not self.use_buffer_reader:
+            for b in src:
+                yield _to_tensors(b)
+            return
+        # bounded background prefetch (blocking-queue analog)
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+        sentinel = object()
+        error = []
+
+        def worker():
+            try:
+                for item in src:
+                    q.put(item)
+            except BaseException as e:  # propagate to the consumer
+                error.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if error:
+                    raise error[0]
+                break
+            yield _to_tensors(item)
+
+
+def _to_tensors(batch):
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, (tuple, list)):
+        return [_to_tensors(b) for b in batch]
+    if isinstance(batch, dict):
+        return {k: _to_tensors(v) for k, v in batch.items()}
+    return batch

@@ -1,0 +1,22 @@
+"""paddle.jit: dynamic-to-static (reference python/paddle/jit — to_static
+api.py:171, SOT bytecode tracer sot/, AST fallback dy2static/).
+
+TPU-native: no bytecode simulation needed — the eager Tensor already wraps
+functional arrays, so tracing IS running the Python forward with jax tracers
+bound to every Tensor/Parameter/buffer. `to_static` builds a pure function
+(state, inputs, rng) -> (outputs, new_buffers) and jit-compiles it; graph
+breaks simply don't exist, and data-dependent Python control flow raises the
+standard jax tracer error (the documented host-sync points, ops marked
+jit:false in ops.yaml).
+
+`TrainStep` compiles forward+backward+optimizer into ONE donated XLA
+program — the steady-state training path that replaces the reference's
+executor pipeline (new_executor) for throughput.
+"""
+
+from .api import (to_static, TrainStep, not_to_static,  # noqa: F401
+                  TranslatedLayer)
+from .api import save, load  # noqa: F401
+
+from . import sot  # noqa: E402,F401
+from .sot import symbolic_translate  # noqa: E402,F401

@@ -1,0 +1,438 @@
+"""SOT-lite: graph capture that survives data-dependent Python control flow.
+
+Reference counterpart: `python/paddle/jit/sot/` — the bytecode-level
+symbolic translator (`translate.py:91-99` installs a CPython eval-frame
+hook via `paddle/fluid/pybind/eval_frame.c`, simulates bytecode into SIR
+subgraphs, and falls back to eager at graph breaks).
+
+TPU-native redesign — no bytecode simulation, same capability:
+
+1. **Trace call** (first call / guard miss): the function runs EAGERLY —
+   so results are always correct — while a dispatcher hook records every
+   op (kernel, attrs, argument symbols) into a linear trace, and patched
+   Tensor host-reads (`__bool__`/`__int__`/`__float__`/`item`/`numpy`)
+   record **graph breaks** with the value Python observed. Everything
+   Python did between breaks (branches, loops, arithmetic on `.item()`
+   values) is captured by its *consequences*: the ops it issued and the
+   constants it baked, all conditional on the observed break values.
+2. **Replay** (subsequent calls): the op trace is partitioned into
+   segments at the breaks; each segment compiles once into a single XLA
+   program (`jax.jit` over the recorded kernel sequence). Replay executes
+   segment → check the break's **guard** (recompute the observed value,
+   compare) → next segment. A guard mismatch means Python would have
+   taken a different path: replay aborts and the call re-traces eagerly
+   (the reference's graph-break fallback), refreshing the cache.
+3. Autograd: each segment registers one tape GradNode (jax.vjp of the
+   segment function), so `backward()` flows through replayed calls
+   exactly like the eager chain.
+
+Unsupported constructs poison the trace (AMP auto-cast rewrites kernel
+inputs outside the recorded trace; `_set_data` mutation mid-trace breaks
+symbol identity) — a poisoned entry simply stays eager forever, which is
+SOT's contract: never wrong, compiled where possible.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import engine
+from ..core import generator
+from ..core.tensor import Tensor
+from ..ops import dispatcher
+
+
+class GuardMismatch(Exception):
+    pass
+
+
+class _Node:
+    __slots__ = ("kernel", "attrs", "present", "arg_refs", "keyed",
+                 "out_syms")
+
+    def __init__(self, kernel, attrs, present, arg_refs, keyed, out_syms):
+        self.kernel = kernel
+        self.attrs = attrs
+        self.present = present
+        self.arg_refs = arg_refs      # ('s', sym) | ('e', ext_idx)
+        self.keyed = keyed
+        self.out_syms = out_syms
+
+
+class _Break:
+    __slots__ = ("kind", "ref", "value")
+
+    def __init__(self, kind, ref, value):
+        self.kind = kind
+        self.ref = ref                # ('s', sym) | ('e', ext_idx)
+        self.value = value
+
+
+class _Recorder:
+    def __init__(self):
+        self.nodes: List[Any] = []    # _Node | _Break interleaved
+        self.sym_of: Dict[int, int] = {}
+        self.externals: List[Tensor] = []
+        self.ext_of: Dict[int, int] = {}
+        self.pins: List[Tensor] = []  # keep traced tensors alive (id reuse)
+        self.next_sym = 0
+        self.poisoned: Optional[str] = None
+
+    def bind_input(self, t: Tensor) -> int:
+        s = self.next_sym
+        self.next_sym += 1
+        self.sym_of[id(t)] = s
+        self.pins.append(t)
+        return s
+
+    def _ref(self, t: Tensor):
+        s = self.sym_of.get(id(t))
+        if s is not None:
+            return ("s", s)
+        e = self.ext_of.get(id(t))
+        if e is None:
+            e = len(self.externals)
+            self.externals.append(t)
+            self.ext_of[id(t)] = e
+        return ("e", e)
+
+    def on_op(self, schema, in_tensors, attrs, present, outs):
+        if self.poisoned:
+            return
+        from .. import amp as amp_mod
+        if amp_mod._state.get("enable"):
+            # auto_cast entered INSIDE the traced fn: the dispatcher casts
+            # primals before the kernel, which replay would not reproduce
+            self.poison("amp auto_cast active during trace")
+            return
+        ins = list(in_tensors)
+        pres = list(present)
+        keyed = bool(schema.key)
+        if keyed:                       # injected PRNG key rides last
+            ins = ins[:-1]
+            pres = pres[:-1]
+        try:
+            hash(tuple(sorted((k, dispatcher._hashable(v))
+                              for k, v in attrs.items())))
+        except TypeError:
+            self.poison("unhashable attrs")
+            return
+        arg_refs = [self._ref(t) if t is not None else None for t in ins]
+        out_syms = []
+        for o in outs:
+            s = self.next_sym
+            self.next_sym += 1
+            self.sym_of[id(o)] = s
+            self.pins.append(o)
+            out_syms.append(s)
+        self.nodes.append(_Node(schema.kernel, dict(attrs), tuple(pres),
+                                arg_refs, keyed, out_syms))
+
+    def on_break(self, kind, t: Tensor, value):
+        if self.poisoned:
+            return
+        self.nodes.append(_Break(kind, self._ref(t), value))
+
+    def poison(self, reason: str):
+        self.poisoned = reason
+
+
+class _Segment:
+    def __init__(self, nodes: List[_Node], in_syms, ext_idxs, out_syms):
+        self.nodes = nodes
+        self.in_syms = list(in_syms)
+        self.ext_idxs = list(ext_idxs)
+        self.out_syms = list(out_syms)
+        self.n_keys = sum(1 for n in nodes if n.keyed)
+        self._jit = None
+        self._bwd_jits: Dict[tuple, Any] = {}
+
+    def _raw(self, arrays, ext_arrays, keys):
+        env: Dict[int, Any] = dict(zip(self.in_syms, arrays))
+        ext = dict(zip(self.ext_idxs, ext_arrays))  # global idx -> array
+        ki = 0
+        for n in self.nodes:
+            prim = []
+            for r in n.arg_refs:
+                if r is None:
+                    continue
+                prim.append(env[r[1]] if r[0] == "s" else ext[r[1]])
+            pres = n.present
+            if n.keyed:
+                prim.append(keys[ki])
+                ki += 1
+                pres = pres + (1,)
+            args = dispatcher._reassemble(prim, pres)
+            res = dispatcher.KERNELS[n.kernel](*args, **n.attrs)
+            res = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+            for s, a in zip(n.out_syms, res):
+                env[s] = a
+        return tuple(env[s] for s in self.out_syms)
+
+    def run(self, in_tensors: List[Tensor], ext_tensors: List[Tensor]):
+        arrays = tuple(t._data for t in in_tensors)
+        ext_arrays = tuple(t._data for t in ext_tensors)
+        keys = tuple(generator.next_key() for _ in range(self.n_keys))
+        all_in = list(in_tensors) + list(ext_tensors)
+        need_grad = engine.is_grad_enabled() and any(
+            not t._stop_gradient for t in all_in)
+        if not need_grad:
+            if self._jit is None:
+                self._jit = jax.jit(self._raw)
+            out_arrays = self._jit(arrays, ext_arrays, keys)
+            return [Tensor(a) for a in out_arrays]
+        prim = arrays + ext_arrays
+        dmask = tuple(not t._stop_gradient
+                      and jnp.issubdtype(t._data.dtype, jnp.inexact)
+                      for t in all_in)
+        # forward: the same cached jitted program as the no-grad path
+        if self._jit is None:
+            self._jit = jax.jit(self._raw)
+        out_arrays = self._jit(arrays, ext_arrays, keys)
+        outs = [Tensor(a) for a in out_arrays]
+        out_avals = [(a.shape, a.dtype) for a in out_arrays]
+        na = len(arrays)
+
+        # backward: one cached jitted vjp per dmask (recomputes the segment
+        # forward inside the compiled program — remat-style, but compiled,
+        # unlike an eager jax.vjp which replays ops unjitted every call)
+        bwd = self._bwd_jits.get(dmask)
+        if bwd is None:
+            def bwd_fn(diff_p, other_p, keys, cts, _dmask=dmask, _na=na):
+                di, oi = iter(diff_p), iter(other_p)
+                frozen = [next(di) if d else next(oi) for d in _dmask]
+
+                def f_diff(*dp):
+                    it = iter(dp)
+                    full = [next(it) if d else f
+                            for f, d in zip(frozen, _dmask)]
+                    outs_ = self._raw(tuple(full[:_na]), tuple(full[_na:]),
+                                      keys)
+                    return tuple(o for o in outs_
+                                 if jnp.issubdtype(o.dtype, jnp.inexact))
+
+                _, vjp = jax.vjp(
+                    f_diff, *(p for p, d in zip(frozen, _dmask) if d))
+                return vjp(tuple(cts))
+            bwd = jax.jit(bwd_fn)
+            self._bwd_jits[dmask] = bwd
+
+        def vjp_callable(_primals, cts, _bwd=bwd, _avals=out_avals,
+                         _dmask=dmask, _keys=keys):
+            cts_f = tuple(
+                (c if c is not None else jnp.zeros(shp, dt))
+                for c, (shp, dt) in zip(cts, _avals)
+                if jnp.issubdtype(dt, jnp.inexact))
+            diff_p = tuple(p for p, d in zip(_primals, _dmask) if d)
+            other_p = tuple(p for p, d in zip(_primals, _dmask) if not d)
+            gs = iter(_bwd(diff_p, other_p, _keys, cts_f))
+            return [next(gs) if d else None for d in _dmask]
+
+        engine.record_node("sot_segment", vjp_callable, prim, all_in, outs)
+        return outs
+
+
+class _TraceEntry:
+    def __init__(self, recorder: _Recorder, input_syms, out_refs,
+                 out_treedef, const_leaves):
+        self.externals = recorder.externals
+        self.input_syms = input_syms
+        self.out_refs = out_refs
+        self.out_treedef = out_treedef
+        self.const_leaves = const_leaves
+        self.eager_only = recorder.poisoned
+        if self.eager_only:
+            return
+        # which syms must surface from segments: break refs + final outputs
+        needed = {r[1] for r in out_refs if r is not None and r[0] == "s"}
+        for ev in recorder.nodes:
+            if isinstance(ev, _Break) and ev.ref[0] == "s":
+                needed.add(ev.ref[1])
+        # last event index where each sym is consumed (ops or break refs) —
+        # a segment must output any sym needed past its end boundary
+        all_events = recorder.nodes
+        use_after: Dict[int, int] = {}
+        for i, ev in enumerate(all_events):
+            if isinstance(ev, _Node):
+                for r in ev.arg_refs:
+                    if r is not None and r[0] == "s":
+                        use_after[r[1]] = i
+            elif ev.ref[0] == "s":
+                use_after[ev.ref[1]] = i
+
+        # split into segments at breaks
+        self.segments: List[_Segment] = []
+        self.breaks: List[Optional[_Break]] = []
+        bounds = [i for i, ev in enumerate(all_events)
+                  if isinstance(ev, _Break)]
+        start = 0
+        for b in bounds + [None]:
+            end = b if b is not None else len(all_events)
+            nodes = [e for e in all_events[start:end]
+                     if isinstance(e, _Node)]
+            prod = set()
+            ins, exts = set(), set()
+            for n in nodes:
+                for r in n.arg_refs:
+                    if r is None:
+                        continue
+                    if r[0] == "s" and r[1] not in prod:
+                        ins.add(r[1])
+                    elif r[0] == "e":
+                        exts.add(r[1])
+                prod.update(n.out_syms)
+            outs = sorted(
+                s for s in prod
+                if s in needed or use_after.get(s, -1) >= end)
+            self.segments.append(
+                _Segment(nodes, sorted(ins), sorted(exts), outs))
+            self.breaks.append(all_events[b] if b is not None else None)
+            start = end + 1 if b is not None else end
+
+    @staticmethod
+    def _read(kind, t: Tensor):
+        if kind == "bool":
+            return bool(t._data)
+        if kind == "int":
+            return int(t._data)
+        if kind == "float":
+            return float(t._data)
+        if kind == "item":
+            return t._data.item()
+        return np.asarray(t._data)
+
+    def replay(self, flat_inputs: List[Tensor]):
+        env: Dict[int, Tensor] = dict(zip(self.input_syms, flat_inputs))
+
+        def tensor_of(ref):
+            return env[ref[1]] if ref[0] == "s" else self.externals[ref[1]]
+
+        for seg, brk in zip(self.segments, self.breaks):
+            missing = [s for s in seg.in_syms if s not in env]
+            if missing:
+                raise GuardMismatch(f"missing syms {missing}")
+            outs = seg.run([env[s] for s in seg.in_syms],
+                           [self.externals[e] for e in seg.ext_idxs])
+            env.update(zip(seg.out_syms, outs))
+            if brk is not None:
+                now = self._read(brk.kind, tensor_of(brk.ref))
+                same = (np.array_equal(now, brk.value)
+                        if isinstance(brk.value, np.ndarray)
+                        else now == brk.value)
+                if not same:
+                    raise GuardMismatch(
+                        f"{brk.kind} guard: traced {brk.value!r}, "
+                        f"got {now!r}")
+        leaves = []
+        ci = iter(self.const_leaves)
+        for r in self.out_refs:
+            leaves.append(next(ci) if r is None else tensor_of(r))
+        return jax.tree.unflatten(self.out_treedef, leaves)
+
+
+_PATCH_METHODS = {"__bool__": "bool", "__int__": "int",
+                  "__float__": "float", "item": "item", "numpy": "numpy"}
+
+
+@contextlib.contextmanager
+def _tracing(recorder: _Recorder):
+    saved = {}
+    for meth, kind in _PATCH_METHODS.items():
+        orig = getattr(Tensor, meth)
+        saved[meth] = orig
+
+        def patched(self, _orig=orig, _kind=kind):
+            v = _orig(self)
+            recorder.on_break(_kind, self, v)
+            return v
+
+        setattr(Tensor, meth, patched)
+    orig_set = Tensor._set_data
+
+    def poisoning_set(self, arr):
+        if id(self) in recorder.sym_of or id(self) in recorder.ext_of:
+            recorder.poison("_set_data on traced tensor")
+        return orig_set(self, arr)
+
+    Tensor._set_data = poisoning_set
+    from .. import amp as amp_mod
+    if amp_mod._state.get("enable"):
+        recorder.poison("amp auto_cast active")
+    prev_recorder = dispatcher._SOT_RECORDER
+    dispatcher._SOT_RECORDER = recorder
+    try:
+        yield
+    finally:
+        dispatcher._SOT_RECORDER = prev_recorder
+        Tensor._set_data = orig_set
+        for meth, orig in saved.items():
+            setattr(Tensor, meth, orig)
+
+
+class SOTFunction:
+    """Callable wrapper: trace-or-replay with guards (the `symbolic_
+    translate` entry, reference jit/sot/translate.py:31)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self._cache: Dict[Tuple, _TraceEntry] = {}
+        self.trace_count = 0
+        self.replay_count = 0
+
+    def __call__(self, *args, **kwargs):
+        flat_all, treedef = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        flat_t = [x for x in flat_all if isinstance(x, Tensor)]
+        key = (treedef,
+               tuple(x if not isinstance(x, Tensor) else
+                     ("T", tuple(x.shape), str(x.dtype)) for x in flat_all))
+        try:
+            hash(key)
+        except TypeError:
+            return self.fn(*args, **kwargs)
+        if dispatcher._SOT_RECORDER is not None:
+            # nested inside another SOT trace: run plain-eager so the OUTER
+            # recorder sees every op (a replay here would hide ops from it)
+            return self.fn(*args, **kwargs)
+        entry = self._cache.get(key)
+        if entry is not None:
+            if entry.eager_only:
+                return self.fn(*args, **kwargs)
+            try:
+                out = entry.replay(flat_t)
+                self.replay_count += 1
+                return out
+            except GuardMismatch:
+                pass   # fall through: re-trace eagerly (graph break)
+        return self._trace(key, flat_t, args, kwargs)
+
+    def _trace(self, key, flat_t, args, kwargs):
+        self.trace_count += 1
+        rec = _Recorder()
+        input_syms = [rec.bind_input(t) for t in flat_t]
+        with _tracing(rec):
+            result = self.fn(*args, **kwargs)
+        out_flat, out_treedef = jax.tree.flatten(
+            result, is_leaf=lambda x: isinstance(x, Tensor))
+        out_refs, consts = [], []
+        for leaf in out_flat:
+            if isinstance(leaf, Tensor):
+                out_refs.append(rec._ref(leaf))
+            else:
+                out_refs.append(None)
+                consts.append(leaf)
+        self._cache[key] = _TraceEntry(rec, input_syms, out_refs,
+                                       out_treedef, consts)
+        return result
+
+
+def symbolic_translate(fn=None, **kwargs):
+    """Decorator/wrapper form (reference sot/translate.py:31)."""
+    if fn is None:
+        return lambda f: SOTFunction(f)
+    return SOTFunction(fn)

@@ -1,0 +1,31 @@
+"""paddle.linalg namespace (reference python/paddle/linalg.py re-exports)."""
+
+from .ops.dispatcher import get_op as _get_op
+
+cholesky = _get_op("cholesky")
+cholesky_solve = _get_op("cholesky_solve")
+cond = _get_op("cond")
+corrcoef = _get_op("corrcoef")
+cov = _get_op("cov")
+det = _get_op("det")
+eig = _get_op("eig")
+eigh = _get_op("eigh")
+eigvals = _get_op("eigvals")
+eigvalsh = _get_op("eigvalsh")
+householder_product = _get_op("householder_product")
+inv = _get_op("inverse")
+lstsq = _get_op("lstsq")
+lu = _get_op("lu")
+matrix_norm = _get_op("matrix_norm")
+matrix_power = _get_op("matrix_power")
+matrix_rank = _get_op("matrix_rank")
+multi_dot = _get_op("multi_dot")
+norm = _get_op("norm")
+pinv = _get_op("pinv")
+qr = _get_op("qr")
+slogdet = _get_op("slogdet")
+solve = _get_op("solve")
+svd = _get_op("svd")
+triangular_solve = _get_op("triangular_solve")
+
+__all__ = [n for n in dir() if not n.startswith("_")]

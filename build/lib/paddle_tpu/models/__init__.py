@@ -1,0 +1,12 @@
+"""Model zoo (reference: PaddleNLP model families + python/paddle/vision/models).
+
+The flagship family is Llama (BASELINE config 3: Llama-3-8B pretrain, the
+MFU north star); BERT covers config 2, MoE config 5.
+"""
+
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion,
+)
+from .bert import BertConfig, BertModel, BertForQuestionAnswering  # noqa: F401
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from .ocr import DBNet, DBLoss, CRNN, CTCHeadLoss  # noqa: E402,F401

@@ -1,0 +1,175 @@
+"""Autoregressive generation: KV caches (contiguous + paged) and the decode
+loop.
+
+Reference: the serving path around
+phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu (paged KV) and
+PaddleNLP's GenerationMixin API (generate with greedy/top-k/top-p).
+
+TPU shape: fixed-capacity cache buffers so every decode step hits ONE cached
+executable (position/length are tensor inputs, never static attrs); the
+paged cache adds a host-side block allocator over a device block pool —
+sequences share the pool, blocks are recycled on release.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatcher import call_op
+
+
+class KVCache:
+    """Contiguous per-layer cache [B, max_len, KV_heads, head_dim]."""
+
+    def __init__(self, num_layers: int, batch: int, max_len: int,
+                 num_kv_heads: int, head_dim: int, dtype="float32"):
+        self.max_len = max_len
+        self.k = [Tensor(jnp.zeros((batch, max_len, num_kv_heads, head_dim),
+                                   dtype=dtype)) for _ in range(num_layers)]
+        self.v = [Tensor(jnp.zeros((batch, max_len, num_kv_heads, head_dim),
+                                   dtype=dtype)) for _ in range(num_layers)]
+
+    def update(self, layer: int, k_new: Tensor, v_new: Tensor,
+               pos: Tensor) -> Tuple[Tensor, Tensor]:
+        """Write k/v at [:, pos:pos+S]; returns the full cache views."""
+        self.k[layer] = call_op("cache_write", self.k[layer], k_new, pos)
+        self.v[layer] = call_op("cache_write", self.v[layer], v_new, pos)
+        return self.k[layer], self.v[layer]
+
+    def attend(self, layer: int, q: Tensor, pos: Tensor,
+               attn_mask: Optional[Tensor] = None) -> Tensor:
+        return call_op("cache_attention", q, self.k[layer], self.v[layer],
+                       pos, attn_mask)
+
+
+class PagedKVCache:
+    """Block-pool cache with per-sequence block tables (paged attention).
+
+    Pool: [num_blocks, block_size, KV_heads, head_dim] per layer. The host
+    allocator hands free blocks to sequences as they grow; `release` returns
+    them — the serving memory model of the reference's block_multi_head
+    path."""
+
+    def __init__(self, num_layers: int, batch: int, num_blocks: int,
+                 block_size: int, num_kv_heads: int, head_dim: int,
+                 max_blocks_per_seq: int, dtype="float32"):
+        self.block_size = block_size
+        self.num_layers = num_layers
+        self.k = [Tensor(jnp.zeros((num_blocks, block_size, num_kv_heads,
+                                    head_dim), dtype=dtype))
+                  for _ in range(num_layers)]
+        self.v = [Tensor(jnp.zeros((num_blocks, block_size, num_kv_heads,
+                                    head_dim), dtype=dtype))
+                  for _ in range(num_layers)]
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self.block_tables = np.zeros((batch, max_blocks_per_seq), np.int32)
+        self.context_lens = np.zeros((batch,), np.int32)
+        # blocks handed to each sequence so far — allocation is per TOKEN,
+        # not per layer-write (all layers share one block table)
+        self._allocated = np.zeros((batch,), np.int32)
+
+    # -- host-side allocator -------------------------------------------------
+    def _ensure_block(self, seq: int, pos: int) -> int:
+        blk_idx = pos // self.block_size
+        if blk_idx >= self.block_tables.shape[1]:
+            raise RuntimeError(
+                f"PagedKVCache: position {pos} needs block {blk_idx} but "
+                f"max_blocks_per_seq={self.block_tables.shape[1]}")
+        while self._allocated[seq] <= blk_idx:
+            if not self._free:
+                raise RuntimeError("PagedKVCache: block pool exhausted")
+            self.block_tables[seq, self._allocated[seq]] = self._free.pop()
+            self._allocated[seq] += 1
+        return self.block_tables[seq, blk_idx]
+
+    def release(self, seq: int):
+        used = int(self._allocated[seq])
+        self._free.extend(int(b) for b in self.block_tables[seq, :used])
+        self.block_tables[seq, :] = 0
+        self.context_lens[seq] = 0
+        self._allocated[seq] = 0
+
+    def write_token(self, layer: int, seq_positions: np.ndarray,
+                    k_new: Tensor, v_new: Tensor):
+        """Write one token per sequence at its current position."""
+        slots = []
+        for b, pos in enumerate(seq_positions):
+            blk = self._ensure_block(b, int(pos))
+            slots.append(blk * self.block_size + int(pos) % self.block_size)
+        slot_ids = Tensor(jnp.asarray(slots, jnp.int32))
+        self.k[layer] = call_op("paged_cache_write", self.k[layer], k_new,
+                                slot_ids)
+        self.v[layer] = call_op("paged_cache_write", self.v[layer], v_new,
+                                slot_ids)
+        # advance lengths at the FIRST layer's write: forward order is
+        # write(i) → attend(i) → write(i+1)..., so every layer (including
+        # layer 0) must already see the just-written token in its mask
+        if layer == 0:
+            for b, pos in enumerate(seq_positions):
+                self.context_lens[b] = max(self.context_lens[b],
+                                           int(pos) + 1)
+
+    def attend(self, layer: int, q: Tensor) -> Tensor:
+        return call_op("paged_attention", q, self.k[layer], self.v[layer],
+                       Tensor(jnp.asarray(self.block_tables)),
+                       Tensor(jnp.asarray(self.context_lens)))
+
+
+class GenerationMixin:
+    """Decode loop (PaddleNLP GenerationMixin analog). Host model must
+    accept forward(input_ids, cache=..., start_pos=...) returning logits."""
+
+    def generate(self, input_ids: Tensor, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_token_id: Optional[int] = None,
+                 max_cache_len: Optional[int] = None):
+        from ..autograd.engine import no_grad
+        cfg = self.config
+        b, s = input_ids.shape[0], input_ids.shape[1]
+        total = s + max_new_tokens
+        if max_cache_len is not None and max_cache_len < total:
+            raise ValueError(
+                f"max_cache_len={max_cache_len} < prompt+max_new_tokens="
+                f"{total}: the cache would wrap and corrupt decoding")
+        if total > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt+max_new_tokens={total} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings} "
+                f"(rope table would clamp positions)")
+        cache = KVCache(cfg.num_hidden_layers, b,
+                        max_cache_len or total,
+                        cfg.num_key_value_heads,
+                        cfg.hidden_size // cfg.num_attention_heads,
+                        dtype=getattr(cfg, "dtype", "float32"))
+        tokens = [input_ids]
+        finished = np.zeros((b,), bool)
+        with no_grad():
+            # prefill: whole prompt in one pass
+            logits = self(input_ids, cache=cache,
+                          start_pos=Tensor(jnp.asarray(0, jnp.int32)))
+            next_tok = call_op("sample_logits", logits[:, -1, :],
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p)
+            for step in range(max_new_tokens):
+                if eos_token_id is not None:
+                    # finished rows emit eos forever (padding), never live
+                    # samples
+                    tok_np = np.where(finished, eos_token_id,
+                                      np.asarray(next_tok._data))
+                    finished |= tok_np == eos_token_id
+                    next_tok = Tensor(jnp.asarray(tok_np, jnp.int32))
+                tokens.append(next_tok.reshape([b, 1]))
+                if eos_token_id is not None and finished.all():
+                    break
+                if step == max_new_tokens - 1:
+                    break
+                pos = Tensor(jnp.asarray(s + step, jnp.int32))
+                logits = self(tokens[-1], cache=cache, start_pos=pos)
+                next_tok = call_op("sample_logits", logits[:, -1, :],
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p)
+        return call_op("concat", tokens, axis=1)

@@ -1,0 +1,112 @@
+"""GPT family (reference: PaddleNLP gpt/modeling.py; also the tiny GPT the
+reference uses for auto-parallel e2e tests, test/auto_parallel/get_gpt_model.py).
+
+Decoder-only with learned positions and pre-norm blocks; TP-aware through the
+same `_linear` helper as Llama.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatcher import call_op
+from .. import nn
+from ..nn.layer_base import Layer
+from .llama import _linear, _tp_enabled
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+
+    @staticmethod
+    def tiny() -> "GPTConfig":
+        return GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, intermediate_size=128,
+                         max_position_embeddings=128)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        h = config.hidden_size
+        self.qkv_proj = _linear(h, 3 * h, has_bias=True, col=True)
+        self.out_proj = _linear(h, h, has_bias=True, col=False)
+
+    def forward(self, x):
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = (qkv[:, :, i] for i in range(3))  # tape-aware getitem
+        out = call_op("scaled_dot_product_attention", q, k, v, is_causal=True)
+        return self.out_proj(out.reshape([b, s, -1]))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_eps)
+        self.fc_in = _linear(config.hidden_size, config.intermediate_size,
+                             has_bias=True, col=True)
+        self.fc_out = _linear(config.intermediate_size, config.hidden_size,
+                              has_bias=True, col=False)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        h = call_op("gelu", self.fc_in(self.ln_2(x)), approximate=True)
+        return x + self.fc_out(h)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        if _tp_enabled():
+            from ..distributed.fleet.mp_layers import VocabParallelEmbedding
+            self.wte = VocabParallelEmbedding(config.vocab_size,
+                                              config.hidden_size)
+        else:
+            self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.lm_head = _linear(config.hidden_size, config.vocab_size,
+                               col=True, gather_output=True)
+
+    def forward(self, input_ids, position_ids=None):
+        return self.lm_head(self.gpt(input_ids, position_ids))

@@ -1,0 +1,149 @@
+"""MoE causal LM family — DeepSeekMoE / Qwen2-MoE style (BASELINE config 5).
+
+Reference counterpart: PaddleNLP's deepseek_v2/qwen2_moe modeling built on
+the reference MoE stack (`python/paddle/incubate/distributed/models/moe/`).
+Architecture: Llama-style decoder where MLP is replaced by
+(shared experts + routed top-k experts); first `first_k_dense_replace`
+layers keep a dense MLP (DeepSeekMoE convention).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.moe import MoELayer
+from ..ops.dispatcher import call_op
+from .llama import (LlamaAttention, LlamaConfig, LlamaMLP,
+                    LlamaPretrainingCriterion, LlamaRMSNorm, _dtype_scope)
+from .. import nn
+
+
+@dataclass
+class MoEConfig(LlamaConfig):
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 0      # 0 -> intermediate_size
+    num_shared_experts: int = 0         # DeepSeekMoE shared experts
+    first_k_dense_replace: int = 1      # dense MLP in the first k layers
+    capacity_factor: float = 1.25
+    aux_loss_alpha: float = 0.01
+    expert_axis: str = "dp"
+
+    @staticmethod
+    def tiny_moe(**kw):
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=128,
+                    num_experts=4, num_experts_per_tok=2,
+                    moe_intermediate_size=32, num_shared_experts=1,
+                    first_k_dense_replace=0)
+        base.update(kw)
+        return MoEConfig(**base)
+
+
+class MoEMLP(Layer):
+    """Routed experts + optional always-on shared experts."""
+
+    def __init__(self, config: MoEConfig):
+        super().__init__()
+        m = config.moe_intermediate_size or config.intermediate_size
+        self.moe = MoELayer(config.hidden_size, m, config.num_experts,
+                            top_k=config.num_experts_per_tok,
+                            capacity_factor=config.capacity_factor,
+                            expert_axis=config.expert_axis)
+        self.shared = None
+        if config.num_shared_experts > 0:
+            shared_cfg = LlamaConfig(
+                hidden_size=config.hidden_size,
+                intermediate_size=m * config.num_shared_experts)
+            self.shared = LlamaMLP(shared_cfg)
+
+    @property
+    def aux_loss(self):
+        return self.moe.aux_loss
+
+    def forward(self, x):
+        out = self.moe(x)
+        if self.shared is not None:
+            out = out + self.shared(x)
+        return out
+
+
+class MoEDecoderLayer(Layer):
+    def __init__(self, config: MoEConfig, layer_idx: int):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        if layer_idx < config.first_k_dense_replace:
+            self.mlp = LlamaMLP(config)
+        else:
+            self.mlp = MoEMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size,
+                                            config.rms_norm_eps)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
+                                                     config.rms_norm_eps)
+
+    def forward(self, x, attn_mask=None, position_ids=None):
+        x = x + self.self_attn(self.input_layernorm(x), attn_mask,
+                               position_ids)
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class MoEModel(Layer):
+    def __init__(self, config: MoEConfig):
+        super().__init__()
+        self.config = config
+        with _dtype_scope(config.dtype):
+            self.embed_tokens = nn.Embedding(config.vocab_size,
+                                             config.hidden_size)
+            self.layers = nn.LayerList(
+                [MoEDecoderLayer(config, i)
+                 for i in range(config.num_hidden_layers)])
+            self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, attn_mask, position_ids)
+        return self.norm(x)
+
+    def collect_aux_loss(self):
+        total = None
+        for layer in self.layers:
+            mlp = layer.mlp
+            aux = getattr(mlp, "aux_loss", None)
+            if aux is not None:
+                total = aux if total is None else total + aux
+        return total
+
+
+class MoEForCausalLM(Layer):
+    def __init__(self, config: MoEConfig):
+        super().__init__()
+        self.config = config
+        self.model = MoEModel(config)
+        with _dtype_scope(config.dtype):
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None):
+        return self.lm_head(self.model(input_ids, attn_mask, position_ids))
+
+
+class MoEPretrainingCriterion(Layer):
+    """Next-token CE + load-balance aux loss (Switch aux_loss_alpha)."""
+
+    def __init__(self, config: MoEConfig, model: MoEForCausalLM):
+        super().__init__()
+        self.alpha = config.aux_loss_alpha
+        self._model = [model]  # not a sublayer: avoid param double-count
+
+    def forward(self, logits, labels):
+        logits = logits[:, :-1, :].astype("float32")
+        labels = labels[:, 1:]
+        loss = call_op("softmax_with_cross_entropy", logits, labels).mean()
+        aux = self._model[0].model.collect_aux_loss()
+        if aux is not None:
+            loss = loss + self.alpha * aux
+        return loss

@@ -1,0 +1,126 @@
+"""paddle_tpu.native — the C++ runtime layer, loaded via ctypes.
+
+Where the reference is native, so are we: the flag registry
+(paddle/common/flags.cc), memory stats (paddle/fluid/memory/stats.cc) and the
+TCPStore rendezvous (paddle/phi/core/distributed/store/tcp_store.h:121) are
+C++ (see /root/repo/csrc), compiled once into
+`paddle_tpu/native/_lib/libpaddle_tpu_native.so` and bound here through
+ctypes (pybind11 is not available in this image). Every facade has a pure-
+Python fallback so the framework still imports where no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LIB_NAME = "libpaddle_tpu_native.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_on_load_hooks = []
+
+
+def on_load(hook):
+    """Register a callback fired once when the native lib first loads (used
+    by flags.py to mirror the Python-registered flags into the C++ registry)."""
+    if _lib is not None:
+        hook(_lib)
+    else:
+        _on_load_hooks.append(hook)
+
+
+def _csrc_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "csrc")
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib",
+                        _LIB_NAME)
+
+
+def _build() -> bool:
+    csrc = _csrc_dir()
+    if not os.path.isdir(csrc):
+        return False
+    try:
+        r = subprocess.run(["make", "-s", "OUT=" + _lib_path()], cwd=csrc,
+                           capture_output=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(_lib_path())
+    except Exception:
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64, i32, cstr = ctypes.c_int64, ctypes.c_int, ctypes.c_char_p
+    sig = {
+        "PT_RegisterFlag": (i32, [cstr, cstr, cstr, cstr]),
+        "PT_SetFlag": (i32, [cstr, cstr]),
+        "PT_GetFlag": (cstr, [cstr]),
+        "PT_GetFlagType": (cstr, [cstr]),
+        "PT_HasFlag": (i32, [cstr]),
+        "PT_FlagCount": (i32, []),
+        "PT_FlagNameAt": (cstr, [i32]),
+        "PT_StatUpdate": (i64, [cstr, i64]),
+        "PT_StatCurrent": (i64, [cstr]),
+        "PT_StatPeak": (i64, [cstr]),
+        "PT_StatTotal": (i64, [cstr]),
+        "PT_StatResetPeak": (None, [cstr]),
+        "PT_StatReset": (None, [cstr]),
+        "PT_StatCount": (i32, []),
+        "PT_StatNameAt": (cstr, [i32]),
+        "PT_TCPStoreServerStart": (i64, [i32]),
+        "PT_TCPStoreServerPort": (i32, [i64]),
+        "PT_TCPStoreServerStop": (None, [i64]),
+        "PT_TCPStoreClientNew": (i64, [cstr, i32, i32]),
+        "PT_TCPStoreClientFree": (None, [i64]),
+        "PT_TCPStoreSet": (i64, [i64, cstr, cstr, i64]),
+        "PT_TCPStoreGet": (i64, [i64, cstr]),
+        "PT_TCPStoreData": (ctypes.c_void_p, []),
+        "PT_TCPStoreAdd": (i64, [i64, cstr, i64]),
+        "PT_TCPStoreWait": (i64, [i64, cstr, i64]),
+        "PT_TCPStoreDelete": (i64, [i64, cstr]),
+        "PT_TCPStoreNumKeys": (i64, [i64]),
+    }
+    for name, (restype, argtypes) in sig.items():
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _lib_path()
+        src_newer = False
+        if os.path.exists(path) and os.path.isdir(_csrc_dir()):
+            lib_mtime = os.path.getmtime(path)
+            src_newer = any(
+                f.endswith(".cc") and
+                os.path.getmtime(os.path.join(_csrc_dir(), f)) > lib_mtime
+                for f in os.listdir(_csrc_dir()))
+        if not os.path.exists(path) or src_newer:
+            if not _build():
+                return None
+        try:
+            _lib = _bind(ctypes.CDLL(path))
+        except OSError:
+            _lib = None
+        if _lib is not None:
+            for hook in _on_load_hooks:
+                hook(_lib)
+            _on_load_hooks.clear()
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

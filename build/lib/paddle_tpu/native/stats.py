@@ -1,0 +1,90 @@
+"""Memory/alloc stat facade over the native registry (csrc/stats.cc).
+
+Reference: paddle/fluid/memory/stats.cc (Allocated/Reserved counters with
+peaks) surfaced as paddle.device.cuda.memory_allocated etc. Here the facade
+is device-neutral: callers tag counters ("Allocated:tpu:0", "host_pinned",
+...) and the framework updates them at tensor materialisation / free points.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from . import load
+
+_py_lock = threading.Lock()
+_py_stats: Dict[str, Dict[str, int]] = {}
+
+
+def update(name: str, delta: int) -> int:
+    lib = load()
+    if lib is not None:
+        return int(lib.PT_StatUpdate(name.encode(), delta))
+    with _py_lock:
+        s = _py_stats.setdefault(name, {"current": 0, "peak": 0, "total": 0})
+        s["current"] += delta
+        if delta > 0:
+            s["total"] += delta
+        s["peak"] = max(s["peak"], s["current"])
+        return s["current"]
+
+
+def current(name: str) -> int:
+    lib = load()
+    if lib is not None:
+        return int(lib.PT_StatCurrent(name.encode()))
+    with _py_lock:
+        return _py_stats.get(name, {}).get("current", 0)
+
+
+def peak(name: str) -> int:
+    lib = load()
+    if lib is not None:
+        return int(lib.PT_StatPeak(name.encode()))
+    with _py_lock:
+        return _py_stats.get(name, {}).get("peak", 0)
+
+
+def total(name: str) -> int:
+    lib = load()
+    if lib is not None:
+        return int(lib.PT_StatTotal(name.encode()))
+    with _py_lock:
+        return _py_stats.get(name, {}).get("total", 0)
+
+
+def reset_peak(name: str) -> None:
+    lib = load()
+    if lib is not None:
+        lib.PT_StatResetPeak(name.encode())
+        return
+    with _py_lock:
+        if name in _py_stats:
+            _py_stats[name]["peak"] = _py_stats[name]["current"]
+
+
+def reset(name: str) -> None:
+    lib = load()
+    if lib is not None:
+        lib.PT_StatReset(name.encode())
+        return
+    with _py_lock:
+        _py_stats.pop(name, None)
+
+
+def all_stats() -> Dict[str, Dict[str, int]]:
+    lib = load()
+    if lib is None:
+        with _py_lock:
+            return {k: dict(v) for k, v in _py_stats.items()}
+    out = {}
+    for i in range(lib.PT_StatCount()):
+        name = lib.PT_StatNameAt(i)
+        if name is None:
+            continue
+        n = name.decode()
+        out[n] = {"current": int(lib.PT_StatCurrent(name)),
+                  "peak": int(lib.PT_StatPeak(name)),
+                  "total": int(lib.PT_StatTotal(name))}
+    return out

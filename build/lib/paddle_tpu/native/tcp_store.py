@@ -1,0 +1,273 @@
+"""TCPStore: KV rendezvous over the native server (Python fallback included).
+
+API parity with the reference store (tcp_store.h:121 / python `core.TCPStore`):
+rank 0 passes is_master=True and hosts the server; all ranks get a client.
+`add` is atomic, `wait` blocks server-side, `barrier` composes the two.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Optional
+
+from . import load
+
+
+class _PyStoreServer:
+    """Pure-Python fallback server speaking the same wire protocol."""
+
+    def __init__(self, port: int):
+        data, cv = {}, threading.Condition()
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        hdr = self._readn(sock, 5)
+                        cmd, key_len = struct.unpack("<BI", hdr)
+                        key = self._readn(sock, key_len).decode()
+                        (arg,) = struct.unpack("<q", self._readn(sock, 8))
+                        status, payload = 0, b""
+                        if cmd == 0:      # SET
+                            if arg < 0 or arg > (1 << 30):
+                                return    # malformed frame: drop connection
+                            val = self._readn(sock, arg)
+                            with cv:
+                                data[key] = val
+                                cv.notify_all()
+                        elif cmd == 1:    # GET
+                            with cv:
+                                if key in data:
+                                    payload = data[key]
+                                    status = len(payload)
+                                else:
+                                    status = -1
+                        elif cmd == 2:    # ADD
+                            with cv:
+                                try:  # match strtoll: non-numeric reads as 0
+                                    base = int(data.get(key, b"0") or b"0")
+                                except ValueError:
+                                    base = 0
+                                v = base + arg
+                                data[key] = str(v).encode()
+                                cv.notify_all()
+                            payload = struct.pack("<q", v)
+                            status = 8
+                        elif cmd == 3:    # WAIT
+                            deadline = (time.monotonic() + arg / 1e3
+                                        if arg > 0 else None)
+                            with cv:
+                                while key not in data:
+                                    remaining = (None if deadline is None else
+                                                 deadline - time.monotonic())
+                                    if remaining is not None and remaining <= 0:
+                                        break
+                                    cv.wait(remaining)
+                                status = 0 if key in data else -1
+                        elif cmd == 4:    # DEL
+                            with cv:
+                                status = 1 if data.pop(key, None) is not None else 0
+                        elif cmd == 5:    # COUNT
+                            with cv:
+                                status = len(data)
+                        else:
+                            status = -2
+                        sock.sendall(struct.pack("<q", status) +
+                                     (payload if status > 0 else b""))
+                except (ConnectionError, struct.error, OSError):
+                    pass
+
+            @staticmethod
+            def _readn(sock, n):
+                buf = b""
+                while len(buf) < n:
+                    chunk = sock.recv(n - len(buf))
+                    if not chunk:
+                        raise ConnectionError("peer closed")
+                    buf += chunk
+                return buf
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(("0.0.0.0", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class _PyStoreClient:
+    def __init__(self, host: str, port: int, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.settimeout(None)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError as e:
+                last = e
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"TCPStore connect to {host}:{port} timed out") from last
+                time.sleep(0.05)
+        self._lock = threading.Lock()
+
+    def request(self, cmd: int, key: str, arg: int = 0, value: bytes = b""):
+        with self._lock:
+            kb = key.encode()
+            msg = struct.pack("<BI", cmd, len(kb)) + kb + struct.pack("<q", arg)
+            if cmd == 0:
+                msg += value
+            self._sock.sendall(msg)
+            (status,) = struct.unpack("<q", self._readn(8))
+            payload = b""
+            if status > 0 and cmd in (1, 2):
+                payload = self._readn(status)
+            return status, payload
+
+    def _readn(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("store server closed")
+            buf += chunk
+        return buf
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.host = host
+        self.is_master = is_master
+        self.world_size = world_size
+        self._lib = load()
+        self._server = None
+        self._server_h = 0
+        self._client_h = 0
+        self._py_client = None
+        self._barrier_rounds = {}
+
+        if is_master:
+            if self._lib is not None:
+                self._server_h = self._lib.PT_TCPStoreServerStart(port)
+                if self._server_h:
+                    port = self._lib.PT_TCPStoreServerPort(self._server_h)
+            if not self._server_h:
+                self._server = _PyStoreServer(port)
+                port = self._server.port
+        self.port = port
+
+        if self._lib is not None:
+            self._client_h = self._lib.PT_TCPStoreClientNew(
+                host.encode(), port, int(timeout * 1000))
+        if not self._client_h:
+            self._py_client = _PyStoreClient(host, port, timeout)
+
+    # -- KV ops --------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._client_h:
+            st = self._lib.PT_TCPStoreSet(self._client_h, key.encode(), data,
+                                          len(data))
+        else:
+            st, _ = self._py_client.request(0, key, len(data), data)
+        if st < 0:
+            raise RuntimeError(f"TCPStore.set({key}) failed: {st}")
+
+    def get(self, key: str, wait: bool = True,
+            timeout_ms: int = 0) -> Optional[bytes]:
+        if wait and self.wait(key, timeout_ms) != 0:
+            raise TimeoutError(f"TCPStore.get({key}) timed out")
+        if self._client_h:
+            st = self._lib.PT_TCPStoreGet(self._client_h, key.encode())
+            if st < 0:
+                return None
+            ptr = self._lib.PT_TCPStoreData()
+            return ctypes.string_at(ptr, st)
+        st, payload = self._py_client.request(1, key)
+        return payload if st >= 0 else None
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._client_h:
+            v = int(self._lib.PT_TCPStoreAdd(self._client_h, key.encode(),
+                                             delta))
+            if v == -(2 ** 63):  # native error sentinel (connection lost)
+                raise ConnectionError(
+                    f"TCPStore.add({key}) failed: server unreachable")
+            return v
+        st, payload = self._py_client.request(2, key, delta)
+        if st != 8:
+            raise ConnectionError(f"TCPStore.add({key}) failed: {st}")
+        return struct.unpack("<q", payload)[0]
+
+    def wait(self, key: str, timeout_ms: int = 0) -> int:
+        if self._client_h:
+            return int(self._lib.PT_TCPStoreWait(self._client_h, key.encode(),
+                                                 timeout_ms))
+        st, _ = self._py_client.request(3, key, timeout_ms)
+        return int(st)
+
+    def delete(self, key: str) -> bool:
+        if self._client_h:
+            return bool(self._lib.PT_TCPStoreDelete(self._client_h,
+                                                    key.encode()))
+        st, _ = self._py_client.request(4, key)
+        return bool(st)
+
+    def num_keys(self) -> int:
+        if self._client_h:
+            return int(self._lib.PT_TCPStoreNumKeys(self._client_h))
+        st, _ = self._py_client.request(5, "")
+        return int(st)
+
+    def barrier(self, name: str, rank_count: Optional[int] = None,
+                timeout_ms: int = 60_000) -> None:
+        """All `rank_count` participants arrive before any leaves. Reusable:
+        each call on a given name is a new round (local round counter), so the
+        done-key of round k never satisfies round k+1."""
+        n = rank_count or self.world_size
+        rnd = self._barrier_rounds.get(name, 0)
+        self._barrier_rounds[name] = rnd + 1
+        tag = f"__barrier/{name}/{rnd}"
+        arrived = self.add(f"{tag}/count", 1)
+        if arrived >= n:
+            self.set(f"{tag}/done", b"1")
+        if self.wait(f"{tag}/done", timeout_ms) != 0:
+            raise TimeoutError(f"barrier '{name}' round {rnd} timed out "
+                               f"({arrived}/{n} arrived)")
+
+    def close(self):
+        if self._client_h:
+            self._lib.PT_TCPStoreClientFree(self._client_h)
+            self._client_h = 0
+        if self._py_client is not None:
+            self._py_client.close()
+            self._py_client = None
+        if self._server_h:
+            self._lib.PT_TCPStoreServerStop(self._server_h)
+            self._server_h = 0
+        if self._server is not None:
+            self._server.stop()
+            self._server = None

@@ -1,0 +1,26 @@
+"""paddle.nn surface (reference python/paddle/nn, 42k LoC)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer_base import Layer, Parameter  # noqa: F401
+from .layers_common import (  # noqa: F401
+    Identity, Linear, Embedding, Conv1D, Conv2D, Conv2DTranspose,
+    LayerNorm, RMSNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    GroupNorm, InstanceNorm2D, Dropout, Dropout2D,
+    ReLU, ReLU6, GELU, SiLU, Swish, Mish, Sigmoid, Tanh, Softplus, Softsign,
+    Hardswish, Hardsigmoid, ELU, SELU, LogSigmoid, LogSoftmax, Softmax,
+    LeakyReLU, PReLU,
+    MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+    Flatten, Upsample, Pad2D, PixelShuffle,
+    Sequential, LayerList, ParameterList,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+)
+from .loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
+    BCEWithLogitsLoss, KLDivLoss,
+)
+from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401
+from .rnn import (LSTM, GRU, SimpleRNN, LSTMCell, GRUCell,  # noqa: E402,F401
+                  SimpleRNNCell)

@@ -1,0 +1,504 @@
+"""Common nn layers (reference python/paddle/nn/layer/{common,conv,norm,
+pooling,activation,transformer}.py). Layers are thin parameter holders; all
+compute goes through the YAML op surface so autograd/AMP/jit see one path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ..ops.dispatcher import call_op
+from . import initializer as I
+from .layer_base import Layer, Parameter
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Linear(Layer):
+    """y = xW + b; weight shape [in, out] (reference nn/layer/common.py Linear)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter((out_features,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return call_op("linear", x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in={self.in_features}, out={self.out_features}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings: int, embedding_dim: int, padding_idx=None,
+                 sparse: bool = False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+
+    def forward(self, x):
+        return call_op("embedding", x, self.weight,
+                       padding_idx=self.padding_idx if self.padding_idx is not None else None)
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Conv2D(Layer):
+    """NCHW conv (reference nn/layer/conv.py Conv2D; kernel [out, in/g, kh, kw])."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups, self.data_format = groups, data_format
+        kh, kw = _pair(kernel_size)
+        fan_in = in_channels // groups * kh * kw
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return call_op("conv2d", x, self.weight, self.bias, stride=self.stride,
+                       padding=self.padding, dilation=self.dilation,
+                       groups=self.groups, data_format=self.data_format)
+
+
+class Conv1D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__()
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups, self.data_format = groups, data_format
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        fan_in = in_channels // groups * k
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, k), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return call_op("conv1d", x, self.weight, self.bias, stride=self.stride,
+                       padding=self.padding, dilation=self.dilation,
+                       groups=self.groups, data_format=self.data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.output_padding, self.groups = output_padding, groups
+        kh, kw = _pair(kernel_size)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, kh, kw), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=in_channels * kh * kw))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return call_op("conv2d_transpose", x, self.weight, self.bias,
+                       stride=self.stride, padding=self.padding,
+                       output_padding=self.output_padding,
+                       dilation=self.dilation, groups=self.groups)
+
+
+# -- normalization -------------------------------------------------------------
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            self.normalized_shape, default_initializer=I.Constant(1.0),
+            attr=None if weight_attr in (None, True) else weight_attr))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            self.normalized_shape, is_bias=True,
+            attr=None if bias_attr in (None, True) else bias_attr))
+
+    def forward(self, x):
+        return call_op("layer_norm", x, self.weight, self.bias,
+                       epsilon=self.epsilon,
+                       begin_norm_axis=-len(self.normalized_shape))
+
+
+class RMSNorm(Layer):
+    """Fused rms_norm layer (reference incubate fused_rms_norm)."""
+
+    def __init__(self, hidden_size, epsilon=1e-06, weight_attr=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter((hidden_size,),
+                                            default_initializer=I.Constant(1.0),
+                                            attr=weight_attr)
+
+    def forward(self, x):
+        return call_op("rms_norm", x, self.weight, None, epsilon=self.epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum, self.epsilon = momentum, epsilon
+        self.data_format = "NCHW" if data_format in ("NCHW", "NCL") else "NHWC"
+        self.use_global_stats = use_global_stats
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            (num_features,), default_initializer=I.Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_features,), is_bias=True))
+        self.register_buffer("_mean", Tensor(np.zeros(num_features, np.float32)))
+        self.register_buffer("_variance", Tensor(np.ones(num_features, np.float32)))
+
+    def forward(self, x):
+        if self.training and not self.use_global_stats:
+            out, mean, var = call_op("batch_norm_train", x, self.weight, self.bias,
+                                     epsilon=self.epsilon,
+                                     data_format=self.data_format)
+            m = self.momentum
+            with_nograd_mean = mean.detach()
+            with_nograd_var = var.detach()
+            self._mean._set_data(
+                (self._mean._data * m + with_nograd_mean._data * (1 - m)))
+            self._variance._set_data(
+                (self._variance._data * m + with_nograd_var._data * (1 - m)))
+            return out
+        return call_op("batch_norm_infer", x, self._mean, self._variance,
+                       self.weight, self.bias, epsilon=self.epsilon,
+                       data_format=self.data_format)
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """On TPU, batch stats under GSPMD are computed over the global batch by
+    construction (XLA inserts the cross-replica reductions); eager single-
+    process semantics match BatchNorm (reference nn/layer/norm.py
+    SyncBatchNorm + ProcessGroupNCCL sync)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.num_groups, self.epsilon = num_groups, epsilon
+        self.data_format = data_format
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            (num_channels,), default_initializer=I.Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_channels,), is_bias=True))
+
+    def forward(self, x):
+        return call_op("group_norm", x, self.weight, self.bias,
+                       epsilon=self.epsilon, groups=self.num_groups,
+                       data_format=self.data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = (None if weight_attr is False else self.create_parameter(
+            (num_features,), default_initializer=I.Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_features,), is_bias=True))
+
+    def forward(self, x):
+        return call_op("instance_norm", x, self.weight, self.bias,
+                       epsilon=self.epsilon)
+
+
+# -- dropout / activations -----------------------------------------------------
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.mode = p, mode
+
+    def forward(self, x):
+        return call_op("dropout", x, p=self.p, training=self.training,
+                       mode=self.mode)
+
+
+class Dropout2D(Dropout):
+    pass
+
+
+def _act_layer(op_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, name=None, **kw):
+            super().__init__()
+            self._kw = {**fixed, **kw}
+
+        def forward(self, x):
+            return call_op(op_name, x, **self._kw)
+
+    _Act.__name__ = op_name.title().replace("_", "")
+    return _Act
+
+
+ReLU = _act_layer("relu")
+ReLU6 = _act_layer("relu6")
+GELU = _act_layer("gelu")
+SiLU = _act_layer("silu")
+Swish = _act_layer("swish")
+Mish = _act_layer("mish")
+Sigmoid = _act_layer("sigmoid")
+Tanh = _act_layer("tanh")
+Softplus = _act_layer("softplus")
+Softsign = _act_layer("softsign")
+Hardswish = _act_layer("hardswish")
+Hardsigmoid = _act_layer("hardsigmoid")
+ELU = _act_layer("elu")
+SELU = _act_layer("selu")
+LogSigmoid = _act_layer("logsigmoid")
+LogSoftmax = _act_layer("log_softmax")
+Softmax = _act_layer("softmax")
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return call_op("leaky_relu", x, negative_slope=self.negative_slope)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), default_initializer=I.Constant(init),
+            attr=weight_attr)
+
+    def forward(self, x):
+        w = self.weight
+        if x.ndim >= 2 and w.shape[0] > 1:
+            shape = [1, w.shape[0]] + [1] * (x.ndim - 2)
+            w = w.reshape(shape)
+        return call_op("prelu", x, w)
+
+
+# -- pooling -------------------------------------------------------------------
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.ceil_mode, self.data_format = ceil_mode, data_format
+
+    def forward(self, x):
+        return call_op("max_pool2d", x, kernel_size=self.kernel_size,
+                       stride=self.stride, padding=self.padding,
+                       ceil_mode=self.ceil_mode, data_format=self.data_format)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.ceil_mode, self.exclusive = ceil_mode, exclusive
+        self.data_format = data_format
+
+    def forward(self, x):
+        return call_op("avg_pool2d", x, kernel_size=self.kernel_size,
+                       stride=self.stride, padding=self.padding,
+                       ceil_mode=self.ceil_mode, exclusive=self.exclusive,
+                       data_format=self.data_format)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size, self.data_format = output_size, data_format
+
+    def forward(self, x):
+        return call_op("adaptive_avg_pool2d", x, output_size=self.output_size,
+                       data_format=self.data_format)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size, self.data_format = output_size, data_format
+
+    def forward(self, x):
+        return call_op("adaptive_max_pool2d", x, output_size=self.output_size,
+                       data_format=self.data_format)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        return call_op("flatten", x, start_axis=self.start_axis,
+                       stop_axis=self.stop_axis)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        h = x.shape[2] if self.data_format == "NCHW" else x.shape[1]
+        w = x.shape[3] if self.data_format == "NCHW" else x.shape[2]
+        if self.size is not None:
+            oh, ow = self.size
+        else:
+            sf = self.scale_factor
+            sf = (sf, sf) if isinstance(sf, (int, float)) else sf
+            oh, ow = int(h * sf[0]), int(w * sf[1])
+        if self.mode == "nearest":
+            return call_op("interpolate_nearest", x, out_h=oh, out_w=ow,
+                           data_format=self.data_format)
+        return call_op("interpolate_bilinear", x, out_h=oh, out_w=ow,
+                       align_corners=self.align_corners,
+                       data_format=self.data_format)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW"):
+        super().__init__()
+        self.padding = padding if not isinstance(padding, int) else [padding] * 4
+        self.mode, self.value, self.data_format = mode, value, data_format
+
+    def forward(self, x):
+        return call_op("pad", x, pad=tuple(self.padding), mode=self.mode,
+                       value=self.value, data_format=self.data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def forward(self, x):
+        return call_op("pixel_shuffle", x, upscale_factor=self.upscale_factor)
+
+
+# -- containers ----------------------------------------------------------------
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], tuple):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx % len(self._sub_layers))]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, p):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx % len(self._parameters))]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())

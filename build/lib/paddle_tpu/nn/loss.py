@@ -1,0 +1,97 @@
+"""Loss layers (reference python/paddle/nn/layer/loss.py)."""
+
+from __future__ import annotations
+
+from ..ops.dispatcher import call_op
+from .layer_base import Layer
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, axis=-1, label_smoothing=0.0):
+        super().__init__()
+        self.weight, self.ignore_index = weight, ignore_index
+        self.reduction, self.soft_label, self.axis = reduction, soft_label, axis
+        self.label_smoothing = label_smoothing
+
+    def forward(self, input, label):
+        if self.label_smoothing > 0.0 and not self.soft_label:
+            import paddle_tpu as paddle
+            n = input.shape[self.axis]
+            onehot = call_op("one_hot", label, num_classes=n)
+            soft = onehot * (1.0 - self.label_smoothing) + self.label_smoothing / n
+            return call_op("cross_entropy_mean", input, soft, soft_label=True,
+                           axis=self.axis, reduction=self.reduction)
+        return call_op("cross_entropy_mean", input, label,
+                       soft_label=self.soft_label,
+                       ignore_index=self.ignore_index, axis=self.axis,
+                       weight=self.weight, reduction=self.reduction)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return call_op("mse_loss", input, label, reduction=self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return call_op("l1_loss", input, label, reduction=self.reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return call_op("smooth_l1_loss", input, label, reduction=self.reduction,
+                       delta=self.delta)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean"):
+        super().__init__()
+        self.weight, self.ignore_index, self.reduction = weight, ignore_index, reduction
+
+    def forward(self, input, label):
+        return call_op("nll_loss", input, label, weight=self.weight,
+                       ignore_index=self.ignore_index, reduction=self.reduction)
+
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return call_op("binary_cross_entropy", input, label, weight=self.weight,
+                       reduction=self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", pos_weight=None):
+        super().__init__()
+        self.weight, self.reduction, self.pos_weight = weight, reduction, pos_weight
+
+    def forward(self, logit, label):
+        return call_op("binary_cross_entropy_with_logits", logit, label,
+                       weight=self.weight, pos_weight=self.pos_weight,
+                       reduction=self.reduction)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean", log_target=False):
+        super().__init__()
+        self.reduction, self.log_target = reduction, log_target
+
+    def forward(self, input, label):
+        return call_op("kl_div", input, label, reduction=self.reduction,
+                       log_target=self.log_target)

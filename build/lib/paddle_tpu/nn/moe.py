@@ -1,0 +1,174 @@
+"""Mixture-of-Experts layers with expert parallelism.
+
+Reference counterpart: `python/paddle/incubate/distributed/models/moe/`
+(`MoELayer` moe_layer.py:99 with `MoEScatter`/`MoEGather` PyLayers over the
+CUDA `global_scatter`/`global_gather` collective ops,
+`paddle/fluid/operators/collective/global_scatter_op*`), plus gate impls
+under `.../moe/gate/`.
+
+TPU-first redesign (SURVEY §2.5 EP row: expert mesh axis + ragged
+all_to_all + Pallas grouped-GEMM):
+  - gate: softmax(x @ wg) in f32, top-k choice, capacity-bounded slot
+    positions via cumsum (tokens over capacity are dropped, GShard policy);
+  - dispatch: *index-based gather* into the [E, C, h] capacity buffer —
+    O(E*C*h) bytes moved, zero matmul FLOPs (the round-1 dense one-hot
+    dispatch was t*E*C*h MXU FLOPs, quadratic in tokens);
+  - experts: grouped-GEMM Pallas kernel over stacked weights [E, h, m]
+    that skips capacity tiles beyond the live token count;
+  - combine: weighted scatter-add back to token order;
+  - EP: experts sharded over `expert_axis`; the capacity buffer moves with
+    one tiled `lax.all_to_all` per direction inside shard_map (the
+    global_scatter/global_gather analog), counts riding along so peers
+    skip padding in compute.
+The compute core is the `moe_ffn` op (ops/kernels/moe.py), so autograd,
+AMP and static capture all flow through the normal dispatcher machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..ops.dispatcher import call_op
+from . import initializer as I
+from .layer_base import Layer
+
+
+class TopKGate(Layer):
+    """Top-k softmax router with capacity (reference moe/gate/topk_gate).
+
+    Returns (combine [t, E, C], dispatch-bool [t, E, C], aux_loss scalar).
+    Kept for API parity; `MoELayer` routes through the fused `moe_ffn` op
+    (index-based — see kernels/moe.py:route_topk) rather than these dense
+    one-hot tensors.
+    """
+
+    def __init__(self, hidden_size: int, num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            (hidden_size, num_experts),
+            default_initializer=I.XavierUniform())
+
+    def capacity(self, num_tokens: int) -> int:
+        from ..ops.kernels.moe import moe_capacity
+        return moe_capacity(num_tokens, self.top_k, self.num_experts,
+                            self.capacity_factor)
+
+    def forward(self, x):
+        """x: [t, h] -> (combine [t,E,C], dispatch [t,E,C], aux_loss)."""
+        t, _ = x.shape
+        E, K = self.num_experts, self.top_k
+        C = self.capacity(t)
+        logits = call_op("matmul", x.astype("float32"),
+                         self.weight.astype("float32"))        # [t, E]
+        probs = call_op("softmax", logits, axis=-1)
+        topv, topi = call_op("topk", probs, k=K, axis=-1)      # [t, K]
+
+        # Switch-style load-balance loss: E * sum_e mean_prob_e * frac_e
+        me = probs.mean(axis=0)                                # [E]
+        first = call_op("one_hot", topi[:, 0], num_classes=E)  # [t, E]
+        ce = first.astype("float32").mean(axis=0)
+        aux = (me * ce).sum() * float(E)
+
+        combine = None
+        dispatch = None
+        counts = None  # running per-expert token counts [1, E]
+        for j in range(K):
+            m_j = call_op("one_hot", topi[:, j], num_classes=E)  # [t, E]
+            m_j = m_j.astype("float32")
+            pos_in_e = call_op("cumsum", m_j, axis=0) - m_j      # [t, E]
+            if counts is not None:
+                pos_in_e = pos_in_e + counts
+            pos = (pos_in_e * m_j).sum(axis=-1)                  # [t]
+            keep = (pos < float(C)).astype("float32")
+            gate_j = topv[:, j] * keep                           # [t]
+            oh_c = call_op("one_hot", pos.astype("int32"),
+                           num_classes=C).astype("float32")      # [t, C]
+            d_j = m_j.unsqueeze(-1) * oh_c.unsqueeze(1)          # [t, E, C]
+            d_j = d_j * keep.unsqueeze(-1).unsqueeze(-1)
+            c_j = d_j * gate_j.unsqueeze(-1).unsqueeze(-1)
+            combine = c_j if combine is None else combine + c_j
+            dispatch = d_j if dispatch is None else dispatch + d_j
+            new_counts = m_j.sum(axis=0, keepdim=True)
+            counts = new_counts if counts is None else counts + new_counts
+        return combine, dispatch, aux
+
+
+class ExpertFFN(Layer):
+    """Stacked SwiGLU expert weights [E, h, m] driven by the grouped-GEMM
+    kernel (one ragged GEMM per projection, not a Python loop)."""
+
+    def __init__(self, num_experts: int, hidden_size: int,
+                 intermediate_size: int):
+        super().__init__()
+        E, h, m = num_experts, hidden_size, intermediate_size
+        init = I.XavierUniform()
+        self.gate_weight = self.create_parameter((E, h, m),
+                                                 default_initializer=init)
+        self.up_weight = self.create_parameter((E, h, m),
+                                               default_initializer=init)
+        self.down_weight = self.create_parameter((E, m, h),
+                                                 default_initializer=init)
+
+    def forward(self, x, counts=None):
+        """x: [E, C, h] -> [E, C, h] (ragged-batched over experts)."""
+        g = call_op("grouped_gemm", x, self.gate_weight, counts)
+        u = call_op("grouped_gemm", x, self.up_weight, counts)
+        return call_op("grouped_gemm", call_op("swiglu", g, u),
+                       self.down_weight, counts)
+
+
+class MoELayer(Layer):
+    """Routed-experts MoE block (reference MoELayer moe_layer.py:99).
+
+    forward(x [b, s, h]) -> [b, s, h]; the load-balance aux loss is
+    accumulated on self.aux_loss (read+reset by the model's criterion).
+    """
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25,
+                 expert_axis: str = "dp"):
+        super().__init__()
+        self.gate = TopKGate(hidden_size, num_experts, top_k, capacity_factor)
+        self.experts = ExpertFFN(num_experts, hidden_size, intermediate_size)
+        self.expert_axis = expert_axis
+        self.aux_loss = None
+        self._shard_experts(expert_axis, num_experts)
+
+    def _shard_experts(self, axis: str, E: int):
+        from ..distributed.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            return
+        try:
+            deg = hcg.axis_degree(axis)
+        except KeyError:
+            return
+        if deg <= 1 or E % deg != 0:
+            return
+        mesh = hcg.mesh.mesh
+        for p in self.experts.parameters():
+            p._set_data(jax.device_put(p._data, NamedSharding(
+                mesh, PartitionSpec(axis))))
+
+    def forward(self, x):
+        b, s, h = x.shape
+        flat = x.reshape([b * s, h])
+        out, aux = call_op(
+            "moe_ffn", flat, self.gate.weight,
+            self.experts.gate_weight, self.experts.up_weight,
+            self.experts.down_weight,
+            top_k=self.gate.top_k,
+            capacity_factor=self.gate.capacity_factor,
+            expert_axis=self.expert_axis)
+        self.aux_loss = aux
+        return out.astype(x.dtype).reshape([b, s, h])

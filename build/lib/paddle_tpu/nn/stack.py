@@ -1,0 +1,210 @@
+"""LayerStack: N structurally-identical blocks stored as stacked parameters.
+
+Reference counterpart: deep transformer stacks in the reference are Python
+lists of N separate layers (e.g. `PipelineLayer` partitioning,
+`fleet/meta_parallel/parallel_layers/pp_layers.py:237`). TPU-first that is
+the wrong shape:
+- XLA traces/compiles N identical layer bodies (slow compiles),
+- pipeline parallelism wants the layer dimension to BE an array axis so it
+  can be sharded over the `pp` mesh axis and rotated with `ppermute`.
+
+LayerStack creates each parameter as one array with a leading [num_layers]
+axis and runs the block with `lax.scan` (optionally rematerialized per
+layer). The pipeline engine (distributed/pipeline.py) reshapes the leading
+axis to [stages, layers_per_stage] and shards it over `pp`.
+
+Autograd: under a compiled TrainStep/to_static the whole forward is
+jax-differentiated and the scan just works. In eager mode the stack records
+ONE tape node whose VJP is `jax.vjp` of the scanned body (the same
+one-node-per-subprogram design the compiled path uses, jit/api.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..autograd import engine
+from ..core.tensor import Tensor
+from .layer_base import Layer
+
+
+@contextlib.contextmanager
+def _swap(tensors, arrays):
+    saved = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    try:
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._data = s
+
+
+@contextlib.contextmanager
+def _local_rng(key):
+    """Route generator.next_key() through a local traced key (no-op if
+    key is None). Mirrors jit/api.py _traced_rng."""
+    if key is None:
+        yield
+        return
+    from ..core import generator
+    gen = generator.default_generator()
+    box = {"key": key}
+    orig = gen.next_key
+
+    def nk():
+        box["key"], sub = jax.random.split(box["key"])
+        return sub
+
+    gen.next_key = nk
+    try:
+        yield
+    finally:
+        gen.next_key = orig
+
+
+class LayerStack(Layer):
+    """Stack of `num_layers` blocks from `block_fn() -> Layer`.
+
+    Parameters are stored stacked: each leaf is [num_layers, *block_shape].
+    forward(x, *shared) scans the block over the leading axis; `shared`
+    args (rope tables, masks, position ids) go to every block unchanged.
+    """
+
+    def __init__(self, block_fn: Callable[[], Layer], num_layers: int,
+                 remat: bool = False):
+        super().__init__()
+        self.num_layers = int(num_layers)
+        self.remat = remat
+        template = block_fn()
+        # template provides structure + forward; its params must NOT be
+        # registered here (stacked tensors replace them)
+        object.__setattr__(self, "template", template)
+        t_params = list(template.parameters())
+        per_leaf: List[List[jax.Array]] = [[] for _ in t_params]
+        for i in range(self.num_layers):
+            blk = template if i == 0 else block_fn()
+            for j, p in enumerate(blk.parameters()):
+                per_leaf[j].append(p._data)
+        # at rest, the layer axis is sharded over pp (each stage's devices
+        # hold only their stage's weights), composing with any TP sharding
+        # the block installed on the other dims
+        pp_axis = None
+        hcg_mesh = None
+        from ..distributed.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if (hcg is not None and hcg.get_pipe_parallel_world_size() > 1
+                and self.num_layers % hcg.get_pipe_parallel_world_size() == 0):
+            pp_axis, hcg_mesh = "pp", hcg.mesh.mesh
+        for j, (tp, arrs) in enumerate(zip(t_params, per_leaf)):
+            stacked = jnp.stack(arrs)
+            sh = getattr(tp._data, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                stacked = jax.device_put(stacked, NamedSharding(
+                    sh.mesh, PartitionSpec(pp_axis, *sh.spec)))
+            elif hcg_mesh is not None:
+                stacked = jax.device_put(stacked, NamedSharding(
+                    hcg_mesh, PartitionSpec(pp_axis)))
+            self.add_parameter(
+                f"stacked_{j}", Tensor(stacked,
+                                       stop_gradient=tp.stop_gradient))
+        self._n_leaves = len(t_params)
+
+    def stacked_params(self) -> List[Tensor]:
+        return [self._parameters[f"stacked_{j}"]
+                for j in range(self._n_leaves)]
+
+    # the template is unregistered (its params are replaced by the stacked
+    # tensors), so train/eval must be forwarded by hand
+    def train(self):
+        super().train()
+        self.template.train()
+        return self
+
+    def eval(self):
+        super().eval()
+        self.template.eval()
+        return self
+
+    # -- pure functional views (used by the pipeline engine too) -------------
+    def apply_block(self, leaf_arrays, x_arr, shared_arrays, rng_key=None):
+        """One block, pure: (leaves, x, shared[, key]) -> y. All jax arrays.
+        rng_key, when given, feeds the global generator facade so rng-keyed
+        ops (dropout) stay pure under scan/shard_map tracing."""
+        t_params = list(self.template.parameters())
+        with _swap(t_params, list(leaf_arrays)), engine.no_grad(), \
+                _local_rng(rng_key):
+            shared = tuple(Tensor(s) if isinstance(s, jax.Array) else s
+                           for s in shared_arrays)
+            out = self.template(Tensor(x_arr), *shared)
+        return out._data if isinstance(out, Tensor) else out
+
+    def scan_apply(self, stacked_arrays, x_arr, shared_arrays, rng_key=None):
+        """All blocks via lax.scan, pure; per-layer rng keys ride the carry."""
+        from ..core import generator
+        if rng_key is None:
+            rng_key = generator.next_key()
+
+        def body(carry, leaves):
+            x, key = carry
+            key, sub = jax.random.split(key)
+            return (self.apply_block(leaves, x, shared_arrays, sub), key), None
+
+        if self.remat:
+            body = jax.checkpoint(body)
+        (y, _), _ = jax.lax.scan(body, (x_arr, rng_key),
+                                 tuple(stacked_arrays))
+        return y
+
+    # -- Layer API -----------------------------------------------------------
+    def forward(self, x, *shared):
+        from ..core import generator
+        params = self.stacked_params()
+        x_t = x if isinstance(x, Tensor) else Tensor(x)
+        shared_arrays = tuple(s._data if isinstance(s, Tensor) else s
+                              for s in shared)
+        rng = generator.next_key()  # once: fwd and vjp recompute share it
+
+        def pure(stacked_arrays, x_arr):
+            return self.scan_apply(stacked_arrays, x_arr, shared_arrays, rng)
+
+        return run_with_tape("layer_stack", pure, params, x_t)
+
+
+def run_with_tape(name: str, pure_fn, param_tensors, x_t: Tensor) -> Tensor:
+    """Run `pure_fn(param_arrays, x_arr) -> y_arr` and, in eager mode, record
+    one tape node whose VJP is jax.vjp of pure_fn (same one-node-per-
+    subprogram design as the compiled path, jit/api.py StaticFunction)."""
+    arrays = tuple(p._data for p in param_tensors)
+    y = pure_fn(arrays, x_t._data)
+    out = Tensor(y)
+
+    if engine.is_grad_enabled() and not isinstance(
+            x_t._data, jax.core.Tracer):
+        pmask = tuple(not p.stop_gradient for p in param_tensors)
+        diff_params = [p for p, m in zip(param_tensors, pmask) if m]
+        x_diff = (not x_t.stop_gradient
+                  and jnp.issubdtype(x_t.dtype, jnp.inexact))
+        parents = diff_params + ([x_t] if x_diff else [])
+        primals = tuple(p._data for p in diff_params) + (
+            (x_t._data,) if x_diff else ())
+        if parents:
+            def vjp_callable(primals_now, cts,
+                             _arrays=arrays, _x=x_t._data):
+                def f(*dp):
+                    it = iter(dp)
+                    st = tuple(next(it) if m else a
+                               for a, m in zip(_arrays, pmask))
+                    xx = next(it) if x_diff else _x
+                    return pure_fn(st, xx)
+
+                _, vjp = jax.vjp(f, *primals_now)
+                return vjp(cts[0])
+
+            engine.record_node(name, vjp_callable, primals, parents, [out])
+    return out

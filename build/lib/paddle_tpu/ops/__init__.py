@@ -1,0 +1,1 @@
+"""YAML-driven op library (reference paddle/phi/api/yaml + phi/kernels)."""

@@ -430,19 +430,7 @@ def call_op(name: str, *args, **kwargs):
         if kwargs:  # legacy call sites may use ProgramDesc I/O names (X=...)
             from .op_compat import resolve_io_kwargs
             kwargs = resolve_io_kwargs(name, kwargs)
-        return fn(*args, **kwargs)
-    try:
-        return fn(*args, **kwargs)
-    except TypeError:
-        if not kwargs:
-            raise
-        # modern op name called with legacy capitalized kwargs (Input=,
-        # Label=): translate once and retry; re-raise if nothing changed
-        from .op_compat import resolve_io_kwargs
-        translated = resolve_io_kwargs(name, kwargs)
-        if translated == kwargs:
-            raise
-        return fn(*args, **translated)
+    return fn(*args, **kwargs)
 
 
 def get_op(name: str) -> Callable:

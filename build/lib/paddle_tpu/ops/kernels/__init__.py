@@ -1,0 +1,22 @@
+"""Kernel library: pure-functional jax implementations keyed by kernel name.
+
+Analog of paddle/phi/kernels (426k LoC across cpu/gpu/xpu backends). Here a
+single functional implementation per op targets every backend through XLA;
+the hot set is overridden by Pallas hand-kernels (see
+paddle_tpu/ops/kernels/pallas/) routed by the same registry.
+"""
+
+from . import creation  # noqa: F401
+from . import math  # noqa: F401
+from . import manipulation  # noqa: F401
+from . import nn  # noqa: F401
+from . import random  # noqa: F401
+from . import linalg_fft  # noqa: F401
+from . import quant  # noqa: F401
+from . import rnn  # noqa: F401
+from . import serving  # noqa: F401
+from . import math_ext  # noqa: F401
+from . import moe  # noqa: F401
+from . import extra_math  # noqa: F401
+from . import extra_nn  # noqa: F401
+from . import extra_misc  # noqa: F401

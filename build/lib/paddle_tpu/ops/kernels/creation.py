@@ -1,0 +1,102 @@
+"""Tensor creation kernels (reference: paddle/phi/kernels/full_kernel.h etc.)."""
+
+import jax.numpy as jnp
+
+from ...core import dtype as dtype_mod
+from ..dispatcher import register_kernel
+
+
+def _dt(dtype, fallback_float=True):
+    if dtype is None:
+        return dtype_mod.get_default_dtype() if fallback_float else None
+    return dtype
+
+
+@register_kernel("full")
+def full(shape=(), fill_value=0.0, dtype=None):
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int32
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return jnp.full(shape, fill_value, dtype=dtype)
+
+
+@register_kernel("full_like")
+def full_like(x, fill_value=0.0, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+@register_kernel("zeros")
+def zeros(shape=(), dtype=None):
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+@register_kernel("ones")
+def ones(shape=(), dtype=None):
+    return jnp.ones(shape, dtype=_dt(dtype))
+
+
+@register_kernel("zeros_like")
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+@register_kernel("ones_like")
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+@register_kernel("arange")
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+@register_kernel("linspace")
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+@register_kernel("eye")
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype))
+
+
+@register_kernel("tril_indices")
+def tril_indices(rows, cols, offset=0):
+    r, c = jnp.tril_indices(rows, offset, cols)
+    return jnp.stack([r, c])
+
+
+@register_kernel("diag")
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+@register_kernel("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@register_kernel("meshgrid")
+def meshgrid(xs):
+    return jnp.meshgrid(*xs, indexing="ij")
+
+
+@register_kernel("assign")
+def assign(x):
+    return jnp.asarray(x)
+
+
+@register_kernel("empty")
+def empty(shape=(), dtype=None):
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+@register_kernel("empty_like")
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)

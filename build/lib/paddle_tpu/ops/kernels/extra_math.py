@@ -1,0 +1,401 @@
+"""Op-tranche kernels: math, losses, norms, indexing (round 2).
+
+Reference counterparts: paddle/phi/api/yaml/{ops,legacy_ops}.yaml entries
+with kernels under paddle/phi/kernels/{cpu,gpu}/ — each kernel cites its
+op name; semantics follow python/paddle public API docs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatcher import register_kernel
+
+_jsp = jax.scipy.special
+
+
+# -- special functions --------------------------------------------------------
+
+@register_kernel("gammaln")
+def gammaln_kernel(x):
+    return _jsp.gammaln(x)
+
+
+@register_kernel("gammaincc")
+def gammaincc_kernel(x, y):
+    return _jsp.gammaincc(x, y)
+
+
+@register_kernel("polygamma")
+def polygamma_kernel(x, n=1):
+    return _jsp.polygamma(int(n), x)
+
+
+@register_kernel("nextafter")
+def nextafter_kernel(x, y):
+    return jnp.nextafter(x, y)
+
+
+@register_kernel("stanh")
+def stanh_kernel(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_kernel("tanh_shrink")
+def tanh_shrink_kernel(x):
+    return x - jnp.tanh(x)
+
+
+@register_kernel("logspace")
+def logspace_kernel(start, stop, num, base=10.0, dtype=None):
+    out = jnp.logspace(float(start), float(stop), int(num),
+                       base=float(base))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_kernel("nanmedian")
+def nanmedian_kernel(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+@register_kernel("complex")
+def complex_kernel(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+@register_kernel("bitwise_left_shift")
+def bitwise_left_shift_kernel(x, y):
+    return jnp.left_shift(x, y)
+
+
+@register_kernel("bitwise_right_shift")
+def bitwise_right_shift_kernel(x, y):
+    return jnp.right_shift(x, y)
+
+
+@register_kernel("fmax")
+def fmax_kernel(x, y):
+    return jnp.fmax(x, y)
+
+
+@register_kernel("fmin")
+def fmin_kernel(x, y):
+    return jnp.fmin(x, y)
+
+
+# -- norms --------------------------------------------------------------------
+
+@register_kernel("dist")
+def dist_kernel(x, y, p=2.0):
+    d = (x - y).reshape(-1)
+    p = float(p)
+    if p == float("inf"):
+        return jnp.abs(d).max()
+    if p == 0:
+        return (d != 0).sum().astype(x.dtype)
+    return (jnp.abs(d) ** p).sum() ** (1.0 / p)
+
+
+@register_kernel("p_norm")
+def p_norm_kernel(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False,
+                  asvector=False):
+    if asvector:
+        x = x.reshape(-1)
+        axis = 0
+    p = float(porder)
+    if p == float("inf"):
+        return jnp.abs(x).max(axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.abs(x).min(axis=axis, keepdims=keepdim)
+    if p == 0:
+        return (x != 0).sum(axis=axis, keepdims=keepdim).astype(x.dtype)
+    s = (jnp.abs(x) ** p).sum(axis=axis, keepdims=keepdim)
+    return jnp.maximum(s, epsilon) ** (1.0 / p)
+
+
+@register_kernel("frobenius_norm")
+def frobenius_norm_kernel(x, axis=None, keepdim=False):
+    ax = tuple(axis) if axis is not None else None
+    return jnp.sqrt((x.astype(jnp.float32) ** 2)
+                    .sum(axis=ax, keepdims=keepdim)).astype(x.dtype)
+
+
+@register_kernel("squared_l2_norm")
+def squared_l2_norm_kernel(x):
+    return (x.astype(jnp.float32) ** 2).sum().astype(x.dtype)
+
+
+@register_kernel("clip_by_norm")
+def clip_by_norm_kernel(x, max_norm):
+    norm = jnp.sqrt((x.astype(jnp.float32) ** 2).sum())
+    scale = jnp.minimum(1.0, float(max_norm) / jnp.maximum(norm, 1e-12))
+    return (x * scale.astype(x.dtype))
+
+
+@register_kernel("add_n")
+def add_n_kernel(inputs):
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+@register_kernel("mean_all")
+def mean_all_kernel(x):
+    return x.mean()
+
+
+# -- losses -------------------------------------------------------------------
+
+@register_kernel("label_smooth")
+def label_smooth_kernel(label, prior_dist=None, epsilon=0.1):
+    c = label.shape[-1]
+    uniform = (prior_dist if prior_dist is not None
+               else jnp.full((c,), 1.0 / c, label.dtype))
+    return (1.0 - epsilon) * label + epsilon * uniform
+
+
+@register_kernel("huber_loss")
+def huber_loss_kernel(input, label, delta=1.0):
+    r = input - label
+    a = jnp.abs(r)
+    return jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+
+
+@register_kernel("bce_loss")
+def bce_loss_kernel(input, label):
+    eps = 1e-12
+    x = jnp.clip(input, eps, 1.0 - eps)
+    return -(label * jnp.log(x) + (1.0 - label) * jnp.log(1.0 - x))
+
+
+@register_kernel("kldiv_loss")
+def kldiv_loss_kernel(x, label, reduction="mean", log_target=False):
+    if log_target:
+        out = jnp.exp(label) * (label - x)
+    else:
+        out = jnp.where(label > 0, label * (jnp.log(label) - x), 0.0)
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "batchmean":
+        return out.sum() / x.shape[0]
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+@register_kernel("log_loss")
+def log_loss_kernel(input, label, epsilon=1e-4):
+    return (-label * jnp.log(input + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+
+@register_kernel("sigmoid_cross_entropy_with_logits")
+def sigmoid_ce_kernel(x, label, pos_weight=None, normalize=False,
+                      ignore_index=-100):
+    # numerically stable: max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = loss * log_w
+    mask = (label != ignore_index)
+    loss = jnp.where(mask, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(mask.sum().astype(loss.dtype), 1.0)
+    return loss
+
+
+@register_kernel("accuracy")
+def accuracy_kernel(x, label, k=1):
+    """[N, C] scores vs [N]/[N,1] labels -> top-k accuracy scalar."""
+    lbl = label.reshape(label.shape[0], -1)[:, 0]
+    _, top = jax.lax.top_k(x, int(k))
+    hit = (top == lbl[:, None]).any(axis=1)
+    return hit.mean(dtype=jnp.float32)
+
+
+# -- indexing / shape utility -------------------------------------------------
+
+@register_kernel("is_empty")
+def is_empty_kernel(x):
+    return jnp.asarray(x.size == 0)
+
+
+@register_kernel("shape_op")
+def shape_kernel(x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+@register_kernel("fill")
+def fill_kernel(x, value=0.0):
+    return jnp.full_like(x, value)
+
+
+@register_kernel("assign_value")
+def assign_value_kernel(shape=(), dtype="float32", values=()):
+    return jnp.asarray(np.asarray(values).reshape(shape), dtype=dtype)
+
+
+@register_kernel("reverse")
+def reverse_kernel(x, axis=()):
+    ax = [axis] if isinstance(axis, int) else list(axis)
+    return jnp.flip(x, axis=ax if ax else None)
+
+
+@register_kernel("unique_consecutive")
+def unique_consecutive_kernel(x, return_inverse=False, return_counts=False,
+                              axis=None, dtype="int64"):
+    """Dynamic output size — eager/host op (jit: false in ops.yaml)."""
+    a = np.asarray(x)
+    if axis is None:
+        a = a.reshape(-1)
+        change = np.concatenate([[True], a[1:] != a[:-1]])
+    else:
+        moved = np.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        change = np.concatenate(
+            [[True], (flat[1:] != flat[:-1]).any(axis=1)])
+    idx = np.nonzero(change)[0]
+    out = (a[idx] if axis is None
+           else np.moveaxis(np.moveaxis(a, axis, 0)[idx], 0, axis))
+    res = [jnp.asarray(out)]
+    if return_inverse:
+        res.append(jnp.asarray(np.cumsum(change) - 1, np.int32))
+    if return_counts:
+        res.append(jnp.asarray(
+            np.diff(np.append(idx, len(change))), np.int32))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+@register_kernel("index_sample")
+def index_sample_kernel(x, index):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+@register_kernel("index_put")
+def index_put_kernel(x, indices, value, accumulate=False):
+    idx = tuple(i.astype(jnp.int32) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value.astype(x.dtype))
+    return x.at[idx].set(value.astype(x.dtype))
+
+
+@register_kernel("repeat_interleave_with_tensor_index")
+def repeat_interleave_tensor_kernel(x, repeats, axis=0):
+    """Dynamic output — host op (jit: false)."""
+    return jnp.asarray(np.repeat(np.asarray(x), np.asarray(repeats),
+                                 axis=axis))
+
+
+@register_kernel("shard_index")
+def shard_index_kernel(input, index_num, nshards, shard_id,
+                       ignore_value=-1):
+    shard_size = (int(index_num) + int(nshards) - 1) // int(nshards)
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    inside = (input >= lo) & (input < hi)
+    return jnp.where(inside, input - lo, ignore_value).astype(input.dtype)
+
+
+@register_kernel("edit_distance")
+def edit_distance_kernel(hyps, refs, hypslength=None, refslength=None,
+                         normalized=True):
+    """Batched Levenshtein DP (reference edit_distance_kernel). Host op
+    (dynamic per-row lengths drive Python loops; jit: false)."""
+    h = np.asarray(hyps)
+    r = np.asarray(refs)
+    B = h.shape[0]
+    hl = (np.asarray(hypslength) if hypslength is not None
+          else np.full(B, h.shape[1]))
+    rl = (np.asarray(refslength) if refslength is not None
+          else np.full(B, r.shape[1]))
+    out = np.zeros((B, 1), np.float32)
+    for b in range(B):
+        m, n = int(hl[b]), int(rl[b])
+        dp = np.arange(n + 1, dtype=np.int64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                cost = 0 if h[b, i - 1] == r[b, j - 1] else 1
+                dp[j] = min(dp[j - 1] + 1, prev[j] + 1, prev[j - 1] + cost)
+        d = float(dp[n])
+        out[b, 0] = d / max(n, 1) if normalized else d
+    return jnp.asarray(out), jnp.asarray([B], jnp.int64)
+
+
+@register_kernel("as_strided")
+def as_strided_kernel(x, shape=(), stride=(), offset=0):
+    """Strided view as a gather (functional: copies, grads flow)."""
+    flat = x.reshape(-1)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    idx = jnp.asarray(int(offset))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij") \
+        if shape else []
+    lin = sum((g * st for g, st in zip(grids, stride)),
+              jnp.zeros(shape, jnp.int32)) + idx
+    return flat[lin.reshape(-1).astype(jnp.int32)].reshape(shape)
+
+
+@register_kernel("view_dtype")
+def view_dtype_kernel(x, dtype):
+    return jax.lax.bitcast_convert_type(x, dtype)
+
+
+@register_kernel("tensor_unfold")
+def tensor_unfold_kernel(x, axis=0, size=1, step=1):
+    """Sliding windows along `axis`: [..., n, ...] -> [..., n_win, ..., size]."""
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    n_win = (n - int(size)) // int(step) + 1
+    starts = jnp.arange(n_win) * int(step)
+    win = starts[:, None] + jnp.arange(int(size))[None, :]   # [n_win, size]
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved[win]                       # [n_win, size, ...rest]
+    out = jnp.moveaxis(out, 1, -1)         # window dim last (paddle layout)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register_kernel("set_value")
+def set_value_kernel(x, value=None, starts=(), ends=(), steps=(), axes=(),
+                     shape=()):
+    """x[slices] = value (reference set_value op). Slices are static attrs."""
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, steps):
+        idx[a] = slice(int(s), int(e), int(st))
+    val = value if value is not None else jnp.zeros((), x.dtype)
+    return x.at[tuple(idx)].set(jnp.asarray(val).astype(x.dtype))
+
+
+@register_kernel("einsum")
+def einsum_kernel(operands, equation=""):
+    return jnp.einsum(equation, *operands)
+
+
+@register_kernel("nms")
+def nms_kernel(boxes, scores=None, iou_threshold=0.3):
+    """Greedy hard-NMS on [N,4] boxes (reference nms op). Dynamic output
+    size — host op (jit: false); returns kept indices sorted by score."""
+    b = np.asarray(boxes, np.float32)
+    s = (np.asarray(scores, np.float32) if scores is not None
+         else np.arange(len(b), 0, -1, dtype=np.float32))
+    order = np.argsort(-s)
+    keep = []
+    area = (b[:, 2] - b[:, 0]).clip(0) * (b[:, 3] - b[:, 1]).clip(0)
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(b[i, 0], b[rest, 0])
+        yy1 = np.maximum(b[i, 1], b[rest, 1])
+        xx2 = np.minimum(b[i, 2], b[rest, 2])
+        yy2 = np.minimum(b[i, 3], b[rest, 3])
+        inter = (xx2 - xx1).clip(0) * (yy2 - yy1).clip(0)
+        iou = inter / np.maximum(area[i] + area[rest] - inter, 1e-10)
+        order = rest[iou <= iou_threshold]
+    return jnp.asarray(np.asarray(keep, np.int64))

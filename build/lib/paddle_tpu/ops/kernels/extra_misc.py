@@ -1,0 +1,565 @@
+"""Op-tranche kernels: random samplers, functional optimizer ops, AMP ops,
+collective ops, fused ops, linalg extras.
+
+Reference counterparts: the optimizer op family (phi/kernels/*/sgd_kernel,
+adam_kernel, ...; exposed as `_C_ops.adam_` etc), AMP ops
+(check_finite_and_unscale_kernel, update_loss_scaling_kernel), static-graph
+collective ops (paddle/fluid/operators/collective/c_*), and the fused
+transformer helper ops (phi/kernels/fusion/).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatcher import register_kernel
+
+
+# -- random samplers ----------------------------------------------------------
+
+@register_kernel("binomial")
+def binomial_kernel(count, prob, key=None):
+    return jax.random.binomial(key, count.astype(jnp.float32),
+                               prob.astype(jnp.float32)).astype(jnp.int32)
+
+
+@register_kernel("dirichlet")
+def dirichlet_kernel(alpha, key=None):
+    return jax.random.dirichlet(key, alpha.astype(jnp.float32)) \
+        .astype(alpha.dtype)
+
+
+@register_kernel("standard_gamma")
+def standard_gamma_kernel(x, key=None):
+    return jax.random.gamma(key, x.astype(jnp.float32)).astype(x.dtype)
+
+
+@register_kernel("truncated_gaussian_random")
+def truncated_gaussian_kernel(key=None, shape=(), mean=0.0, std=1.0,
+                              a=-2.0, b=2.0, dtype="float32"):
+    z = jax.random.truncated_normal(key, float(a), float(b),
+                                    tuple(int(s) for s in shape))
+    return (z * std + mean).astype(dtype)
+
+
+@register_kernel("exponential")
+def exponential_kernel(x, key=None, lam=1.0):
+    u = jax.random.uniform(key, x.shape, jnp.float32, 1e-9, 1.0)
+    return (-jnp.log(u) / float(lam)).astype(x.dtype)
+
+
+# -- functional optimizer ops (reference adam_kernel etc.) --------------------
+# Each returns the updated state; the trailing-underscore public ops are
+# declared inplace in ops.yaml so `_C_ops.sgd_(param, ...)` mutates like
+# the reference.
+
+@register_kernel("sgd_op")
+def sgd_op_kernel(param, learning_rate, grad, master_param=None,
+                  multi_precision=False):
+    p = master_param if master_param is not None else param
+    new_p = p - learning_rate.astype(p.dtype) * grad.astype(p.dtype)
+    if master_param is not None:
+        return new_p.astype(param.dtype), new_p
+    return new_p
+
+
+@register_kernel("momentum_op")
+def momentum_op_kernel(param, grad, velocity, learning_rate,
+                       master_param=None, mu=0.9, use_nesterov=False,
+                       regularization_method="", regularization_coeff=0.0,
+                       multi_precision=False, rescale_grad=1.0):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32) * float(rescale_grad)
+    if regularization_method == "l2_decay":
+        g = g + float(regularization_coeff) * p
+    v = float(mu) * velocity.astype(jnp.float32) + g
+    lr = learning_rate.astype(jnp.float32)
+    if use_nesterov:
+        new_p = p - (g + float(mu) * v) * lr
+    else:
+        new_p = p - v * lr
+    outs = [new_p.astype(param.dtype), v]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+def _adam_core(param, grad, lr, m1, m2, b1p, b2p, master_param, beta1,
+               beta2, epsilon, lazy=False):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32)
+    m1n = beta1 * m1.astype(jnp.float32) + (1 - beta1) * g
+    m2n = beta2 * m2.astype(jnp.float32) + (1 - beta2) * g * g
+    b1n = b1p.astype(jnp.float32) * beta1
+    b2n = b2p.astype(jnp.float32) * beta2
+    lr_t = lr.astype(jnp.float32) * jnp.sqrt(1 - b2n) / (1 - b1n)
+    new_p = p - lr_t * m1n / (jnp.sqrt(m2n) + epsilon)
+    return new_p, m1n, m2n, b1n, b2n
+
+
+@register_kernel("adam_op")
+def adam_op_kernel(param, grad, learning_rate, moment1, moment2,
+                   beta1_pow, beta2_pow, master_param=None,
+                   skip_update=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                   lazy_mode=False, multi_precision=False):
+    new_p, m1, m2, b1, b2 = _adam_core(
+        param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
+        master_param, float(beta1), float(beta2), float(epsilon))
+    outs = [new_p.astype(param.dtype), m1, m2, b1, b2]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+@register_kernel("adamw_op")
+def adamw_op_kernel(param, grad, learning_rate, moment1, moment2,
+                    beta1_pow, beta2_pow, master_param=None,
+                    skip_update=None, beta1=0.9, beta2=0.999,
+                    epsilon=1e-8, lr_ratio=1.0, coeff=0.01,
+                    with_decay=True, multi_precision=False):
+    p0 = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    lr = learning_rate.astype(jnp.float32) * float(lr_ratio)
+    if with_decay:
+        p0 = p0 * (1.0 - lr * float(coeff))
+    base = p0.astype(param.dtype)
+    new_p, m1, m2, b1, b2 = _adam_core(
+        base, grad, jnp.asarray(lr), moment1, moment2, beta1_pow,
+        beta2_pow, p0 if master_param is not None else None,
+        float(beta1), float(beta2), float(epsilon))
+    outs = [new_p.astype(param.dtype), m1, m2, b1, b2]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+@register_kernel("adagrad_op")
+def adagrad_op_kernel(param, grad, moment, learning_rate,
+                      master_param=None, epsilon=1e-6,
+                      multi_precision=False):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32)
+    m = moment.astype(jnp.float32) + g * g
+    new_p = p - learning_rate.astype(jnp.float32) * g \
+        / (jnp.sqrt(m) + float(epsilon))
+    outs = [new_p.astype(param.dtype), m]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+@register_kernel("adadelta_op")
+def adadelta_op_kernel(param, grad, avg_squared_grad, avg_squared_update,
+                       learning_rate=None, master_param=None, rho=0.95,
+                       epsilon=1e-6, multi_precision=False):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32)
+    rho = float(rho)
+    eps = float(epsilon)
+    asg = rho * avg_squared_grad.astype(jnp.float32) + (1 - rho) * g * g
+    upd = (jnp.sqrt(avg_squared_update.astype(jnp.float32) + eps)
+           / jnp.sqrt(asg + eps)) * g
+    asu = rho * avg_squared_update.astype(jnp.float32) \
+        + (1 - rho) * upd * upd
+    lr = (learning_rate.astype(jnp.float32)
+          if learning_rate is not None else 1.0)
+    new_p = p - lr * upd
+    outs = [new_p.astype(param.dtype), asg, asu]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+@register_kernel("adamax_op")
+def adamax_op_kernel(param, grad, learning_rate, moment, inf_norm,
+                     beta1_pow, master_param=None, beta1=0.9, beta2=0.999,
+                     epsilon=1e-8, multi_precision=False):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32)
+    m = float(beta1) * moment.astype(jnp.float32) + (1 - float(beta1)) * g
+    n = jnp.maximum(float(beta2) * inf_norm.astype(jnp.float32),
+                    jnp.abs(g))
+    lr = learning_rate.astype(jnp.float32) \
+        / (1 - beta1_pow.astype(jnp.float32))
+    new_p = p - lr * m / (n + float(epsilon))
+    outs = [new_p.astype(param.dtype), m, n]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+@register_kernel("rmsprop_op")
+def rmsprop_op_kernel(param, mean_square, grad, moment, learning_rate,
+                      mean_grad=None, master_param=None, epsilon=1e-10,
+                      decay=0.9, momentum=0.0, centered=False,
+                      multi_precision=False):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32)
+    ms = float(decay) * mean_square.astype(jnp.float32) \
+        + (1 - float(decay)) * g * g
+    if centered and mean_grad is not None:
+        mg = float(decay) * mean_grad.astype(jnp.float32) \
+            + (1 - float(decay)) * g
+        denom = jnp.sqrt(ms - mg * mg + float(epsilon))
+    else:
+        mg = None
+        denom = jnp.sqrt(ms + float(epsilon))
+    mom = float(momentum) * moment.astype(jnp.float32) \
+        + learning_rate.astype(jnp.float32) * g / denom
+    new_p = p - mom
+    outs = [new_p.astype(param.dtype), mom, ms]
+    if mg is not None:
+        outs.append(mg)
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+@register_kernel("lamb_op")
+def lamb_op_kernel(param, grad, learning_rate, moment1, moment2,
+                   beta1_pow, beta2_pow, master_param=None, weight_decay=0.01,
+                   beta1=0.9, beta2=0.999, epsilon=1e-6,
+                   always_adapt=False, multi_precision=False):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32)
+    m1 = float(beta1) * moment1.astype(jnp.float32) + (1 - float(beta1)) * g
+    m2 = float(beta2) * moment2.astype(jnp.float32) \
+        + (1 - float(beta2)) * g * g
+    b1 = beta1_pow.astype(jnp.float32) * float(beta1)
+    b2 = beta2_pow.astype(jnp.float32) * float(beta2)
+    mhat = m1 / (1 - b1)
+    vhat = m2 / (1 - b2)
+    r = mhat / (jnp.sqrt(vhat) + float(epsilon)) + float(weight_decay) * p
+    p_norm = jnp.sqrt((p * p).sum())
+    r_norm = jnp.sqrt((r * r).sum())
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    new_p = p - learning_rate.astype(jnp.float32) * trust * r
+    outs = [new_p.astype(param.dtype), m1, m2, b1, b2]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+@register_kernel("asgd_op")
+def asgd_op_kernel(param, grad, learning_rate, d, y, n,
+                   master_param=None, multi_precision=False):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32)
+    dn = d.astype(jnp.float32) - y.astype(jnp.float32) + g
+    yn = g
+    new_p = p - learning_rate.astype(jnp.float32) \
+        * dn / jnp.maximum(n.astype(jnp.float32), 1.0)
+    outs = [new_p.astype(param.dtype), dn, yn]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+@register_kernel("rprop_op")
+def rprop_op_kernel(param, grad, prev, learning_rate, master_param=None,
+                    learning_rate_range=(1e-6, 50.0), etas=(0.5, 1.2),
+                    multi_precision=False):
+    p = (master_param if master_param is not None else param) \
+        .astype(jnp.float32)
+    g = grad.astype(jnp.float32)
+    pg = prev.astype(jnp.float32)
+    lr = learning_rate.astype(jnp.float32)
+    sign = jnp.sign(g * pg)
+    eta_n, eta_p = float(etas[0]), float(etas[1])
+    factor = jnp.where(sign > 0, eta_p, jnp.where(sign < 0, eta_n, 1.0))
+    lr_new = jnp.clip(lr * factor, float(learning_rate_range[0]),
+                      float(learning_rate_range[1]))
+    g_eff = jnp.where(sign < 0, 0.0, g)
+    new_p = p - jnp.sign(g_eff) * lr_new
+    outs = [new_p.astype(param.dtype), g_eff, lr_new]
+    if master_param is not None:
+        outs.append(new_p)
+    return tuple(outs)
+
+
+# -- AMP ops ------------------------------------------------------------------
+
+@register_kernel("check_finite_and_unscale_op")
+def check_finite_and_unscale_kernel(xs, scale):
+    inv = 1.0 / scale.astype(jnp.float32)
+    outs = [x * inv.astype(x.dtype) for x in xs]
+    finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(o)) for o in outs])) \
+        if outs else jnp.asarray(True)
+    return tuple(outs) + (~finite,)
+
+
+@register_kernel("update_loss_scaling_op")
+def update_loss_scaling_kernel(xs, found_infinite, prev_loss_scaling,
+                               in_good_steps, in_bad_steps,
+                               incr_every_n_steps=1000,
+                               decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                               decr_ratio=0.5, stop_update=False):
+    found = found_infinite.astype(jnp.bool_)
+    good = in_good_steps.astype(jnp.int32)
+    bad = in_bad_steps.astype(jnp.int32)
+    scale = prev_loss_scaling.astype(jnp.float32)
+    good_n = jnp.where(found, 0, good + 1)
+    bad_n = jnp.where(found, bad + 1, 0)
+    scale_up = jnp.where(good_n >= incr_every_n_steps,
+                         scale * float(incr_ratio), scale)
+    good_n = jnp.where(good_n >= incr_every_n_steps, 0, good_n)
+    scale_dn = jnp.where(bad_n >= decr_every_n_nan_or_inf,
+                         jnp.maximum(scale * float(decr_ratio), 1.0),
+                         scale_up)
+    bad_n = jnp.where(bad_n >= decr_every_n_nan_or_inf, 0, bad_n)
+    new_scale = jnp.where(jnp.asarray(bool(stop_update)), scale, scale_dn)
+    outs = tuple(jnp.where(found, jnp.zeros_like(x), x) for x in xs)
+    return outs + (new_scale.astype(prev_loss_scaling.dtype), good_n, bad_n)
+
+
+# -- collective ops (static-graph c_* family; eager shard_map lowering) -------
+
+def _collective_tensor(x, fn, **kw):
+    """Delegate to the eager collective API (jit: false ops — they act on
+    concrete shardings)."""
+    from ...core.tensor import Tensor
+    from ...distributed import collective
+    t = Tensor(x)
+    getattr(collective, fn)(t, **kw)
+    return t._data
+
+
+@register_kernel("c_allreduce_sum")
+def c_allreduce_sum_kernel(x, ring_id=0, use_calc_stream=True):
+    return _collective_tensor(x, "all_reduce", op="sum")
+
+
+@register_kernel("c_allreduce_max")
+def c_allreduce_max_kernel(x, ring_id=0, use_calc_stream=True):
+    return _collective_tensor(x, "all_reduce", op="max")
+
+
+@register_kernel("c_allreduce_min")
+def c_allreduce_min_kernel(x, ring_id=0, use_calc_stream=True):
+    return _collective_tensor(x, "all_reduce", op="min")
+
+
+@register_kernel("c_allreduce_prod")
+def c_allreduce_prod_kernel(x, ring_id=0, use_calc_stream=True):
+    return _collective_tensor(x, "all_reduce", op="prod")
+
+
+@register_kernel("c_broadcast")
+def c_broadcast_kernel(x, root=0, ring_id=0):
+    from ...core.tensor import Tensor
+    from ...distributed import collective
+    t = Tensor(x)
+    collective.broadcast(t, src=root)
+    return t._data
+
+
+@register_kernel("c_identity")
+def c_identity_kernel(x, ring_id=0, use_calc_stream=True,
+                      use_model_parallel=True):
+    return x
+
+
+@register_kernel("c_concat")
+def c_concat_kernel(x, rank=0, nranks=1, ring_id=0):
+    """Gather model-parallel shards along the last dim: under GSPMD the
+    global tensor already holds every shard — concat is a resharding to
+    replicated (identity on values)."""
+    return x
+
+
+@register_kernel("c_embedding")
+def c_embedding_kernel(table, ids, start_index=0, vocab_size=-1):
+    """Vocab-parallel embedding shard lookup (c_embedding_op.cu): rows
+    outside [start_index, start_index + rows) contribute zeros."""
+    n = table.shape[0]
+    local = ids.astype(jnp.int32) - int(start_index)
+    inside = (local >= 0) & (local < n)
+    safe = jnp.clip(local, 0, n - 1)
+    out = jnp.take(table, safe, axis=0)
+    return jnp.where(inside[..., None], out, 0).astype(table.dtype)
+
+
+# -- fused ops ----------------------------------------------------------------
+
+@register_kernel("fused_dropout_add")
+def fused_dropout_add_kernel(x, y, key=None, p=0.5, training=True,
+                             mode="upscale_in_train"):
+    if not training or p == 0.0:
+        return x + y
+    keep = 1.0 - float(p)
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        xd = jnp.where(mask, x / keep, 0.0)
+    else:
+        xd = jnp.where(mask, x, 0.0)
+    return (xd + y).astype(x.dtype)
+
+
+@register_kernel("fused_softmax_mask")
+def fused_softmax_mask_kernel(x, mask):
+    return jax.nn.softmax(x.astype(jnp.float32)
+                          + mask.astype(jnp.float32), axis=-1) \
+        .astype(x.dtype)
+
+
+@register_kernel("fused_softmax_mask_upper_triangle")
+def fused_softmax_mask_upper_triangle_kernel(x):
+    s = x.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (x.shape[-2], s), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (x.shape[-2], s), 1)
+    logits = jnp.where(cols <= rows, x.astype(jnp.float32), -1e30)
+    return jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+
+
+@register_kernel("fused_gemm_epilogue")
+def fused_gemm_epilogue_kernel(x, y, bias, trans_x=False, trans_y=False,
+                               activation="none"):
+    a = x.T if trans_x else x
+    b = y.T if trans_y else y
+    out = jnp.matmul(a, b) + bias
+    if activation == "relu":
+        out = jax.nn.relu(out)
+    elif activation == "gelu":
+        out = jax.nn.gelu(out)
+    return out
+
+
+@register_kernel("fused_bias_act")
+def fused_bias_act_kernel(x, bias=None, act_method="gelu"):
+    out = x + bias if bias is not None else x
+    if act_method == "gelu":
+        return jax.nn.gelu(out)
+    if act_method == "relu":
+        return jax.nn.relu(out)
+    if act_method in ("swiglu", "silu"):
+        return jax.nn.silu(out)
+    return out
+
+
+@register_kernel("fused_linear_param_grad_add")
+def fused_linear_param_grad_add_kernel(x, dout, dweight=None, dbias=None,
+                                       multi_precision=True,
+                                       has_bias=True):
+    xf = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    df = dout.reshape(-1, dout.shape[-1]).astype(jnp.float32)
+    dw = xf.T @ df
+    if dweight is not None:
+        dw = dw + dweight.astype(jnp.float32)
+    outs = [dw]
+    if has_bias:
+        db = df.sum(axis=0)
+        if dbias is not None:
+            db = db + dbias.astype(jnp.float32)
+        outs.append(db)
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register_kernel("top_p_sampling")
+def top_p_sampling_kernel(x, ps, threshold=None, key=None):
+    """Per-row nucleus sampling (reference top_p_sampling fused op).
+    x [B, V] logits; ps [B] per-row p. Returns (ids [B,1], scores [B,1])."""
+    logits = x.astype(jnp.float32)
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < ps.astype(jnp.float32)[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+    filt = jnp.where(logits < cutoff, -jnp.inf, logits)
+    ids = jax.random.categorical(key, filt, axis=-1)
+    scores = jnp.take_along_axis(jax.nn.softmax(logits, axis=-1),
+                                 ids[:, None], axis=-1)
+    return ids[:, None].astype(jnp.int64), scores
+
+
+@register_kernel("memory_efficient_attention")
+def memory_efficient_attention_kernel(query, key, value, attn_mask=None,
+                                      rng_key=None, dropout_p=0.0,
+                                      scale=None, is_causal=False):
+    from .nn import scaled_dot_product_attention
+    return scaled_dot_product_attention(query, key, value,
+                                        attn_mask=attn_mask,
+                                        dropout_p=dropout_p,
+                                        is_causal=is_causal, scale=scale,
+                                        rng_key=rng_key)
+
+
+# -- linalg extras ------------------------------------------------------------
+
+@register_kernel("matrix_rank")
+def matrix_rank_kernel(x, tol=None, hermitian=False):
+    if hermitian:
+        s = jnp.abs(jnp.linalg.eigvalsh(x))
+    else:
+        s = jnp.linalg.svd(x, compute_uv=False)
+    if tol is None:
+        t = s.max(axis=-1, keepdims=True) * max(x.shape[-2:]) \
+            * jnp.finfo(x.dtype).eps
+    else:
+        t = jnp.asarray(tol)
+        while t.ndim < s.ndim:
+            t = t[..., None]
+    return (s > t).sum(axis=-1).astype(jnp.int32)
+
+
+@register_kernel("lu_unpack")
+def lu_unpack_kernel(x, y, unpack_ludata=True, unpack_pivots=True):
+    """x: packed LU [.., M, N]; y: pivots [.., min(M,N)] (1-based like the
+    reference). Returns (P, L, U)."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(x[..., :, :k], -1) + jnp.eye(m, k, dtype=x.dtype)
+    U = jnp.triu(x[..., :k, :])
+
+    def perm_of(piv):
+        perm = jnp.arange(m)
+
+        def body(i, p):
+            j = piv[i] - 1  # pivots are 1-based
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        return jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+
+    piv = y.astype(jnp.int32)
+    if piv.ndim == 1:
+        perm = perm_of(piv)
+        P = jnp.eye(m, dtype=x.dtype)[perm].T
+    else:
+        flat = piv.reshape(-1, piv.shape[-1])
+        perms = jax.vmap(perm_of)(flat)
+        P = jnp.eye(m, dtype=x.dtype)[perms].transpose(0, 2, 1) \
+            .reshape(x.shape[:-2] + (m, m))
+    return P, L, U
+
+
+@register_kernel("fft_c2c")
+def fft_c2c_kernel(x, axes=(-1,), normalization="backward", forward=True):
+    fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return fn(x, axes=tuple(axes), norm=normalization)
+
+
+@register_kernel("fft_r2c")
+def fft_r2c_kernel(x, axes=(-1,), normalization="backward", forward=True,
+                   onesided=True):
+    if onesided:
+        return jnp.fft.rfftn(x, axes=tuple(axes), norm=normalization)
+    return jnp.fft.fftn(x.astype(jnp.complex64), axes=tuple(axes),
+                        norm=normalization)
+
+
+@register_kernel("fft_c2r")
+def fft_c2r_kernel(x, axes=(-1,), normalization="backward", forward=False,
+                   last_dim_size=0):
+    n = int(last_dim_size) or None
+    return jnp.fft.irfftn(x, s=None if n is None else
+                          tuple([n]), axes=tuple(axes), norm=normalization)

@@ -1,0 +1,812 @@
+"""Op-tranche kernels: nn / vision / pooling / conv3d / interpolation.
+
+Reference counterparts: per-op phi kernels (grid_sample_kernel.cu,
+pool_kernel.cu, interpolate_kernel.cu, ...); semantics follow the
+python/paddle public API. Layouts are NCHW/NCDHW like the reference
+defaults.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatcher import register_kernel
+
+
+# -- sampling / geometry ------------------------------------------------------
+
+@register_kernel("grid_sample")
+def grid_sample_kernel(x, grid, mode="bilinear", padding_mode="zeros",
+                      align_corners=True):
+    """x [N,C,H,W], grid [N,Hg,Wg,2] in [-1,1] -> [N,C,Hg,Wg]."""
+    N, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) * 0.5 * (size - 1)
+        return ((g + 1.0) * size - 1.0) * 0.5
+
+    fx, fy = unnorm(gx, W), unnorm(gy, H)
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, W - 1)
+        fy = jnp.clip(fy, 0, H - 1)
+    elif padding_mode == "reflection":
+        def reflect(f, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                f = jnp.abs(jnp.mod(f, span))
+                return jnp.where(f > size - 1, span - f, f)
+            span = 2 * size
+            f = jnp.mod(jnp.abs(f + 0.5), span)
+            f = jnp.where(f > size, span - f, f) - 0.5
+            return jnp.clip(f, 0, size - 1)
+        fx, fy = reflect(fx, W), reflect(fy, H)
+
+    def sample(ix, iy):
+        inb = ((ix >= 0) & (ix < W) & (iy >= 0) & (iy < H))
+        ixc = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        bidx = jnp.arange(N)[:, None, None]
+        v = x[bidx, :, iyc, ixc]              # [N,Hg,Wg,C]
+        v = jnp.where(inb[..., None], v, 0.0)
+        return v
+
+    if mode == "nearest":
+        out = sample(jnp.round(fx), jnp.round(fy))
+    else:
+        x0, y0 = jnp.floor(fx), jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (fx - x0) * (y1 - fy)
+        wc = (x1 - fx) * (fy - y0)
+        wd = (fx - x0) * (fy - y0)
+        out = (sample(x0, y0) * wa[..., None] + sample(x1, y0) * wb[..., None]
+               + sample(x0, y1) * wc[..., None]
+               + sample(x1, y1) * wd[..., None])
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+
+
+@register_kernel("affine_grid")
+def affine_grid_kernel(theta, output_shape=(), align_corners=True):
+    """theta [N,2,3], output_shape (N,C,H,W) -> grid [N,H,W,2]."""
+    N, _, H, W = [int(s) for s in output_shape]
+
+    def lin(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys, xs = jnp.meshgrid(lin(H), lin(W), indexing="ij")
+    base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)   # [H,W,3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta.astype(jnp.float32))
+    return grid.astype(theta.dtype)
+
+
+# -- shuffles / shifts --------------------------------------------------------
+
+@register_kernel("pixel_unshuffle")
+def pixel_unshuffle_kernel(x, downscale_factor=1, data_format="NCHW"):
+    r = int(downscale_factor)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    N, C, H, W = x.shape
+    out = x.reshape(N, C, H // r, r, W // r, r)
+    out = out.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * r * r, H // r,
+                                                  W // r)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("channel_shuffle")
+def channel_shuffle_kernel(x, groups=1, data_format="NCHW"):
+    g = int(groups)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    N, C, H, W = x.shape
+    out = x.reshape(N, g, C // g, H, W).transpose(0, 2, 1, 3, 4) \
+        .reshape(N, C, H, W)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("temporal_shift")
+def temporal_shift_kernel(x, seg_num=1, shift_ratio=0.25,
+                          data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    NT, C, H, W = x.shape
+    T = int(seg_num)
+    N = NT // T
+    c1 = int(C * shift_ratio)
+    v = x.reshape(N, T, C, H, W)
+    fwd = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], 1)
+    bwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:2 * c1]),
+                           v[:, :-1, c1:2 * c1]], 1)
+    out = jnp.concatenate([fwd, bwd, v[:, :, 2 * c1:]], axis=2)
+    out = out.reshape(NT, C, H, W)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("maxout")
+def maxout_kernel(x, groups=1, axis=1):
+    axis = axis % x.ndim
+    C = x.shape[axis]
+    g = int(groups)
+    shape = x.shape[:axis] + (C // g, g) + x.shape[axis + 1:]
+    return x.reshape(shape).max(axis=axis + 1)
+
+
+@register_kernel("pad3d")
+def pad3d_kernel(x, paddings=(), mode="constant", value=0.0,
+                 data_format="NCDHW"):
+    p = [int(v) for v in paddings]   # (l, r, t, b, f, bk) W,H,D order
+    if data_format == "NDHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    pad = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    if mode == "constant":
+        out = jnp.pad(x, pad, constant_values=value)
+    elif mode == "reflect":
+        out = jnp.pad(x, pad, mode="reflect")
+    elif mode == "replicate":
+        out = jnp.pad(x, pad, mode="edge")
+    elif mode == "circular":
+        out = jnp.pad(x, pad, mode="wrap")
+    else:
+        raise ValueError(mode)
+    if data_format == "NDHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+# -- pooling ------------------------------------------------------------------
+
+def _pool_nd(x, ksize, strides, paddings, nd, op, ceil_mode=False,
+             exclusive=True):
+    init = -jnp.inf if op == "max" else 0.0
+    reducer = jax.lax.max if op == "max" else jax.lax.add
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    pads = [(0, 0), (0, 0)]
+    count_padding = bool(any(paddings))
+    for i, p in enumerate(paddings):
+        hi = p
+        if ceil_mode:
+            # extra high-side padding so partial windows survive
+            # (reference ceil-mode output size)
+            size = x.shape[2 + i]
+            out_floor = (size + 2 * p - ksize[i]) // strides[i] + 1
+            out_ceil = -(-(size + 2 * p - ksize[i]) // strides[i]) + 1
+            hi = p + (out_ceil - out_floor) * strides[i]
+            count_padding = count_padding or out_ceil != out_floor
+        pads.append((p, hi))
+    y = jax.lax.reduce_window(
+        x.astype(jnp.float32), init, reducer, window, stride, pads)
+    if op == "avg":
+        if exclusive and count_padding:
+            ones = jnp.ones_like(x, jnp.float32)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        stride, pads)
+            y = y / jnp.maximum(cnt, 1.0)
+        else:
+            y = y / float(np.prod(ksize))
+    return y.astype(x.dtype)
+
+
+@register_kernel("pool2d")
+def pool2d_kernel(x, kernel_size=(), strides=(1, 1), paddings=(0, 0),
+                  pooling_type="max", ceil_mode=False, exclusive=True,
+                  adaptive=False, global_pooling=False,
+                  data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    if global_pooling:
+        kernel_size = x.shape[2:]
+        paddings = (0, 0)
+    if adaptive:
+        out = _adaptive_pool(x, kernel_size, pooling_type)
+    else:
+        out = _pool_nd(x, kernel_size, strides or kernel_size, paddings, 2,
+                       "avg" if pooling_type == "avg" else "max",
+                       ceil_mode, exclusive)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def _adaptive_pool(x, out_size, pooling_type):
+    spatial = x.shape[2:]
+    out = x
+    # exact adaptive pooling when divisible; interpolative reshaping else
+    shape = x.shape[:2]
+    view = x
+    for i, (s, o) in enumerate(zip(spatial, out_size)):
+        assert s % o == 0, "adaptive pool needs divisible sizes"
+    view = x.reshape(shape + tuple(
+        d for s, o in zip(spatial, out_size) for d in (o, s // o)))
+    axes = tuple(3 + 2 * i for i in range(len(spatial)))
+    return (view.max(axis=axes) if pooling_type == "max"
+            else view.mean(axis=axes))
+
+
+@register_kernel("pool3d")
+def pool3d_kernel(x, kernel_size=(), strides=(1, 1, 1),
+                  paddings=(0, 0, 0), pooling_type="max", ceil_mode=False,
+                  exclusive=True, adaptive=False, global_pooling=False,
+                  data_format="NCDHW"):
+    if data_format == "NDHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    if global_pooling:
+        kernel_size = x.shape[2:]
+        paddings = (0, 0, 0)
+    if adaptive:
+        out = _adaptive_pool(x, kernel_size, pooling_type)
+    else:
+        out = _pool_nd(x, kernel_size, strides or kernel_size, paddings, 3,
+                       "avg" if pooling_type == "avg" else "max",
+                       ceil_mode, exclusive)
+    if data_format == "NDHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def _pool_with_index(x, ksize, strides, paddings, nd):
+    """Max pool returning (out, flat spatial argmax) via patch extraction
+    (reference max_pool2d_with_index)."""
+    spatial = x.shape[2:]
+    patches = jax.lax.conv_general_dilated_patches(
+        x.astype(jnp.float32),
+        filter_shape=tuple(ksize),
+        window_strides=tuple(strides),
+        padding=[(p, p) for p in paddings])
+    # [N, C*prod(k), *out_spatial] -> [N, C, prod(k), *out]
+    N = x.shape[0]
+    C = x.shape[1]
+    K = int(np.prod(ksize))
+    patches = patches.reshape((N, C, K) + patches.shape[2:])
+    out = patches.max(axis=2)
+    arg = patches.argmax(axis=2)           # index within the window
+    # convert window-relative to global flat spatial index
+    out_spatial = patches.shape[3:]
+    grids = jnp.meshgrid(*[jnp.arange(o) for o in out_spatial],
+                         indexing="ij")
+    k_coords = jnp.unravel_index(arg, tuple(ksize))
+    flat = jnp.zeros_like(arg)
+    for dim in range(nd):
+        pos = (grids[dim] * strides[dim] - paddings[dim]
+               + k_coords[dim])
+        pos = jnp.clip(pos, 0, spatial[dim] - 1)
+        flat = flat * spatial[dim] + pos
+    return out.astype(x.dtype), flat.astype(jnp.int32)
+
+
+@register_kernel("max_pool2d_with_index")
+def max_pool2d_with_index_kernel(x, kernel_size=(), strides=(),
+                                 paddings=(0, 0), global_pooling=False,
+                                 adaptive=False):
+    if global_pooling:
+        kernel_size, paddings = x.shape[2:], (0, 0)
+    return _pool_with_index(x, kernel_size, strides or kernel_size,
+                            paddings, 2)
+
+
+@register_kernel("max_pool3d_with_index")
+def max_pool3d_with_index_kernel(x, kernel_size=(), strides=(),
+                                 paddings=(0, 0, 0), global_pooling=False,
+                                 adaptive=False):
+    if global_pooling:
+        kernel_size, paddings = x.shape[2:], (0, 0, 0)
+    return _pool_with_index(x, kernel_size, strides or kernel_size,
+                            paddings, 3)
+
+
+@register_kernel("unpool")
+def unpool_kernel(x, indices, kernel_size=(), strides=(), paddings=(0, 0),
+                  output_size=()):
+    """Inverse of max_pool2d_with_index: scatter by flat spatial index."""
+    N, C = x.shape[:2]
+    H, W = [int(s) for s in output_size[-2:]]
+    flat = jnp.zeros((N, C, H * W), x.dtype)
+    idx = indices.reshape(N, C, -1).astype(jnp.int32)
+    flat = flat.at[jnp.arange(N)[:, None, None],
+                   jnp.arange(C)[None, :, None], idx] \
+        .set(x.reshape(N, C, -1))
+    return flat.reshape(N, C, H, W)
+
+
+@register_kernel("unpool3d")
+def unpool3d_kernel(x, indices, kernel_size=(), strides=(),
+                    paddings=(0, 0, 0), output_size=()):
+    N, C = x.shape[:2]
+    D, H, W = [int(s) for s in output_size[-3:]]
+    flat = jnp.zeros((N, C, D * H * W), x.dtype)
+    idx = indices.reshape(N, C, -1).astype(jnp.int32)
+    flat = flat.at[jnp.arange(N)[:, None, None],
+                   jnp.arange(C)[None, :, None], idx] \
+        .set(x.reshape(N, C, -1))
+    return flat.reshape(N, C, D, H, W)
+
+
+@register_kernel("fold")
+def fold_kernel(x, output_sizes=(), kernel_sizes=(), strides=(1, 1),
+                paddings=(0, 0), dilations=(1, 1)):
+    """Inverse of unfold (col2im): x [N, C*kh*kw, L] -> [N, C, H, W]."""
+    N = x.shape[0]
+    H, W = [int(s) for s in output_sizes]
+    kh, kw = [int(s) for s in kernel_sizes]
+    sh, sw = [int(s) for s in strides]
+    ph, pw = [int(s) for s in paddings]
+    dh, dw = [int(s) for s in dilations]
+    C = x.shape[1] // (kh * kw)
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(N, C, kh, kw, oh, ow)
+    out = jnp.zeros((N, C, H + 2 * ph, W + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = jax.lax.dynamic_update_slice(
+                out,
+                jax.lax.dynamic_slice(
+                    out, (0, 0, i * dh, j * dw),
+                    (N, C, (oh - 1) * sh + 1, (ow - 1) * sw + 1))
+                .at[:, :, ::sh, ::sw].add(cols[:, :, i, j]),
+                (0, 0, i * dh, j * dw))
+    return out[:, :, ph:H + ph, pw:W + pw]
+
+
+@register_kernel("fractional_max_pool2d")
+def fractional_max_pool2d_kernel(x, output_size=(), kernel_size=None,
+                                 random_u=0.5, return_mask=False):
+    """Deterministic-u fractional pooling (reference with fixed u).
+    Region edges follow the pseudo-random-sequence construction with a
+    constant u; kernel_size bounds each region's extent when given.
+    return_mask=True also returns flat spatial argmax indices."""
+    N, C, H, W = x.shape
+    oh, ow = [int(s) for s in output_size]
+    eh = np.floor((H / oh) * (np.arange(oh + 1) + float(random_u))).astype(int)
+    eh = np.clip(eh - eh[0], 0, H)
+    ew = np.floor((W / ow) * (np.arange(ow + 1) + float(random_u))).astype(int)
+    ew = np.clip(ew - ew[0], 0, W)
+    eh[-1], ew[-1] = H, W
+    kh = kw = None
+    if kernel_size:
+        kh, kw = [int(k) for k in kernel_size]
+    rows, mrows = [], []
+    for i in range(oh):
+        cols, mcols = [], []
+        h0, h1 = eh[i], max(eh[i + 1], eh[i] + 1)
+        if kh:
+            h1 = min(h0 + kh, H)
+        for j in range(ow):
+            w0, w1 = ew[j], max(ew[j + 1], ew[j] + 1)
+            if kw:
+                w1 = min(w0 + kw, W)
+            patch = x[:, :, h0:h1, w0:w1]
+            flat = patch.reshape(N, C, -1)
+            cols.append(flat.max(axis=-1))
+            arg = flat.argmax(axis=-1)
+            pr, pc = arg // (w1 - w0), arg % (w1 - w0)
+            mcols.append((pr + h0) * W + (pc + w0))
+        rows.append(jnp.stack(cols, axis=-1))
+        mrows.append(jnp.stack(mcols, axis=-1))
+    out = jnp.stack(rows, axis=-2)
+    if return_mask:
+        return out, jnp.stack(mrows, axis=-2).astype(jnp.int32)
+    return out
+
+
+@register_kernel("rrelu")
+def rrelu_kernel(x, key=None, lower=0.125, upper=0.333333, is_test=False):
+    if is_test or key is None:
+        slope = (lower + upper) / 2.0
+        return jnp.where(x >= 0, x, x * slope)
+    slope = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
+    return jnp.where(x >= 0, x, x * slope.astype(x.dtype))
+
+
+# -- conv3d -------------------------------------------------------------------
+
+@register_kernel("conv3d")
+def conv3d_kernel(x, weight, stride=(1, 1, 1), padding=(0, 0, 0),
+                  dilation=(1, 1, 1), groups=1, data_format="NCDHW"):
+    if data_format == "NDHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = [int(v) for v in padding]
+        pad = [(v, v) for v in (p * 3 if len(p) == 1 else p)]
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=tuple(stride), padding=pad,
+        rhs_dilation=tuple(dilation), feature_group_count=int(groups),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if data_format == "NDHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("conv3d_transpose")
+def conv3d_transpose_kernel(x, weight, stride=(1, 1, 1), padding=(0, 0, 0),
+                            output_padding=(0, 0, 0), dilation=(1, 1, 1),
+                            groups=1, data_format="NCDHW"):
+    if data_format == "NDHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    p = [int(v) for v in padding]
+    s = tuple(int(v) for v in stride)
+    d = tuple(int(v) for v in dilation)
+    op = [int(v) for v in output_padding]
+    k = weight.shape[2:]
+    # gradient-style transpose conv: lhs dilation by stride
+    pads = []
+    for i in range(3):
+        eff_k = d[i] * (k[i] - 1) + 1
+        lo = eff_k - 1 - p[i]
+        hi = eff_k - 1 - p[i] + op[i]
+        pads.append((lo, hi))
+    # weight [I, O/g, kd, kh, kw] (paddle transpose-conv layout): flip +
+    # swap to OIDHW
+    w = jnp.flip(weight, axis=(2, 3, 4))
+    I, Og = w.shape[0], w.shape[1]
+    g = int(groups)
+    w = w.reshape(g, I // g, Og, *k).transpose(0, 2, 1, 3, 4, 5) \
+        .reshape(g * Og, I // g, *k)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pads, lhs_dilation=s,
+        rhs_dilation=d, feature_group_count=g,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if data_format == "NDHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+# -- interpolation ------------------------------------------------------------
+
+def _interp(x, size, scale, method, align_corners, nd,
+            data_format):
+    ch_last = data_format.endswith("C")
+    if ch_last:
+        x = jnp.moveaxis(x, -1, 1)
+    spatial = x.shape[2:]
+    if size:
+        out_sp = tuple(int(s) for s in size)
+    else:
+        sc = ([float(scale)] * nd if np.isscalar(scale)
+              else [float(s) for s in scale])
+        out_sp = tuple(int(round(s * c)) for s, c in zip(spatial, sc))
+    xf = x.astype(jnp.float32)
+    if align_corners and method != "nearest":
+        # corners-to-corners mapping: out o -> in o*(S-1)/(O-1); with
+        # scale_and_translate's half-pixel convention that needs
+        # scale k=(O-1)/(S-1) and translation 0.5*(1-k)
+        scales = [(o - 1) / (s - 1) if s > 1 else 1.0
+                  for s, o in zip(spatial, out_sp)]
+        out = jax.image.scale_and_translate(
+            xf, x.shape[:2] + out_sp, list(range(2, 2 + nd)),
+            jnp.asarray(scales, jnp.float32),
+            jnp.asarray([0.5 * (1.0 - k) for k in scales], jnp.float32),
+            method="cubic" if method == "bicubic" else "linear")
+    else:
+        m = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic"}[method]
+        out = jax.image.resize(xf, x.shape[:2] + out_sp, method=m)
+    out = out.astype(x.dtype)
+    if ch_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("bilinear_interp")
+def bilinear_interp_kernel(x, size=None, scale_factor=None,
+                           align_corners=False, data_format="NCHW"):
+    return _interp(x, size, scale_factor, "bilinear", align_corners, 2,
+                   data_format)
+
+
+@register_kernel("nearest_interp")
+def nearest_interp_kernel(x, size=None, scale_factor=None,
+                          align_corners=False, data_format="NCHW"):
+    return _interp(x, size, scale_factor, "nearest", align_corners, 2,
+                   data_format)
+
+
+@register_kernel("bicubic_interp")
+def bicubic_interp_kernel(x, size=None, scale_factor=None,
+                          align_corners=False, data_format="NCHW"):
+    return _interp(x, size, scale_factor, "bicubic", align_corners, 2,
+                   data_format)
+
+
+@register_kernel("linear_interp")
+def linear_interp_kernel(x, size=None, scale_factor=None,
+                         align_corners=False, data_format="NCW"):
+    return _interp(x, size, scale_factor, "linear", align_corners, 1,
+                   data_format)
+
+
+@register_kernel("trilinear_interp")
+def trilinear_interp_kernel(x, size=None, scale_factor=None,
+                            align_corners=False, data_format="NCDHW"):
+    return _interp(x, size, scale_factor, "trilinear", align_corners, 3,
+                   data_format)
+
+
+# -- normalization extras -----------------------------------------------------
+
+@register_kernel("spectral_norm")
+def spectral_norm_kernel(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    w = jnp.moveaxis(weight, dim, 0)
+    mat = w.reshape(w.shape[0], -1).astype(jnp.float32)
+    uu, vv = u.astype(jnp.float32), v.astype(jnp.float32)
+    for _ in range(int(power_iters)):
+        vv = mat.T @ uu
+        vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+        uu = mat @ vv
+        uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+    sigma = uu @ mat @ vv
+    return (weight / sigma.astype(weight.dtype))
+
+
+@register_kernel("segment_pool")
+def segment_pool_kernel(x, segment_ids, pooltype="SUM"):
+    """Host-sized output (num_segments = max id + 1): jit: false."""
+    ids = np.asarray(segment_ids)
+    n = int(ids.max()) + 1 if ids.size else 0
+    ids_j = jnp.asarray(ids.astype(np.int32))
+    if pooltype == "SUM":
+        out = jax.ops.segment_sum(x, ids_j, n)
+    elif pooltype == "MEAN":
+        s = jax.ops.segment_sum(x, ids_j, n)
+        c = jax.ops.segment_sum(jnp.ones(x.shape[0], x.dtype), ids_j, n)
+        out = s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (x.ndim - 1))
+    elif pooltype == "MAX":
+        out = jax.ops.segment_max(x, ids_j, n)
+    elif pooltype == "MIN":
+        out = jax.ops.segment_min(x, ids_j, n)
+    else:
+        raise ValueError(pooltype)
+    return out
+
+
+@register_kernel("overlap_add")
+def overlap_add_kernel(x, hop_length=1, axis=-1):
+    """[..., n_frames, frame_len] -> [..., output_len] (reference
+    overlap_add; inverse of frame)."""
+    if axis == 0:   # frames leading: [frame_len, n_frames, ...]
+        x = jnp.moveaxis(x, (0, 1), (-1, -2))
+    frame_len = x.shape[-1]
+    n = x.shape[-2]
+    hop = int(hop_length)
+    out_len = (n - 1) * hop + frame_len
+    batch = x.shape[:-2]
+    out = jnp.zeros(batch + (out_len,), x.dtype)
+    pos = (jnp.arange(n)[:, None] * hop
+           + jnp.arange(frame_len)[None, :]).reshape(-1)
+    out = out.at[..., pos].add(x.reshape(batch + (-1,)))
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+# -- detection ----------------------------------------------------------------
+
+@register_kernel("box_coder")
+def box_coder_kernel(prior_box, prior_box_var=None, target_box=None,
+                     code_type="encode_center_size", box_normalized=True,
+                     axis=0):
+    pb = prior_box.astype(jnp.float32)
+    tb = target_box.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    px = pb[:, 0] + pw * 0.5
+    py = pb[:, 1] + ph * 0.5
+    var = (prior_box_var.astype(jnp.float32)
+           if prior_box_var is not None else jnp.ones((1, 4), jnp.float32))
+    if code_type.startswith("encode"):
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tx = tb[:, 0] + tw * 0.5
+        ty = tb[:, 1] + th * 0.5
+        out = jnp.stack([(tx[:, None] - px[None]) / pw[None],
+                         (ty[:, None] - py[None]) / ph[None],
+                         jnp.log(tw[:, None] / pw[None]),
+                         jnp.log(th[:, None] / ph[None])], axis=-1)
+        return out / var.reshape(1, -1, 4)
+    # decode: tb [N, M, 4] deltas (axis 0: priors broadcast over dim 1)
+    d = tb * var.reshape(1, -1, 4) if prior_box_var is not None else tb
+    if axis == 0:
+        pw_, ph_, px_, py_ = (v[:, None] for v in (pw, ph, px, py))
+    else:
+        pw_, ph_, px_, py_ = (v[None, :] for v in (pw, ph, px, py))
+    cx = d[..., 0] * pw_ + px_
+    cy = d[..., 1] * ph_ + py_
+    w = jnp.exp(d[..., 2]) * pw_
+    h = jnp.exp(d[..., 3]) * ph_
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+
+@register_kernel("roi_align")
+def roi_align_kernel(x, boxes, boxes_num=None, pooled_height=1,
+                     pooled_width=1, spatial_scale=1.0, sampling_ratio=-1,
+                     aligned=True):
+    """[N,C,H,W] + [K,4] boxes (+ per-image counts) -> [K,C,ph,pw]."""
+    N, C, H, W = x.shape
+    K = boxes.shape[0]
+    ph, pw = int(pooled_height), int(pooled_width)
+    if boxes_num is not None:
+        counts = np.asarray(boxes_num)
+        bidx = np.repeat(np.arange(len(counts)), counts)
+    else:
+        bidx = np.zeros(K, np.int64)
+    bidx = jnp.asarray(bidx.astype(np.int32))
+    off = 0.5 if aligned else 0.0
+    b = boxes.astype(jnp.float32) * float(spatial_scale) - off
+    x0, y0, x1, y1 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    bw = jnp.maximum(x1 - x0, 1e-3 if aligned else 1.0)
+    bh = jnp.maximum(y1 - y0, 1e-3 if aligned else 1.0)
+    s = int(sampling_ratio) if int(sampling_ratio) > 0 else 2
+    # sample grid: [K, ph*s, pw*s]
+    gy = (y0[:, None] + (jnp.arange(ph * s) + 0.5)[None, :]
+          * (bh / (ph * s))[:, None])
+    gx = (x0[:, None] + (jnp.arange(pw * s) + 0.5)[None, :]
+          * (bw / (pw * s))[:, None])
+
+    def bilinear(img, yy, xx):
+        yy0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+        xx0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+        yy1 = jnp.clip(yy0 + 1, 0, H - 1)
+        xx1 = jnp.clip(xx0 + 1, 0, W - 1)
+        wy = jnp.clip(yy - yy0, 0, 1)
+        wx = jnp.clip(xx - xx0, 0, 1)
+        i = lambda a: a.astype(jnp.int32)
+        # gather per (row, col) pair grids
+        v00 = img[:, i(yy0)[:, None], i(xx0)[None, :]]
+        v01 = img[:, i(yy0)[:, None], i(xx1)[None, :]]
+        v10 = img[:, i(yy1)[:, None], i(xx0)[None, :]]
+        v11 = img[:, i(yy1)[:, None], i(xx1)[None, :]]
+        return (v00 * ((1 - wy)[:, None] * (1 - wx)[None, :])
+                + v01 * ((1 - wy)[:, None] * wx[None, :])
+                + v10 * (wy[:, None] * (1 - wx)[None, :])
+                + v11 * (wy[:, None] * wx[None, :]))
+
+    def per_box(k):
+        img = x[bidx[k]].astype(jnp.float32)       # [C,H,W]
+        samp = bilinear(img, gy[k], gx[k])         # [C, ph*s, pw*s]
+        return samp.reshape(C, ph, s, pw, s).mean(axis=(2, 4))
+
+    out = jax.vmap(per_box)(jnp.arange(K))
+    return out.astype(x.dtype)
+
+
+@register_kernel("roi_pool")
+def roi_pool_kernel(x, boxes, boxes_num=None, pooled_height=1,
+                    pooled_width=1, spatial_scale=1.0):
+    """Max-pool RoI (reference roi_pool): quantized bins."""
+    N, C, H, W = x.shape
+    K = boxes.shape[0]
+    ph, pw = int(pooled_height), int(pooled_width)
+    if boxes_num is not None:
+        counts = np.asarray(boxes_num)
+        bidx = np.repeat(np.arange(len(counts)), counts)
+    else:
+        bidx = np.zeros(K, np.int64)
+    bidx = jnp.asarray(bidx.astype(np.int32))
+    b = jnp.round(boxes.astype(jnp.float32) * float(spatial_scale))
+    x0 = jnp.clip(b[:, 0], 0, W - 1).astype(jnp.int32)
+    y0 = jnp.clip(b[:, 1], 0, H - 1).astype(jnp.int32)
+    x1 = jnp.clip(b[:, 2], 0, W - 1).astype(jnp.int32)
+    y1 = jnp.clip(b[:, 3], 0, H - 1).astype(jnp.int32)
+
+    ys = jnp.arange(H)
+    xs = jnp.arange(W)
+
+    def per_box(k):
+        img = x[bidx[k]].astype(jnp.float32)
+        bh = jnp.maximum(y1[k] - y0[k] + 1, 1)
+        bw = jnp.maximum(x1[k] - x0[k] + 1, 1)
+        rows = []
+        for i in range(ph):
+            hs = y0[k] + (i * bh) // ph
+            he = y0[k] + ((i + 1) * bh + ph - 1) // ph
+            rmask = (ys >= hs) & (ys < jnp.maximum(he, hs + 1))
+            cols = []
+            for j in range(pw):
+                ws = x0[k] + (j * bw) // pw
+                we = x0[k] + ((j + 1) * bw + pw - 1) // pw
+                cmask = (xs >= ws) & (xs < jnp.maximum(we, ws + 1))
+                m = rmask[:, None] & cmask[None, :]
+                cols.append(jnp.where(m[None], img, -jnp.inf)
+                            .max(axis=(1, 2)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    out = jax.vmap(per_box)(jnp.arange(K))
+    return out.astype(x.dtype)
+
+
+@register_kernel("prior_box")
+def prior_box_kernel(input, image, min_sizes=(), max_sizes=(),
+                     aspect_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2),
+                     flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+                     min_max_aspect_ratios_order=False):
+    """SSD prior boxes (reference prior_box_kernel)."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = float(steps[0]) or iw / fw
+    sh = float(steps[1]) or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for s_i, ms in enumerate(min_sizes):
+        ms = float(ms)
+        boxes.append((ms, ms))
+        if max_sizes:
+            mx = float(max_sizes[s_i])
+            boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    num_priors = len(boxes)
+    cx = (np.arange(fw) + float(offset)) * sw
+    cy = (np.arange(fh) + float(offset)) * sh
+    gx, gy = np.meshgrid(cx, cy)             # [fh, fw]
+    out = np.zeros((fh, fw, num_priors, 4), np.float32)
+    for p, (bw, bh) in enumerate(boxes):
+        out[:, :, p, 0] = (gx - bw / 2) / iw
+        out[:, :, p, 1] = (gy - bh / 2) / ih
+        out[:, :, p, 2] = (gx + bw / 2) / iw
+        out[:, :, p, 3] = (gy + bh / 2) / ih
+    if clip:
+        out = out.clip(0.0, 1.0)
+    var = np.tile(np.asarray(variances, np.float32),
+                  (fh, fw, num_priors, 1))
+    return jnp.asarray(out), jnp.asarray(var)
+
+
+@register_kernel("batch_norm")
+def batch_norm_kernel(x, mean, variance, scale=None, bias=None,
+                      is_test=False, momentum=0.9, epsilon=1e-05,
+                      data_format="NCHW", use_global_stats=False):
+    """Unified batch_norm op (reference batch_norm/batch_norm_ — the
+    per-mode kernels batch_norm_train/infer stay the Layer path). Returns
+    (out, mean_out, variance_out, saved_mean, saved_variance): running
+    stats fold the batch stats by `momentum` in training mode."""
+    from .nn import batch_norm_infer, batch_norm_train
+    if is_test or use_global_stats:
+        out = batch_norm_infer(x, mean, variance, scale, bias, epsilon,
+                               data_format)
+        return out, mean, variance, mean, variance
+    out, bmean, bvar = batch_norm_train(x, scale, bias, epsilon,
+                                        data_format)
+    m = float(momentum)
+    mean_out = mean * m + bmean * (1 - m)
+    var_out = variance * m + bvar * (1 - m)
+    return out, mean_out, var_out, bmean, bvar
+
+
+@register_kernel("viterbi_decode")
+def viterbi_decode_kernel(potentials, transition, lengths=None,
+                          include_bos_eos_tag=True):
+    """CRF Viterbi decode op (reference viterbi_decode_kernel) — delegates
+    to the scan-based decoder in text/ (same math, one home)."""
+    from ...core.tensor import Tensor as _T
+    from ...text import viterbi_decode as _vd
+    scores, path = _vd(_T(potentials), _T(transition),
+                       _T(lengths) if lengths is not None else None,
+                       include_bos_eos_tag=include_bos_eos_tag)
+    return scores._data, path._data

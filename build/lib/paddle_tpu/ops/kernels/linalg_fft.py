@@ -1,0 +1,320 @@
+"""Decomposition / spectral kernels (reference python/paddle/tensor/linalg.py,
+python/paddle/fft.py, python/paddle/signal.py over phi kernels
+paddle/phi/kernels/cpu|gpu/{svd,qr,eigh,...}_kernel + fft_kernel).
+
+TPU notes: svd/qr/eigh/cholesky lower to XLA's decomposition ops on MXU;
+general eig is CPU-only in XLA (jit: false in ops.yaml, runs via host
+callback semantics eagerly). stft/istft are composites: strided framing +
+rfft, overlap-add via scatter — no cuFFT plan management to port.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatcher import register_kernel
+
+
+# -- decompositions ------------------------------------------------------------
+
+@register_kernel("svd")
+def svd_kernel(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+@register_kernel("qr")
+def qr_kernel(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@register_kernel("eigh")
+def eigh_kernel(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@register_kernel("eigvalsh")
+def eigvalsh_kernel(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register_kernel("eig")
+def eig_kernel(x):
+    # XLA has no general-eig on TPU: compute on host (numpy/LAPACK), results
+    # land back on the default device
+    w, v = np.linalg.eig(np.asarray(jax.device_get(x)))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@register_kernel("eigvals")
+def eigvals_kernel(x):
+    return jnp.asarray(np.linalg.eigvals(np.asarray(jax.device_get(x))))
+
+
+@register_kernel("lu")
+def lu_kernel(x):
+    lu, piv = jax.scipy.linalg.lu_factor(x)
+    # reference lu returns 1-based LAPACK pivots (python/paddle linalg.lu);
+    # jax's are 0-based
+    return lu, piv.astype(jnp.int32) + 1
+
+
+@register_kernel("det")
+def det_kernel(x):
+    return jnp.linalg.det(x)
+
+
+@register_kernel("slogdet")
+def slogdet_kernel(x):
+    sign, logabsdet = jnp.linalg.slogdet(x)
+    return sign, logabsdet
+
+
+@register_kernel("pinv")
+def pinv_kernel(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@register_kernel("matrix_power")
+def matrix_power_kernel(x, n=1):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_kernel("matrix_rank")
+def matrix_rank_kernel(x, tol=None, hermitian=False):
+    if hermitian:
+        s = jnp.abs(jnp.linalg.eigvalsh(x))
+    else:
+        s = jnp.linalg.svd(x, compute_uv=False)
+    if tol is None:
+        tol = (s.max(axis=-1, keepdims=True) * max(x.shape[-2:]) *
+               jnp.finfo(s.dtype).eps)
+    else:
+        tol = jnp.asarray(tol)[..., None] if jnp.ndim(tol) else tol
+    return jnp.sum(s > tol, axis=-1).astype(jnp.int32)
+
+
+@register_kernel("solve")
+def solve_kernel(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register_kernel("lstsq")
+def lstsq_kernel(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank.astype(jnp.int32), sv
+
+
+@register_kernel("cholesky_solve")
+def cholesky_solve_kernel(x, y, upper=False):
+    # paddle: solves A z = x given y = chol factor of A
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@register_kernel("cond")
+def cond_kernel(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register_kernel("cov")
+def cov_kernel(x, fweights=None, aweights=None, rowvar=True, ddof=True):
+    # optional tensors arrive positionally (dispatcher slot order), attrs by
+    # keyword; public arg order (paddle parity) lives in ops.yaml
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@register_kernel("corrcoef")
+def corrcoef_kernel(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register_kernel("multi_dot")
+def multi_dot_kernel(xs):
+    return jnp.linalg.multi_dot(list(xs))
+
+
+@register_kernel("householder_product")
+def householder_product_kernel(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+@register_kernel("matrix_norm")
+def matrix_norm_kernel(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+# -- fft ----------------------------------------------------------------------
+
+def _norm(norm):
+    return norm if norm in ("forward", "ortho", "backward") else "backward"
+
+
+@register_kernel("fft")
+def fft_kernel(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@register_kernel("ifft")
+def ifft_kernel(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@register_kernel("rfft")
+def rfft_kernel(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@register_kernel("irfft")
+def irfft_kernel(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@register_kernel("hfft")
+def hfft_kernel(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@register_kernel("ihfft")
+def ihfft_kernel(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@register_kernel("fft2")
+def fft2_kernel(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@register_kernel("ifft2")
+def ifft2_kernel(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@register_kernel("rfft2")
+def rfft2_kernel(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@register_kernel("irfft2")
+def irfft2_kernel(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@register_kernel("fftn")
+def fftn_kernel(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@register_kernel("ifftn")
+def ifftn_kernel(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@register_kernel("fftshift")
+def fftshift_kernel(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@register_kernel("ifftshift")
+def ifftshift_kernel(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+@register_kernel("fftfreq")
+def fftfreq_kernel(n=1, d=1.0, dtype=None):
+    return jnp.fft.fftfreq(n, d=d).astype(dtype or jnp.float32)
+
+
+@register_kernel("rfftfreq")
+def rfftfreq_kernel(n=1, d=1.0, dtype=None):
+    return jnp.fft.rfftfreq(n, d=d).astype(dtype or jnp.float32)
+
+
+# -- signal (stft/istft composites) -------------------------------------------
+
+def _frame(x, frame_length, hop_length):
+    """[..., T] -> [..., n_frames, frame_length] via gather (XLA-friendly)."""
+    n = x.shape[-1]
+    n_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length +
+           jnp.arange(frame_length)[None, :])
+    return x[..., idx], n_frames
+
+
+@register_kernel("frame")
+def frame_kernel(x, frame_length=512, hop_length=128, axis=-1):
+    """Reference layout (signal.py:45): axis=-1 → [..., frame_length,
+    num_frames]; axis=0 → [num_frames, frame_length, ...]."""
+    if axis == 0:
+        x = jnp.moveaxis(x, 0, -1)            # time to trailing for _frame
+        framed, _ = _frame(x, frame_length, hop_length)
+        # [..., n_frames, frame_length] -> [n_frames, frame_length, ...]
+        return jnp.moveaxis(framed, (-2, -1), (0, 1))
+    framed, _ = _frame(x, frame_length, hop_length)
+    return jnp.swapaxes(framed, -1, -2)       # [..., frame_length, n_frames]
+
+
+@register_kernel("stft")
+def stft_kernel(x, window=None, n_fft=512, hop_length=None, win_length=None,
+                center=True, pad_mode="reflect", normalized=False,
+                onesided=True):
+    hop = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), x.dtype)
+    if win_length < n_fft:  # center-pad the window to n_fft (reference)
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames, _ = _frame(x, n_fft, hop)
+    frames = frames * window
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+        jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    # paddle layout: [..., n_fft//2+1, n_frames]
+    return jnp.swapaxes(spec, -1, -2)
+
+
+@register_kernel("istft")
+def istft_kernel(x, window=None, n_fft=512, hop_length=None, win_length=None,
+                 center=True, normalized=False, onesided=True, length=None,
+                 return_complex=False):
+    hop = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    spec = jnp.swapaxes(x, -1, -2)            # [..., n_frames, bins]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1)
+        frames = frames if return_complex else frames.real
+    frames = frames * window
+    n_frames = frames.shape[-2]
+    out_len = n_fft + hop * (n_frames - 1)
+    idx = (jnp.arange(n_frames)[:, None] * hop +
+           jnp.arange(n_fft)[None, :]).reshape(-1)
+    flat = frames.reshape(frames.shape[:-2] + (-1,))
+    sig = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    sig = sig.at[..., idx].add(flat)
+    # window envelope normalization (COLA)
+    env = jnp.zeros((out_len,), window.dtype).at[idx].add(
+        jnp.tile(window * window, n_frames))
+    sig = sig / jnp.maximum(env, 1e-11)
+    if center:
+        sig = sig[..., n_fft // 2: out_len - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig

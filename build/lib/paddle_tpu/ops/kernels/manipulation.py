@@ -1,0 +1,376 @@
+"""Shape/layout manipulation kernels.
+
+Reference: paddle/phi/kernels/*_kernel.* (reshape, concat, split, gather,
+scatter, ...). Static-shape by design: ops whose output shape depends on
+data (nonzero, unique, masked_select) are marked jit:false in ops.yaml and
+documented as host-sync points — inside to_static they must be avoided or
+bucketized.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatcher import register_kernel
+
+
+@register_kernel("reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+@register_kernel("transpose")
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+@register_kernel("swapaxes")
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@register_kernel("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_kernel("concat")
+def concat(xs, axis=0):
+    dt = jnp.result_type(*xs)
+    return jnp.concatenate([a.astype(dt) for a in xs], axis=int(axis))
+
+
+@register_kernel("stack")
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=axis)
+
+
+@register_kernel("split")
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s in (-1, None) for s in sections):
+        known = sum(s for s in sections if s not in (-1, None))
+        sections = [total - known if s in (-1, None) else s for s in sections]
+    splits, acc = [], 0
+    for s in sections[:-1]:
+        acc += int(s)
+        splits.append(acc)
+    return jnp.split(x, splits, axis=axis)
+
+
+@register_kernel("chunk")
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+@register_kernel("unstack")
+def unstack(x, axis=0, num=None):
+    n = num or x.shape[axis]
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+
+
+@register_kernel("unbind")
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)
+
+
+@register_kernel("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@register_kernel("unsqueeze")
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    for a in sorted(a if a >= 0 else a + x.ndim + 1 for a in axis):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register_kernel("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    s = start_axis % nd
+    e = stop_axis % nd
+    shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, shape)
+
+
+@register_kernel("expand")
+def expand(x, shape):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register_kernel("broadcast_to")
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+@register_kernel("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+@register_kernel("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_kernel("flip")
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@register_kernel("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register_kernel("cast")
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+@register_kernel("slice")
+def slice_(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+@register_kernel("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+@register_kernel("getitem")
+def getitem(x, index=None):
+    return x[index]
+
+
+@register_kernel("gather")
+def gather(x, index, axis=0):
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=int(axis))
+
+
+@register_kernel("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register_kernel("take_along_axis")
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@register_kernel("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(values, indices.shape).astype(x.dtype)
+    dims = [i for i in range(x.ndim)]
+    # build open indices along all dims
+    idx = list(jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij"))
+    idx[axis] = indices
+    if reduce == "assign":
+        return x.at[tuple(idx)].set(values)
+    if reduce in ("add", "sum"):
+        return x.at[tuple(idx)].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[tuple(idx)].multiply(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+@register_kernel("scatter")
+def scatter(x, index, updates, overwrite=True):
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates.astype(x.dtype))
+    return x.at[index].add(updates.astype(x.dtype))
+
+
+@register_kernel("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates.astype(x.dtype))
+
+
+@register_kernel("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=int(axis))
+
+
+@register_kernel("index_add")
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    movedv = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(movedv.astype(x.dtype))
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register_kernel("where")
+def where(condition, x=None, y=None):
+    return jnp.where(condition, x, y)
+
+
+@register_kernel("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+@register_kernel("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = list(pad)
+    if len(pad) == 2 * x.ndim:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle semantics: pad applies to trailing spatial dims, reversed pairs
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * (x.ndim - n_spatial)
+        spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            widths = [(0, 0), (0, 0)] + spatial
+        else:
+            widths = [(0, 0)] + spatial + [(0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+@register_kernel("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_kernel("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+# -- search / sort ------------------------------------------------------------
+
+@register_kernel("argmax")
+def argmax(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype or jnp.int32)
+
+
+@register_kernel("argmin")
+def argmin(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype or jnp.int32)
+
+
+@register_kernel("argsort")
+def argsort(x, axis=-1, descending=False, stable=True):
+    idx = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return idx.astype(jnp.int32)
+
+
+@register_kernel("sort")
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+@register_kernel("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    axis = axis % x.ndim
+    if axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    if axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int32)
+
+
+@register_kernel("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    # int64 is unavailable (x64 disabled on TPU); both flags yield int32
+    return out.astype(jnp.int32)
+
+
+@register_kernel("bincount")
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@register_kernel("histogram")
+def histogram(x, bins=100, min=0.0, max=0.0):
+    if min == 0.0 and max == 0.0:
+        min, max = float(jnp.min(x)), float(jnp.max(x))
+    h, _ = jnp.histogram(x, bins=bins, range=(min, max))
+    return h
+
+
+@register_kernel("nonzero")
+def nonzero(x, as_tuple=False):
+    idx = jnp.stack(jnp.nonzero(x), axis=-1)
+    return idx
+
+
+@register_kernel("masked_select")
+def masked_select(x, mask):
+    return x[mask]
+
+
+@register_kernel("unique")
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    res = jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+@register_kernel("one_hot")
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@register_kernel("numel")
+def numel(x):
+    return jnp.asarray(x.size, dtype=jnp.int32)
+
+
+@register_kernel("shape")
+def shape(x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+@register_kernel("as_real")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_kernel("as_complex")
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])

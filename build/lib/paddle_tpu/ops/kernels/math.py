@@ -1,0 +1,301 @@
+"""Math kernels: unary/binary elementwise, reductions, linalg.
+
+Reference: paddle/phi/kernels/{cpu,gpu}/*_kernel.* and funcs/ engines
+(broadcast_function.h, elementwise_base.h, reduce engines). On TPU all of
+these lower to single XLA HLO ops that the compiler fuses; the VPU handles
+elementwise and the MXU the matmuls, so the kernels are one-liners by design.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatcher import register_kernel
+
+# -- unary --------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "exp": jnp.exp, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sqrt": jnp.sqrt, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "trunc": jnp.trunc, "sign": jnp.sign, "square": jnp.square,
+    "reciprocal": lambda x: 1.0 / x, "neg": jnp.negative,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "lgamma": jax.scipy.special.gammaln, "digamma": jax.scipy.special.digamma,
+    "sigmoid": jax.nn.sigmoid, "logsigmoid": jax.nn.log_sigmoid,
+    "rsqrt": jax.lax.rsqrt, "isnan": jnp.isnan, "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite, "logical_not": jnp.logical_not,
+    "bitwise_not": jnp.bitwise_not, "conj": jnp.conj, "angle": jnp.angle,
+    "real": jnp.real, "imag": jnp.imag, "frac": lambda x: x - jnp.trunc(x),
+}
+for _name, _fn in _UNARY.items():
+    register_kernel(_name)(_fn)
+
+# -- binary (jnp broadcasting == paddle broadcasting) -------------------------
+
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "pow": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "remainder": jnp.remainder, "fmod": jnp.fmod,
+    "floor_divide": jnp.floor_divide, "atan2": jnp.arctan2,
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "logaddexp": jnp.logaddexp, "hypot": jnp.hypot,
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+}
+for _name, _fn in _BINARY.items():
+    register_kernel(_name)(_fn)
+
+
+@register_kernel("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_kernel("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@register_kernel("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register_kernel("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@register_kernel("allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_kernel("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_kernel("equal_all")
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+# -- reductions ---------------------------------------------------------------
+
+def _axis(axis):
+    if axis is None or axis == ():
+        return None
+    return axis
+
+
+@register_kernel("sum")
+def sum_(x, axis=None, dtype=None, keepdim=False):
+    out_dtype = dtype
+    if out_dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        out_dtype = jnp.int32
+    return jnp.sum(x, axis=_axis(axis), dtype=out_dtype, keepdims=keepdim)
+
+
+@register_kernel("mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("max")
+def max_(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("min")
+def min_(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@register_kernel("any")
+def any_(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("all")
+def all_(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_kernel("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_kernel("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("nansum")
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_kernel("cumsum")
+def cumsum(x, axis=None):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+
+@register_kernel("cumprod")
+def cumprod(x, dim=None):
+    if dim is None:
+        return jnp.cumprod(x.reshape(-1))
+    return jnp.cumprod(x, axis=dim)
+
+
+@register_kernel("cummax")
+def cummax(x, axis=-1):
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+@register_kernel("cummin")
+def cummin(x, axis=-1):
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+# -- linalg -------------------------------------------------------------------
+
+@register_kernel("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    """MXU-bound contraction (reference paddle/phi/kernels/gpu/matmul_kernel.cu
+    → cuBLAS; here a single dot_general XLA tiles onto the systolic array)."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@register_kernel("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_kernel("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register_kernel("cross")
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_kernel("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_kernel("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@register_kernel("t")
+def t(x):
+    return x.T
+
+
+@register_kernel("norm")
+def norm(x, p=2.0, axis=None, keepdim=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=_axis(axis), keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=_axis(axis), keepdims=keepdim)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@register_kernel("einsum_impl")
+def einsum_impl(operands, equation=""):
+    return jnp.einsum(equation, *operands)
+
+
+@register_kernel("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+
+@register_kernel("cholesky")
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@register_kernel("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register_kernel("matrix_transpose")
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register_kernel("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_kernel("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_kernel("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)

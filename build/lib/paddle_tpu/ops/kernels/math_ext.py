@@ -1,0 +1,459 @@
+"""Extended tensor-op tranche (reference python/paddle/tensor/{math,stat,
+manipulation,search}.py long tail) — jnp/lax-backed kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatcher import register_kernel
+
+
+# -- statistics ---------------------------------------------------------------
+
+@register_kernel("quantile")
+def quantile_kernel(x, q=0.5, axis=None, keepdim=False,
+                    interpolation="linear"):
+    qs = jnp.asarray(q)
+    return jnp.quantile(x, qs, axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+@register_kernel("nanquantile")
+def nanquantile_kernel(x, q=0.5, axis=None, keepdim=False,
+                       interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+@register_kernel("kthvalue")
+def kthvalue_kernel(x, k=1, axis=-1, keepdim=False):
+    idxs = jnp.argsort(x, axis=axis)        # one sort: values via gather
+    vals = jnp.take_along_axis(x, idxs, axis=axis)
+    val = jnp.take(vals, k - 1, axis=axis)
+    idx = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return val, idx.astype(jnp.int32)
+
+
+@register_kernel("mode")
+def mode_kernel(x, axis=-1, keepdim=False):
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+
+    def per_slice(row):
+        # longest run in sorted order
+        same = row[1:] == row[:-1]
+        breaks = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                  (~same).astype(jnp.int32)])
+        grp = jnp.cumsum(breaks)
+        lengths = jax.ops.segment_sum(jnp.ones(n, jnp.int32), grp,
+                                      num_segments=n)
+        best_grp = jnp.argmax(lengths)
+        first_idx = jnp.argmax(grp == best_grp)
+        return row[first_idx]
+
+    moved = jnp.moveaxis(sorted_x, axis, -1)
+    flat = moved.reshape(-1, n)
+    vals = jax.vmap(per_slice)(flat).reshape(moved.shape[:-1])
+    # index of the LAST occurrence in the ORIGINAL array (reference mode())
+    eq = jnp.moveaxis(x, axis, -1).reshape(-1, n) == vals[..., None].reshape(
+        -1, 1)
+    idx = (n - 1 - jnp.argmax(eq[:, ::-1], axis=-1)).reshape(
+        moved.shape[:-1])
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int32)
+
+
+@register_kernel("count_nonzero")
+def count_nonzero_kernel(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(
+        jnp.int32)
+
+
+# -- math ---------------------------------------------------------------------
+
+@register_kernel("logcumsumexp")
+def logcumsumexp_kernel(x, axis=None):
+    # numerically stable associative scan with logaddexp; axis=None scans
+    # the flattened tensor (reference default)
+    if axis is None:
+        return jax.lax.associative_scan(jnp.logaddexp, x.reshape(-1))
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis % x.ndim)
+
+
+@register_kernel("renorm")
+def renorm_kernel(x, p=2.0, axis=0, max_norm=1.0):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.linalg.norm(flat, ord=p, axis=1)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+@register_kernel("diff")
+def diff_kernel(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@register_kernel("vander")
+def vander_kernel(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@register_kernel("heaviside")
+def heaviside_kernel(x, y):
+    return jnp.heaviside(x, y)
+
+
+@register_kernel("copysign")
+def copysign_kernel(x, y):
+    return jnp.copysign(x, y)
+
+
+@register_kernel("deg2rad")
+def deg2rad_kernel(x):
+    return jnp.deg2rad(x)
+
+
+@register_kernel("rad2deg")
+def rad2deg_kernel(x):
+    return jnp.rad2deg(x)
+
+
+@register_kernel("nan_to_num")
+def nan_to_num_kernel(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register_kernel("trapezoid")
+def trapezoid_kernel(y, x=None, dx=1.0, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=dx, axis=axis)
+
+
+@register_kernel("ldexp")
+def ldexp_kernel(x, y):
+    return jnp.ldexp(x, y.astype(jnp.int32))
+
+
+@register_kernel("logit")
+def logit_kernel(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@register_kernel("polar")
+def polar_kernel(abs, angle):
+    return abs * jnp.exp(1j * angle.astype(jnp.complex64))
+
+
+@register_kernel("signbit")
+def signbit_kernel(x):
+    return jnp.signbit(x)
+
+
+@register_kernel("sgn")
+def sgn_kernel(x):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.maximum(mag, 1e-38))
+    return jnp.sign(x)
+
+
+@register_kernel("isneginf")
+def isneginf_kernel(x):
+    return jnp.isneginf(x)
+
+
+@register_kernel("isposinf")
+def isposinf_kernel(x):
+    return jnp.isposinf(x)
+
+
+@register_kernel("isreal")
+def isreal_kernel(x):
+    return jnp.isreal(x)
+
+
+@register_kernel("i0")
+def i0_kernel(x):
+    return jnp.i0(x)
+
+
+@register_kernel("i0e")
+def i0e_kernel(x):
+    return jax.scipy.special.i0e(x)
+
+
+@register_kernel("i1")
+def i1_kernel(x):
+    return jax.scipy.special.i1(x)
+
+
+@register_kernel("i1e")
+def i1e_kernel(x):
+    return jax.scipy.special.i1e(x)
+
+
+@register_kernel("frexp")
+def frexp_kernel(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+# -- search / indexing --------------------------------------------------------
+
+@register_kernel("take")
+def take_kernel(x, index, mode="raise"):
+    """mode='raise' bounds-checks on the host in eager calls (the op is
+    jit: false for exactly this); under to_static/jit tracing XLA cannot
+    raise on data-dependent indices, so out-of-range degrades to numpy-wrap
+    + edge-clamp — the one documented divergence from the reference."""
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = idx % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:
+        if not isinstance(idx, jax.core.Tracer):
+            bad = (np.asarray(idx) < -n) | (np.asarray(idx) >= n)
+            if bad.any():
+                raise IndexError(
+                    f"take(mode='raise'): index out of range for tensor "
+                    f"with {n} elements")
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return flat[idx]
+
+
+@register_kernel("bucketize")
+def bucketize_kernel(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    if out_int32 or not jax.config.jax_enable_x64:
+        return out.astype(jnp.int32)  # avoid the x64 truncation warning
+    return out.astype(jnp.int64)
+
+
+@register_kernel("cdist")
+def cdist_kernel(x, y, p=2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 0.0))
+    return (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+
+
+@register_kernel("index_fill")
+def index_fill_kernel(x, index, axis=0, value=0.0):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index.astype(jnp.int32)].set(value)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+@register_kernel("masked_scatter")
+def masked_scatter_kernel(x, mask, value):
+    # fill masked slots with consecutive elements of `value` (row-major).
+    # The reference errors when value has fewer elements than mask selects;
+    # a data-dependent raise is impossible under XLA, so the last element
+    # repeats instead (documented divergence)
+    flat_m = mask.reshape(-1).astype(bool)
+    order = jnp.cumsum(flat_m) - 1
+    vals = value.reshape(-1)[jnp.clip(order, 0, value.size - 1)]
+    out = jnp.where(flat_m, vals, x.reshape(-1))
+    return out.reshape(x.shape)
+
+
+# -- manipulation -------------------------------------------------------------
+
+@register_kernel("rot90")
+def rot90_kernel(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_kernel("unflatten")
+def unflatten_kernel(x, axis=0, shape=()):
+    ax = axis % x.ndim
+    new_shape = x.shape[:ax] + tuple(shape) + x.shape[ax + 1:]
+    return x.reshape(new_shape)
+
+
+@register_kernel("expand_as")
+def expand_as_kernel(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_kernel("view_as")
+def view_as_kernel(x, other):
+    return x.reshape(other.shape)
+
+
+@register_kernel("crop")
+def crop_kernel(x, shape=(), offsets=None):
+    offs = tuple(offsets) if offsets is not None else (0,) * x.ndim
+    # -1 in shape extends to the end of that dim (reference convention)
+    slices = tuple(slice(o, None if s == -1 else o + s)
+                   for o, s in zip(offs, shape))
+    return x[slices]
+
+
+@register_kernel("increment")
+def increment_kernel(x, value=1.0):
+    return x + value
+
+
+@register_kernel("block_diag")
+def block_diag_kernel(xs):
+    return jax.scipy.linalg.block_diag(*list(xs))
+
+
+@register_kernel("broadcast_tensors")
+def broadcast_tensors_kernel(xs):
+    return tuple(jnp.broadcast_arrays(*list(xs)))
+
+
+@register_kernel("column_stack")
+def column_stack_kernel(xs):
+    return jnp.column_stack(list(xs))
+
+
+@register_kernel("hstack")
+def hstack_kernel(xs):
+    return jnp.hstack(list(xs))
+
+
+@register_kernel("vstack")
+def vstack_kernel(xs):
+    return jnp.vstack(list(xs))
+
+
+@register_kernel("dstack")
+def dstack_kernel(xs):
+    return jnp.dstack(list(xs))
+
+
+@register_kernel("row_stack")
+def row_stack_kernel(xs):
+    return jnp.vstack(list(xs))
+
+
+@register_kernel("tensor_split")
+def tensor_split_kernel(x, num_or_indices=2, axis=0):
+    if isinstance(num_or_indices, int):
+        return tuple(jnp.array_split(x, num_or_indices, axis=axis))
+    return tuple(jnp.split(x, list(num_or_indices), axis=axis))
+
+
+@register_kernel("hsplit")
+def hsplit_kernel(x, num_or_indices=2):
+    parts = (num_or_indices if isinstance(num_or_indices, int)
+             else list(num_or_indices))
+    return tuple(jnp.hsplit(x, parts))
+
+
+@register_kernel("vsplit")
+def vsplit_kernel(x, num_or_indices=2):
+    parts = (num_or_indices if isinstance(num_or_indices, int)
+             else list(num_or_indices))
+    return tuple(jnp.vsplit(x, parts))
+
+
+@register_kernel("dsplit")
+def dsplit_kernel(x, num_or_indices=2):
+    parts = (num_or_indices if isinstance(num_or_indices, int)
+             else list(num_or_indices))
+    return tuple(jnp.dsplit(x, parts))
+
+
+@register_kernel("atleast_1d")
+def atleast_1d_kernel(x):
+    return jnp.atleast_1d(x)
+
+
+@register_kernel("atleast_2d")
+def atleast_2d_kernel(x):
+    return jnp.atleast_2d(x)
+
+
+@register_kernel("atleast_3d")
+def atleast_3d_kernel(x):
+    return jnp.atleast_3d(x)
+
+
+@register_kernel("diag_embed")
+def diag_embed_kernel(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1]
+    rows = jnp.arange(n) + max(-offset, 0)
+    cols = jnp.arange(n) + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (n + abs(offset), n + abs(offset)),
+                    x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    # move the two new dims into requested positions
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+@register_kernel("fill_diagonal")
+def fill_diagonal_kernel(x, value=0.0, offset=0, wrap=False):
+    if x.ndim > 2:
+        # reference semantics: ndim>2 requires a hypercube, fills the
+        # hyper-diagonal [i, i, ..., i]; offset/wrap are 2-D-only knobs
+        if offset != 0 or wrap:
+            raise ValueError(
+                "fill_diagonal: offset/wrap are unsupported for ndim > 2")
+        if len(set(x.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal: tensors with ndim > 2 must have all "
+                f"dimensions equal, got {x.shape}")
+        idx = jnp.arange(x.shape[0])
+        return x.at[tuple([idx] * x.ndim)].set(value)
+    rows_n, cols_n = x.shape[-2], x.shape[-1]
+    # offset-diagonal length for non-square matrices
+    if offset >= 0:
+        n = max(min(rows_n, cols_n - offset), 0)
+    else:
+        n = max(min(rows_n + offset, cols_n), 0)
+    if n == 0:
+        return x
+    rows = jnp.arange(n) + max(-offset, 0)
+    cols = jnp.arange(n) + max(offset, 0)
+    out = x.at[..., rows, cols].set(value)
+    if wrap and rows_n > cols_n and offset == 0:
+        # numpy-style wrapped diagonal on tall matrices
+        start = cols_n + 1
+        while start < rows_n:
+            m = min(cols_n, rows_n - start)
+            out = out.at[..., jnp.arange(m) + start, jnp.arange(m)].set(value)
+            start += cols_n + 1
+    return out
+
+
+@register_kernel("gather_tree")
+def gather_tree_kernel(ids, parents):
+    """Beam-search backtrace (reference gather_tree op): ids/parents
+    [T, B, beam] → full sequences re-threaded through parent pointers.
+    At time t the current beam emits ids[t][beams], THEN descends through
+    parents[t][beams]."""
+    T = ids.shape[0]
+
+    def step(beams, t):
+        tok = jnp.take_along_axis(ids[t], beams, axis=-1)
+        prev = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return prev, tok
+
+    last = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, toks = jax.lax.scan(step, last, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(toks, axis=0)

@@ -1,0 +1,212 @@
+"""MoE routing + expert-parallel dispatch kernels.
+
+Reference counterpart: `python/paddle/incubate/distributed/models/moe/
+moe_layer.py:99,149` (`MoEScatter`/`MoEGather` over the CUDA
+`global_scatter`/`global_gather` ops, `paddle/fluid/operators/collective/
+global_scatter_op*`) and the gate impls under `.../moe/gate/`.
+
+TPU-first design (SURVEY.md §2.5 EP row: "expert mesh axis + ragged
+all_to_all; Pallas grouped-GEMM"):
+
+- routing is *index-based*, not one-hot matmuls: top-k gating with a GShard
+  capacity bound produces per-expert slot indices `idx [E, C]`, combine
+  weights `w [E, C]` and live counts `counts [E]`. Dispatch is a gather
+  (O(E*C*h) bytes, no FLOPs); combine is a scatter-add. Compare the dense
+  formulation (dispatch one-hot [t, E*C] matmul = t*E*C*h MXU FLOPs —
+  quadratic in tokens since E*C grows with t).
+- expert parallelism shards the expert axis over a mesh axis: the capacity
+  buffer [E, C, h] is exchanged with ONE tiled `lax.all_to_all` per
+  direction (the ragged a2a — token validity rides `counts`, so peers
+  skip the padding in compute), each peer runs its local experts with the
+  grouped-GEMM Pallas kernel (kernels/pallas/grouped_gemm.py), and the
+  reverse a2a brings expert outputs home for the local combine.
+- the load-balance aux loss is the Switch-Transformer form, `pmean`ed over
+  the expert axis under EP.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ... import flags
+from ..dispatcher import register_kernel
+from .pallas.grouped_gemm import grouped_matmul
+
+
+def moe_capacity(num_tokens: int, top_k: int, num_experts: int,
+                 capacity_factor: float) -> int:
+    """Per-expert slot budget (reference moe/gate/topk_gate convention)."""
+    c = int(capacity_factor * num_tokens * top_k / num_experts)
+    return max(c, top_k, 4)
+
+
+def route_topk(x, gate_w, top_k: int, capacity: int):
+    """Top-k softmax routing with capacity-bounded slot assignment.
+
+    x [t, h], gate_w [h, E]  ->  (idx [E, C] int32 — token index per slot,
+    t for empty; w [E, C] f32 combine weight, 0 for empty/dropped;
+    counts [E] int32 live slots; aux scalar Switch load-balance loss).
+
+    Slot priority is (k, token-order): all k=0 assignments claim positions
+    before any k=1 assignment, matching the reference gate's per-k cumsum
+    with running counts. Tokens past capacity are dropped (GShard policy).
+    """
+    t = x.shape[0]
+    E = gate_w.shape[1]
+    K, C = top_k, capacity
+    logits = jnp.dot(x.astype(jnp.float32), gate_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                 # [t, E]
+    topv, topi = jax.lax.top_k(probs, K)                    # [t, K]
+
+    me = probs.mean(axis=0)
+    ce = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32).mean(axis=0)
+    aux = (me * ce).sum() * float(E)
+
+    # position of each (k, token) choice within its expert, k-major order
+    oh = jax.nn.one_hot(topi, E, dtype=jnp.int32)           # [t, K, E]
+    ohf = oh.transpose(1, 0, 2).reshape(K * t, E)           # k-major flat
+    pos_f = jnp.cumsum(ohf, axis=0) - ohf
+    pos = (pos_f * ohf).sum(-1).reshape(K, t)               # [K, t]
+    expert = topi.T                                         # [K, t]
+    keep = pos < C
+    wv = jnp.where(keep, topv.T, 0.0)                       # [K, t]
+    slot = jnp.where(keep, expert * C + pos, E * C)         # dummy slot E*C
+    token_ids = jnp.tile(jnp.arange(t, dtype=jnp.int32), K)
+    idx = jnp.full((E * C + 1,), t, jnp.int32) \
+        .at[slot.reshape(-1)].set(token_ids, mode="drop")
+    w = jnp.zeros((E * C + 1,), jnp.float32) \
+        .at[slot.reshape(-1)].set(wv.reshape(-1).astype(jnp.float32),
+                                  mode="drop")
+    counts = jnp.minimum(oh.sum(axis=(0, 1)), C).astype(jnp.int32)
+    return (idx[:E * C].reshape(E, C), w[:E * C].reshape(E, C), counts, aux)
+
+
+def _expert_mlp(expert_in, gate_proj, up_proj, down_proj, counts,
+                gpe: int, use_pallas: bool):
+    """SwiGLU expert FFN over the capacity buffer via grouped GEMM."""
+    g = grouped_matmul(expert_in, gate_proj, counts, gpe, use_pallas)
+    u = grouped_matmul(expert_in, up_proj, counts, gpe, use_pallas)
+    mid = (jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u)
+    return grouped_matmul(mid, down_proj, counts, gpe, use_pallas)
+
+
+def _dispatch_gather(x, idx):
+    """x [t, h], idx [E, C] -> [E, C, h]; empty slots (idx == t) read zeros."""
+    t = x.shape[0]
+    valid = idx < t
+    safe = jnp.where(valid, idx, 0)
+    out = jnp.take(x, safe, axis=0)                          # [E, C, h]
+    return jnp.where(valid[..., None], out, 0)
+
+
+def _combine_scatter(expert_out, idx, w, t: int):
+    """Weighted scatter-add of expert outputs back to token order."""
+    E, C, h = expert_out.shape
+    contrib = expert_out.astype(jnp.float32) * w[..., None]
+    out = jnp.zeros((t + 1, h), jnp.float32) \
+        .at[idx.reshape(-1)].add(contrib.reshape(E * C, h))
+    return out[:t]
+
+
+def _moe_local(x, gate_w, gate_proj, up_proj, down_proj,
+               top_k, capacity_factor, use_pallas):
+    """Single-shard routed-experts forward: route → gather → GEMM → scatter."""
+    t = x.shape[0]
+    E = gate_w.shape[1]
+    C = moe_capacity(t, top_k, E, capacity_factor)
+    idx, w, counts, aux = route_topk(x, gate_w, top_k, C)
+    expert_in = _dispatch_gather(x, idx)
+    expert_out = _expert_mlp(expert_in, gate_proj, up_proj, down_proj,
+                             counts, 1, use_pallas)
+    out = _combine_scatter(expert_out, idx, w, t)
+    return out.astype(x.dtype), aux
+
+
+def _moe_ep_body(x, gate_w, gate_proj, up_proj, down_proj,
+                 axis: str, n: int, top_k, capacity_factor, use_pallas):
+    """Per-device body under shard_map: x is the local token shard, the
+    expert weights are the local E/n experts; two tiled all_to_alls move
+    capacity buffers to expert owners and back (the global_scatter /
+    global_gather analog, ragged via counts)."""
+    t_l = x.shape[0]
+    E = gate_w.shape[1]
+    E_l = E // n
+    C = moe_capacity(t_l, top_k, E, capacity_factor)
+    idx, w, counts, aux = route_topk(x, gate_w, top_k, C)
+    expert_in = _dispatch_gather(x, idx)                     # [E, C, h]
+    # ragged a2a: each peer receives one C-segment per shard for its experts
+    ei = jax.lax.all_to_all(expert_in, axis, split_axis=0, concat_axis=1,
+                            tiled=True)                      # [E_l, n*C, h]
+    cnt = jax.lax.all_to_all(counts[:, None], axis, split_axis=0,
+                             concat_axis=1, tiled=True)      # [E_l, n]
+    h = ei.shape[-1]
+    eo = _expert_mlp(ei.reshape(E_l * n, C, h), gate_proj, up_proj,
+                     down_proj, cnt.reshape(E_l * n), n, use_pallas)
+    back = jax.lax.all_to_all(eo.reshape(E_l, n * C, h), axis, split_axis=1,
+                              concat_axis=0, tiled=True)     # [E, C, h]
+    out = _combine_scatter(back, idx, w, t_l)
+    return out.astype(x.dtype), jax.lax.pmean(aux, axis)
+
+
+_EP_CACHE: dict = {}
+
+
+@register_kernel("moe_ffn")
+def moe_ffn(x, gate_weight, gate_proj, up_proj, down_proj,
+            top_k=2, capacity_factor=1.25, expert_axis="dp",
+            use_pallas=None):
+    """Routed top-k expert FFN (reference MoELayer moe_layer.py:99).
+
+    x [t, h]; gate_weight [h, E]; gate/up_proj [E, h, m]; down_proj
+    [E, m, h]. Returns (out [t, h], aux_loss scalar). Under an active
+    hybrid topology with `expert_axis` degree > 1 and E divisible by it,
+    experts are sharded over that axis and dispatch runs as a tiled
+    all_to_all inside shard_map; otherwise single-shard local compute.
+    """
+    if use_pallas is None:
+        use_pallas = flags.get_flag("use_pallas_kernels")
+    use_pallas = bool(use_pallas)
+    E = gate_weight.shape[1]
+    from ...distributed.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    n = 0
+    if hcg is not None:
+        try:
+            n = hcg.axis_degree(expert_axis)
+        except KeyError:
+            n = 0
+    # shard_map needs even splits: fall back to single-shard compute for
+    # ragged token counts (last partial batch) or non-divisible expert counts
+    if n <= 1 or E % n != 0 or x.shape[0] % n != 0:
+        return _moe_local(x, gate_weight, gate_proj, up_proj, down_proj,
+                          int(top_k), float(capacity_factor), use_pallas)
+    mesh = hcg.mesh.mesh
+    key = (mesh, expert_axis, n, int(top_k), float(capacity_factor),
+           use_pallas)
+    fn = _EP_CACHE.get(key)
+    if fn is None:
+        def body(x, gw, gp, up, dp):
+            return _moe_ep_body(x, gw, gp, up, dp, expert_axis, n,
+                                int(top_k), float(capacity_factor),
+                                use_pallas)
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(expert_axis), P(), P(expert_axis), P(expert_axis),
+                      P(expert_axis)),
+            out_specs=(P(expert_axis), P()),
+            axis_names=frozenset({expert_axis}), check_vma=False)
+        _EP_CACHE[key] = fn
+    return fn(x, gate_weight, gate_proj, up_proj, down_proj)
+
+
+@register_kernel("grouped_gemm")
+def grouped_gemm(x, w, counts=None, groups_per_expert=1, use_pallas=None):
+    """Ragged grouped matmul y[g] = x[g] @ w[g // groups_per_expert]
+    (kernels/pallas/grouped_gemm.py; rows past counts[g] are zero and
+    C-tiles past counts[g] are skipped on the MXU)."""
+    if use_pallas is None:
+        use_pallas = flags.get_flag("use_pallas_kernels")
+    return grouped_matmul(x, w, counts, int(groups_per_expert),
+                          bool(use_pallas))

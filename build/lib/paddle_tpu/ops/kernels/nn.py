@@ -1,0 +1,724 @@
+"""Neural-network kernels: activations, norms, conv/pool, losses, attention.
+
+Reference: paddle/phi/kernels (softmax, layer_norm, conv, cross_entropy,
+dropout_impl, flash_attn_kernel.cu) and fusion/ (fused_rope, fused_rms_norm,
+fused_bias_act). Composite formulations here let XLA fuse into the
+surrounding matmuls; the attention/norm hot set has Pallas overrides in
+kernels/pallas/ selected by FLAGS_use_pallas_kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..dispatcher import register_kernel
+
+# -- activations --------------------------------------------------------------
+
+register_kernel("relu")(jax.nn.relu)
+register_kernel("relu6")(jax.nn.relu6)
+register_kernel("elu")(lambda x, alpha=1.0: jax.nn.elu(x, alpha))
+register_kernel("selu")(jax.nn.selu)
+register_kernel("celu")(lambda x, alpha=1.0: jax.nn.celu(x, alpha))
+register_kernel("softplus")(lambda x, beta=1.0, threshold=20.0:
+                            jnp.where(x * beta > threshold, x,
+                                      jax.nn.softplus(x * beta) / beta))
+register_kernel("softsign")(jax.nn.soft_sign)
+register_kernel("silu")(jax.nn.silu)
+register_kernel("swish")(jax.nn.silu)
+register_kernel("mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+register_kernel("hardswish")(jax.nn.hard_swish)
+register_kernel("hardsigmoid")(lambda x, slope=1/6, offset=0.5:
+                               jnp.clip(x * slope + offset, 0.0, 1.0))
+register_kernel("hardtanh")(lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max))
+register_kernel("leaky_relu")(lambda x, negative_slope=0.01:
+                              jax.nn.leaky_relu(x, negative_slope))
+register_kernel("prelu")(lambda x, weight: jnp.where(x >= 0, x, weight * x))
+register_kernel("tanhshrink")(lambda x: x - jnp.tanh(x))
+register_kernel("softshrink")(lambda x, threshold=0.5:
+                              jnp.where(x > threshold, x - threshold,
+                                        jnp.where(x < -threshold, x + threshold, 0.0)))
+register_kernel("hardshrink")(lambda x, threshold=0.5:
+                              jnp.where(jnp.abs(x) > threshold, x, 0.0))
+register_kernel("thresholded_relu")(lambda x, threshold=1.0:
+                                    jnp.where(x > threshold, x, 0.0))
+
+
+@register_kernel("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register_kernel("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register_kernel("swiglu")
+def swiglu(x, y=None):
+    """fused SwiGLU (reference phi/kernels/fusion swiglu): silu(x) * y."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@register_kernel("softmax")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_kernel("log_softmax")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_kernel("gumbel_softmax")
+def gumbel_softmax(x, key=None, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        # straight-through: forward one-hot, backward d(soft)/dx
+        y = y_hard + y - lax.stop_gradient(y)
+    return y
+
+
+# -- linear / embedding -------------------------------------------------------
+
+@register_kernel("linear")
+def linear(x, weight, bias=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_kernel("embedding")
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return out
+
+
+# -- normalization ------------------------------------------------------------
+
+@register_kernel("layer_norm")
+def layer_norm(x, weight=None, bias=None, epsilon=1e-05, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim)) if begin_norm_axis != -1 \
+        else (x.ndim - 1,)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_kernel("rms_norm")
+def rms_norm(x, weight=None, bias=None, epsilon=1e-06, begin_norm_axis=-1):
+    """fused rms_norm (reference phi/kernels/fusion/gpu/fused_rms_norm*)."""
+    axes = (x.ndim - 1,) if begin_norm_axis == -1 else \
+        tuple(range(begin_norm_axis % x.ndim, x.ndim))
+    acc = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(acc), axis=axes, keepdims=True)
+    out = (acc * lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_kernel("batch_norm_infer")
+def batch_norm_infer(x, running_mean, running_var, weight=None, bias=None,
+                     epsilon=1e-05, data_format="NCHW"):
+    shape = [1, -1] + [1] * (x.ndim - 2) if data_format == "NCHW" else \
+        [1] * (x.ndim - 1) + [-1]
+    mean = running_mean.reshape(shape)
+    var = running_var.reshape(shape)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_kernel("batch_norm_train")
+def batch_norm_train(x, weight=None, bias=None, epsilon=1e-05, data_format="NCHW"):
+    """Returns (out, batch_mean, batch_var); running stats update is host-side."""
+    if data_format == "NCHW":
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = [1, -1] + [1] * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = [1] * (x.ndim - 1) + [-1]
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+@register_kernel("group_norm")
+def group_norm(x, weight=None, bias=None, epsilon=1e-05, groups=1, data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    N, C = x.shape[:2]
+    g = x.reshape((N, groups, C // groups) + x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1, C] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("instance_norm")
+def instance_norm(x, weight=None, bias=None, epsilon=1e-05):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+# -- convolution / pooling ----------------------------------------------------
+
+def _conv_dn(ndim, data_format):
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        spec = "NC" + "DHW"[3 - (ndim - 2):]
+    else:
+        spec = "N" + "DHW"[3 - (ndim - 2):] + "C"
+    rhs = "OI" + "DHW"[3 - (ndim - 2):]
+    return lax.conv_dimension_numbers((1,) * ndim, (1,) * ndim, (spec, rhs, spec))
+
+
+@register_kernel("conv2d")
+def conv2d(x, weight, bias=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
+           groups=1, data_format="NCHW"):
+    """Conv lowers to one XLA conv_general_dilated → MXU
+    (reference paddle/phi/kernels/gpu/conv_kernel.cu → cuDNN)."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        if isinstance(padding, int):
+            padding = (padding, padding)
+        pad = [(p, p) for p in padding] if len(padding) == 2 else \
+            [tuple(padding[:2]), tuple(padding[2:])]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+                                    else ("NHWC", "OIHW", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=tuple(stride), padding=pad,
+        rhs_dilation=tuple(dilation), dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(bshape)
+    return out.astype(x.dtype)
+
+
+@register_kernel("conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    x4 = x[:, :, None, :] if data_format == "NCL" else x[:, None, :, :]
+    w4 = weight[:, :, None, :]
+    st = (1, stride if isinstance(stride, int) else stride[0])
+    dl = (1, dilation if isinstance(dilation, int) else dilation[0])
+    if isinstance(padding, str):
+        pd = padding
+    else:
+        p = padding if isinstance(padding, int) else padding[0]
+        pd = (0, p)
+    out = conv2d(x4, w4, bias, stride=st, padding=pd, dilation=dl, groups=groups,
+                 data_format="NCHW" if data_format == "NCL" else "NHWC")
+    return out[:, :, 0, :] if data_format == "NCL" else out[:, 0, :, :]
+
+
+@register_kernel("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=(1, 1), padding=(0, 0),
+                     output_padding=(0, 0), dilation=(1, 1), groups=1,
+                     data_format="NCHW"):
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if isinstance(output_padding, int):
+        output_padding = (output_padding, output_padding)
+    # weight layout IOHW (paddle conv_transpose stores [in, out//groups, kh, kw])
+    kh, kw = weight.shape[2], weight.shape[3]
+    pad = [(dilation[0] * (kh - 1) - padding[0],
+            dilation[0] * (kh - 1) - padding[0] + output_padding[0]),
+           (dilation[1] * (kw - 1) - padding[1],
+            dilation[1] * (kw - 1) - padding[1] + output_padding[1])]
+    w = jnp.flip(weight, axis=(2, 3))
+    w = jnp.swapaxes(w, 0, 1)  # -> [out//g, in, kh, kw] as OIHW
+    if groups > 1:
+        # regroup for grouped transpose conv
+        ci = x.shape[1]
+        w = weight.reshape(groups, ci // groups, -1, kh, kw)
+        w = jnp.flip(w, axis=(3, 4))
+        w = jnp.swapaxes(w, 1, 2).reshape(-1, ci // groups, kh, kw)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad, lhs_dilation=tuple(stride),
+        rhs_dilation=tuple(dilation), dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool(x, ksize, stride, padding, data_format, init, op, count_include_pad=True):
+    if isinstance(ksize, int):
+        ksize = (ksize, ksize)
+    if stride is None:
+        stride = ksize
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if data_format == "NCHW":
+        window = (1, 1) + tuple(ksize)
+        strides = (1, 1) + tuple(stride)
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    else:
+        window = (1,) + tuple(ksize) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+    return lax.reduce_window(x, init, op, window, strides, pads), window, pads, strides
+
+
+@register_kernel("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW"):
+    out, *_ = _pool(x, kernel_size, stride, padding, data_format,
+                    -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else
+                    jnp.iinfo(x.dtype).min, lax.max)
+    return out
+
+
+@register_kernel("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCHW"):
+    out, window, pads, strides = _pool(x, kernel_size, stride, padding,
+                                       data_format, 0.0, lax.add)
+    if exclusive and any(p != (0, 0) for p in pads):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return out / counts
+    denom = 1
+    for w in window:
+        denom *= w
+    return out / denom
+
+
+def _adaptive_bins(in_size, out_size):
+    """paddle bin i covers [floor(i*H/oh), ceil((i+1)*H/oh))."""
+    return [(i * in_size // out_size,
+             -(-((i + 1) * in_size) // out_size)) for i in range(out_size)]
+
+
+def _adaptive_pool2d(x, output_size, reduce_fn, data_format):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    N, C, H, W = x.shape
+    oh, ow = output_size
+    oh = H if oh is None else oh   # None = keep input extent (reference
+    ow = W if ow is None else ow   # adaptive_avg_pool2d accepts None)
+    if H % oh == 0 and W % ow == 0:
+        # uniform bins: single reshape-reduce, fuses cleanly in XLA
+        x6 = x.reshape(N, C, oh, H // oh, ow, W // ow)
+        out = reduce_fn(x6, axis=(3, 5))
+    else:
+        # non-uniform (incl. upsampling oh>H): static python loop over bins
+        rows = [reduce_fn(x[:, :, a:b, :], axis=2, keepdims=True)
+                for a, b in _adaptive_bins(H, oh)]
+        xr = jnp.concatenate(rows, axis=2)
+        cols = [reduce_fn(xr[:, :, :, a:b], axis=3, keepdims=True)
+                for a, b in _adaptive_bins(W, ow)]
+        out = jnp.concatenate(cols, axis=3)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool2d(x, output_size, jnp.mean, data_format)
+
+
+@register_kernel("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool2d(x, output_size, jnp.max, data_format)
+
+
+@register_kernel("interpolate_nearest")
+def interpolate_nearest(x, out_h, out_w, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = jax.image.resize(x, (n, c, out_h, out_w), method="nearest")
+    else:
+        n, h, w, c = x.shape
+        out = jax.image.resize(x, (n, out_h, out_w, c), method="nearest")
+    return out
+
+
+@register_kernel("interpolate_bilinear")
+def interpolate_bilinear(x, out_h, out_w, align_corners=False, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    if align_corners and out_h > 1 and out_w > 1:
+        # sample at i*(in-1)/(out-1) via order-1 map_coordinates
+        yy = jnp.linspace(0.0, h - 1.0, out_h)
+        xx = jnp.linspace(0.0, w - 1.0, out_w)
+        gy, gx = jnp.meshgrid(yy, xx, indexing="ij")
+        flat = x.reshape(n * c, h, w)
+        out = jax.vmap(lambda im: jax.scipy.ndimage.map_coordinates(
+            im, [gy, gx], order=1))(flat)
+        out = out.reshape(n, c, out_h, out_w).astype(x.dtype)
+    else:
+        out = jax.image.resize(x, (n, c, out_h, out_w), method="bilinear")
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_kernel("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_kernel("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = (kernel_sizes, kernel_sizes)
+    if isinstance(strides, int):
+        strides = (strides, strides)
+    if isinstance(paddings, int):
+        paddings = (paddings, paddings)
+    if isinstance(dilations, int):
+        dilations = (dilations, dilations)
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=kernel_sizes, window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c) + tuple(kernel_sizes), ("NCHW", "OIHW", "NCHW")))
+    return patches.reshape(n, c * kernel_sizes[0] * kernel_sizes[1], -1)
+
+
+# -- losses -------------------------------------------------------------------
+
+@register_kernel("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               axis=-1):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+        nll = -jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.where(lab == ignore_index, 0, lab), axis),
+            axis=axis)
+        mask = jnp.expand_dims(lab != ignore_index, axis)
+        loss = jnp.where(mask, nll, 0.0)
+    return loss
+
+
+@register_kernel("cross_entropy_mean")
+def cross_entropy_mean(logits, label, weight=None, soft_label=False,
+                       ignore_index=-100, axis=-1, reduction="mean"):
+    loss = softmax_with_cross_entropy(logits, label, soft_label, ignore_index, axis)
+    loss = jnp.squeeze(loss, axis=axis)
+    if not soft_label and label.ndim == logits.ndim and label.shape[axis] == 1:
+        label = jnp.squeeze(label, axis=axis)  # (N,1) hard labels -> (N,)
+    if weight is not None and not soft_label:
+        w = jnp.take(weight, jnp.where(label == ignore_index, 0, label))
+        w = jnp.where(label == ignore_index, 0.0, w)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        if not soft_label:
+            valid = (label != ignore_index).astype(loss.dtype)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_kernel("nll_loss")
+def nll_loss(log_prob, label, weight=None, ignore_index=-100, reduction="mean"):
+    if label.ndim == log_prob.ndim and label.shape[-1] == 1:
+        label = jnp.squeeze(label, axis=-1)  # (N,1) -> (N,)
+    nll = -jnp.take_along_axis(log_prob, label[..., None], axis=-1)
+    nll = jnp.squeeze(nll, axis=-1)
+    mask = (label != ignore_index).astype(log_prob.dtype)
+    if weight is not None:
+        w = jnp.take(weight, jnp.where(label == ignore_index, 0, label)) * mask
+    else:
+        w = mask
+    nll = nll * w
+    if reduction == "mean":
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
+
+
+@register_kernel("mse_loss")
+def mse_loss(input, label, reduction="mean"):
+    loss = jnp.square(input - label)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_kernel("l1_loss")
+def l1_loss(input, label, reduction="mean"):
+    loss = jnp.abs(input - label)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_kernel("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = input - label
+    loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta,
+                     jnp.abs(d) - 0.5 * delta)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_kernel("binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.clip(input, eps)) +
+             (1 - label) * jnp.log(jnp.clip(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_kernel("binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None, pos_weight=None,
+                                     reduction="mean"):
+    max_val = jnp.clip(-logit, 0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_kernel("kl_div")
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe = jnp.where(label > 0, label, 1.0)
+        loss = jnp.where(label > 0, label * (jnp.log(safe) - input), 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_kernel("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.clip(n1 * n2, eps)
+
+
+@register_kernel("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input, jnp.clip(margin - input, 0))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# -- attention & rope ---------------------------------------------------------
+
+@register_kernel("scaled_dot_product_attention")
+def scaled_dot_product_attention(query, key, value, attn_mask=None, rng_key=None,
+                                 dropout_p=0.0, is_causal=False, scale=None):
+    """Reference composite path (paddle/phi/kernels/gpu/flash_attn_kernel.cu
+    dispatches to the flash-attn lib; the Pallas override lives in
+    kernels/pallas/flash_attention.py). Layout: [batch, seq, heads, dim]."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    q = jnp.swapaxes(query, 1, 2)  # b h s d
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    # grouped-query attention: broadcast kv heads
+    if k.shape[1] != h:
+        rep = h // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and rng_key is not None:
+        keep = 1.0 - dropout_p
+        mask_d = jax.random.bernoulli(rng_key, keep, probs.shape)
+        probs = jnp.where(mask_d, probs / keep, 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@register_kernel("ring_attention")
+def ring_attention(query, key, value, is_causal=False, scale=None):
+    """Sequence-parallel attention: q resident, K/V rotated over the `sep`
+    ring (kernels/pallas/ring_attention.py). Requires an active hybrid
+    topology with sep_degree > 1; falls back to the composite otherwise."""
+    from ...distributed.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.get_sep_parallel_world_size() <= 1:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=is_causal, scale=scale)
+    from .pallas import ring_attention as ra
+    return ra.ring_attention(query, key, value, hcg.mesh.mesh, "sep",
+                             causal=is_causal, scale=scale)
+
+
+@register_kernel("rope")
+def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=True):
+    """fused rotary embedding (reference phi/kernels/fusion/gpu/fused_rope*).
+
+    q/k: [batch, seq, heads, head_dim]; cos/sin: [seq, head_dim] or
+    [1, seq, 1, head_dim]. rotate_half_style=True is the neox convention
+    (halves rotated, matching the half-concat cos/sin tables);
+    False is GPT-J interleaved pairs (tables re-laid to repeat per pair)."""
+    def rot(x):
+        if rotate_half_style:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([-x2, x1], axis=-1)
+        x1 = x[..., ::2]
+        x2 = x[..., 1::2]
+        return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+    def relayout(t):
+        if rotate_half_style:
+            return t
+        # half-concat [f0..f_{d/2-1}, f0..] -> interleaved [f0,f0,f1,f1,..]
+        half = t[..., : t.shape[-1] // 2]
+        return jnp.repeat(half, 2, axis=-1)
+
+    def bshape(t, like):
+        if t.ndim == 2:  # [seq, dim]
+            t = t[None, :, None, :]
+        return t.astype(like.dtype)
+
+    if position_ids is not None:
+        # accept [seq, dim] or [1, seq, 1, dim] tables
+        cos = jnp.take(cos.reshape(-1, cos.shape[-1]), position_ids, axis=0)
+        sin = jnp.take(sin.reshape(-1, sin.shape[-1]), position_ids, axis=0)
+        cos = relayout(cos)[:, :, None, :].astype(q.dtype)
+        sin = relayout(sin)[:, :, None, :].astype(q.dtype)
+    else:
+        cos = bshape(relayout(cos), q)
+        sin = bshape(relayout(sin), q)
+    out_q = q * cos + rot(q) * sin
+    if k is not None:
+        out_k = k * cos + rot(k) * sin
+        return out_q, out_k
+    return out_q
+
+
+@register_kernel("flash_attention")
+def flash_attention(query, key, value, attn_mask=None, rng_key=None,
+                    dropout_p=0.0, is_causal=False, scale=None):
+    """Routes to the Pallas flash kernel when enabled (ops/kernels/pallas),
+    else the XLA composite above."""
+    from ... import flags
+    if flags.get_flag("use_pallas_kernels") and attn_mask is None \
+            and dropout_p == 0.0:
+        try:
+            from .pallas import flash_attention as fa
+        except ImportError:
+            fa = None
+        if fa is not None and fa.supported(query.shape, key.shape, is_causal):
+            return fa.flash_attention(query, key, value, causal=is_causal,
+                                      scale=scale)
+    return scaled_dot_product_attention(query, key, value, attn_mask=attn_mask,
+                                        rng_key=rng_key, dropout_p=dropout_p,
+                                        is_causal=is_causal, scale=scale)

@@ -1,0 +1,9 @@
+"""Hand-written Pallas TPU kernels for the hot op set.
+
+Reference counterpart: the CUDA fused kernels under
+`paddle/phi/kernels/fusion/gpu/` and the dynloaded flash-attention library
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu:91,199`). Here the kernels are
+authored in Pallas/Mosaic and selected by the op dispatcher when
+`FLAGS_use_pallas_kernels` is set and shapes qualify; otherwise ops fall back
+to their XLA composite definitions (which XLA fuses on its own).
+"""

@@ -1,0 +1,157 @@
+"""Grouped (ragged) GEMM — the MoE expert-compute kernel.
+
+Reference counterpart: the reference's MoE runs per-expert matmuls after a
+`global_scatter` exchange (`python/paddle/incubate/distributed/models/moe/
+moe_layer.py:99,149`, CUDA ops `paddle/fluid/operators/collective/
+global_scatter_op*`); SURVEY.md §2.5 (EP row) prescribes "expert mesh axis +
+ragged all_to_all; Pallas grouped-GEMM" for the TPU build.
+
+Contract
+--------
+    grouped_matmul(x, w, counts, groups_per_expert=1) -> y
+
+    x      [G, C, K]   token buffer: G groups of capacity C
+    w      [E, K, N]   per-expert weights, expert of group g = g // gpe
+                       (gpe = G // E; >1 after an all-to-all that splits
+                       each expert's buffer into one segment per EP peer)
+    counts [G] int32   valid rows per group; rows c >= counts[g] are zero
+    y      [G, C, N]
+
+The kernel grid is (G, C-tiles, N-tiles, K-tiles) with a VMEM f32
+accumulator revisited across the K dimension. C-tiles that start at or
+beyond counts[g] are predicated off with `pl.when`, so MXU FLOPs scale with
+the number of *routed* tokens, not with G*C — that is the "ragged" part:
+capacity padding costs bandwidth but not compute.
+
+Backward: dx reuses the same kernel with w transposed (row-sparsity of the
+cotangent matches the forward); dw is a dense batched einsum over
+count-masked x (dw needs a cross-group reduction per expert, which XLA's
+batched matmul already does well on the MXU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _gmm_kernel(counts_ref, x_ref, w_ref, o_ref, acc_scr, *, bc, bn, nk):
+    g, ci, ki = pl.program_id(0), pl.program_id(1), pl.program_id(3)
+    cnt = counts_ref[g]
+    live = ci * bc < cnt
+
+    @pl.when(ki == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(live)
+    def _():
+        acc_scr[...] += jnp.dot(x_ref[0], w_ref[0],
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        rows = ci * bc + jax.lax.broadcasted_iota(jnp.int32, (bc, bn), 0)
+        o_ref[0] = jnp.where(rows < cnt, acc_scr[...], 0.0).astype(o_ref.dtype)
+
+
+def _gmm_impl(x, w, counts, gpe: int):
+    G, C, K = x.shape
+    E, _, N = w.shape
+    out_dtype = x.dtype
+    # tile sizes: sublane multiples on the row dim, lane (128) multiples on
+    # the minor dims; small shapes collapse to one padded tile
+    bc = 128 if C >= 128 else _ceil_to(C, 8)
+    bk = 512 if K >= 512 else _ceil_to(K, 128)
+    bn = 512 if N >= 512 else _ceil_to(N, 128)
+    Cp, Kp, Np = _ceil_to(C, bc), _ceil_to(K, bk), _ceil_to(N, bn)
+    if (Cp, Kp) != (C, K):
+        x = jnp.pad(x, ((0, 0), (0, Cp - C), (0, Kp - K)))
+    if (Kp, Np) != (K, N):
+        w = jnp.pad(w, ((0, 0), (0, Kp - K), (0, Np - N)))
+    nc, nn, nk = Cp // bc, Np // bn, Kp // bk
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(G, nc, nn, nk),
+        in_specs=[
+            pl.BlockSpec((1, bc, bk), lambda g, ci, ni, ki, *_: (g, ci, ki)),
+            pl.BlockSpec((1, bk, bn),
+                         lambda g, ci, ni, ki, *_, gpe=gpe: (g // gpe, ki, ni)),
+        ],
+        out_specs=pl.BlockSpec((1, bc, bn),
+                               lambda g, ci, ni, ki, *_: (g, ci, ni)),
+        scratch_shapes=[pltpu.VMEM((bc, bn), jnp.float32)],
+    )
+    y = pl.pallas_call(
+        functools.partial(_gmm_kernel, bc=bc, bn=bn, nk=nk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((G, Cp, Np), out_dtype),
+        interpret=_interpret(),
+    )(counts.astype(jnp.int32), x, w)
+    return y[:, :C, :N]
+
+
+def gmm_reference(x, w, counts, groups_per_expert: int = 1):
+    """Dense-math reference: count-masked batched matmul (also the CPU/XLA
+    fallback and the numerical golden for the Pallas kernel)."""
+    G, C, K = x.shape
+    E, _, N = w.shape
+    gpe = groups_per_expert
+    rows = jax.lax.broadcasted_iota(jnp.int32, (G, C), 1) < counts[:, None]
+    xm = jnp.where(rows[..., None], x, 0)
+    wg = jnp.repeat(w, gpe, axis=0) if gpe > 1 else w
+    y = jax.lax.dot_general(
+        xm, wg, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    return jnp.where(rows[..., None], y, 0.0).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _gmm(x, w, counts, gpe, use_pallas):
+    if use_pallas:
+        return _gmm_impl(x, w, counts, gpe)
+    return gmm_reference(x, w, counts, gpe)
+
+
+def _gmm_fwd(x, w, counts, gpe, use_pallas):
+    return _gmm(x, w, counts, gpe, use_pallas), (x, w, counts)
+
+
+def _gmm_bwd(gpe, use_pallas, res, dy):
+    x, w, counts = res
+    G, C, K = x.shape
+    E = w.shape[0]
+    dx = _gmm(dy, jnp.swapaxes(w, 1, 2), counts, gpe, use_pallas)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (G, C), 1) < counts[:, None]
+    xm = jnp.where(rows[..., None], x, 0).astype(jnp.float32)
+    dym = jnp.where(rows[..., None], dy, 0).astype(jnp.float32)
+    dw = jnp.einsum("egck,egcn->ekn",
+                    xm.reshape(E, gpe, C, K),
+                    dym.reshape(E, gpe, C, -1)).astype(w.dtype)
+    return dx, dw, None
+
+
+_gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def grouped_matmul(x, w, counts=None, groups_per_expert: int = 1,
+                   use_pallas: bool = True):
+    """Public entry. counts=None means all C rows of every group are valid."""
+    G, C, K = x.shape
+    if counts is None:
+        counts = jnp.full((G,), C, jnp.int32)
+    return _gmm(x, w, counts.astype(jnp.int32), groups_per_expert,
+                bool(use_pallas))

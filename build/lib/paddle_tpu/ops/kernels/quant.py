@@ -1,0 +1,43 @@
+"""Fake-quantize kernel with straight-through-estimator VJP (reference
+phi/kernels/fake_quantize_kernel + fake_quantize_grad: pass-through inside
+the representable range). Declared with jax.custom_vjp so the dispatcher's
+auto-VJP (jax.vjp of the kernel) picks up the STE instead of round()'s
+zero gradient.
+
+`scale` is a TENSOR input (as in the reference kernel), not an attr: QAT
+observers update it every step, and an attr would recompile + grow the
+per-op exec cache unboundedly."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatcher import register_kernel
+
+
+@jax.custom_vjp
+def _fq(x, step, qmin, qmax):
+    return jnp.clip(jnp.round(x / step), qmin, qmax) * step
+
+
+def _fq_fwd(x, step, qmin, qmax):
+    return _fq(x, step, qmin, qmax), (x, step, qmin, qmax)
+
+
+def _fq_bwd(res, ct):
+    x, step, qmin, qmax = res
+    inside = (x / step >= qmin) & (x / step <= qmax)
+    return (jnp.where(inside, ct, 0.0), jnp.zeros_like(step),
+            jnp.zeros_like(qmin), jnp.zeros_like(qmax))
+
+
+_fq.defvjp(_fq_fwd, _fq_bwd)
+
+
+@register_kernel("fake_quantize")
+def fake_quantize_kernel(x, scale, bit_length=8):
+    """scale: observed abs-max of x (scalar tensor); step = scale / qmax."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    step = jnp.maximum(scale.astype(x.dtype) / qmax, 1e-9)
+    return _fq(x, step, -qmax - 1.0, qmax)

@@ -1,0 +1,90 @@
+"""Random-sampling kernels.
+
+Every kernel takes an explicit threefry `key` (injected by the dispatcher
+from the global stateful Generator, paddle_tpu/core/generator.py). This is
+the TPU-native replacement for the reference's per-device Philox state
+(paddle/phi/core/generator.h): the key is a primal argument, so cached-VJP
+recompute (dropout backward) is deterministic by construction.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dtype as dtype_mod
+from ..dispatcher import register_kernel
+
+
+def _dt(dtype):
+    return dtype if dtype is not None else dtype_mod.get_default_dtype()
+
+
+@register_kernel("uniform")
+def uniform(key=None, shape=(), dtype=None, min=0.0, max=1.0):
+    return jax.random.uniform(key, shape, dtype=_dt(dtype), minval=min, maxval=max)
+
+
+@register_kernel("gaussian")
+def gaussian(key=None, shape=(), mean=0.0, std=1.0, dtype=None):
+    return mean + std * jax.random.normal(key, shape, dtype=_dt(dtype))
+
+
+@register_kernel("randint")
+def randint(key=None, low=0, high=None, shape=(), dtype=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, shape, low, high, dtype=dtype or jnp.int32)
+
+
+@register_kernel("randperm")
+def randperm(key=None, n=0, dtype=None):
+    return jax.random.permutation(key, n).astype(dtype or jnp.int32)
+
+
+@register_kernel("bernoulli")
+def bernoulli(x, key=None):
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+@register_kernel("multinomial")
+def multinomial(x, key=None, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(x, 1e-30))
+    if replacement:
+        return jax.random.categorical(key, logits, axis=-1,
+                                      shape=x.shape[:-1] + (num_samples,)).astype(jnp.int32)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape, dtype=jnp.float32)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int32)
+
+
+@register_kernel("normal_like")
+def normal_like(x, key=None, mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(key, x.shape, dtype=x.dtype)
+
+
+@register_kernel("exponential")
+def exponential(x, key=None, lam=1.0):
+    return jax.random.exponential(key, x.shape, dtype=x.dtype) / lam
+
+
+@register_kernel("poisson")
+def poisson(x, key=None):
+    return jax.random.poisson(key, x, dtype=jnp.int32).astype(x.dtype)
+
+
+@register_kernel("dropout")
+def dropout(x, key=None, p=0.5, training=True, mode="upscale_in_train"):
+    """reference paddle/phi/kernels/funcs/dropout_impl.cu.h; differentiable —
+    the key primal makes VJP-recompute reuse the same mask."""
+    if not training or p == 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+@register_kernel("shuffle")
+def shuffle(x, key=None, axis=0):
+    return jax.random.permutation(key, x, axis=axis)

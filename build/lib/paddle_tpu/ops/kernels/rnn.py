@@ -1,0 +1,173 @@
+"""Recurrent kernels: single-layer LSTM/GRU/vanilla-RNN scans + CTC loss.
+
+Reference: paddle/phi/kernels/cpu|gpu/rnn_kernel (cuDNN RNN on GPU) and
+warpctc (cmake/external/warpctc.cmake) for CTC.
+
+TPU-native: one `lax.scan` over time per layer — the whole recurrence is a
+single fused XLA loop (grads = BPTT through the scan via jax.vjp, no hand
+backward); CTC is the log-space alpha recursion as a scan (SURVEY §2.7
+"XLA-composite CTC"). Gate chunk order [i, f, g, o] (LSTM) and [r, z, n]
+(GRU) matches the reference's cell definitions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatcher import register_kernel
+
+_NEG_INF = -1e30
+
+
+def _seq_prepare(x, lens, reverse):
+    """Variable-length + direction handling for the time-major scan.
+
+    reverse with lens: each sequence is reversed WITHIN its valid range
+    (index t ↦ lens-1-t), so the backward pass starts at the true last
+    element, not at padding. Returns (x_scan, live[T,B] mask, restore fn).
+    """
+    T, B = x.shape[0], x.shape[1]
+    if lens is None:
+        live = jnp.ones((T, B), bool)
+        if not reverse:
+            return x, live, lambda out: out
+        return jnp.flip(x, axis=0), live, lambda out: jnp.flip(out, axis=0)
+    lens = lens.astype(jnp.int32)
+    ts = jnp.arange(T)[:, None]                       # [T, 1]
+    live = ts < lens[None, :]                         # [T, B]
+    if not reverse:
+        return x, live, lambda out: out * live[..., None].astype(out.dtype)
+    idx = jnp.where(live, lens[None, :] - 1 - ts, ts)  # involution in-range
+    x_rev = x[idx, jnp.arange(B)[None, :]]
+
+    def restore(out):
+        back = out[idx, jnp.arange(B)[None, :]]
+        return back * live[..., None].astype(out.dtype)
+
+    return x_rev, live, restore
+
+
+@register_kernel("lstm_layer")
+def lstm_layer_kernel(x, w_ih, w_hh, b_ih, b_hh, h0, c0, lens=None,
+                      reverse=False):
+    """x[T,B,I]; w_ih[4H,I]; w_hh[4H,H]; b*[4H]; h0/c0[B,H] →
+    (out[T,B,H], hT, cT). lens[B] masks padded steps (carry frozen, outputs
+    zeroed); reverse flips within each sequence's valid range."""
+    x_scan, live, restore = _seq_prepare(x, lens, reverse)
+
+    def step(carry, inp):
+        h, c = carry
+        xt, lv = inp
+        gates = xt @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        m = lv[:, None]
+        h = jnp.where(m, h_new, h)
+        c = jnp.where(m, c_new, c)
+        return (h, c), h
+
+    (hT, cT), out = jax.lax.scan(step, (h0, c0), (x_scan, live))
+    return restore(out), hT, cT
+
+
+@register_kernel("gru_layer")
+def gru_layer_kernel(x, w_ih, w_hh, b_ih, b_hh, h0, lens=None,
+                     reverse=False):
+    """x[T,B,I]; w_ih[3H,I]; w_hh[3H,H]; b*[3H]; h0[B,H] → (out, hT)."""
+    x_scan, live, restore = _seq_prepare(x, lens, reverse)
+
+    def step(h, inp):
+        xt, lv = inp
+        gi = xt @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h_new = (1 - z) * n + z * h
+        h = jnp.where(lv[:, None], h_new, h)
+        return h, h
+
+    hT, out = jax.lax.scan(step, h0, (x_scan, live))
+    return restore(out), hT
+
+
+@register_kernel("simple_rnn_layer")
+def simple_rnn_layer_kernel(x, w_ih, w_hh, b_ih, b_hh, h0, lens=None,
+                            reverse=False, activation="tanh"):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    x_scan, live, restore = _seq_prepare(x, lens, reverse)
+
+    def step(h, inp):
+        xt, lv = inp
+        h_new = act(xt @ w_ih.T + h @ w_hh.T + b_ih + b_hh)
+        h = jnp.where(lv[:, None], h_new, h)
+        return h, h
+
+    hT, out = jax.lax.scan(step, h0, (x_scan, live))
+    return restore(out), hT
+
+
+@register_kernel("ctc_loss")
+def ctc_loss_kernel(log_probs, labels, input_lengths, label_lengths,
+                    blank=0, norm_by_times=False):
+    """CTC negative log-likelihood per batch element.
+
+    log_probs: [T, B, C] log-softmaxed; labels: [B, L] padded; lengths [B].
+    Log-space alpha recursion over the blank-extended label sequence
+    (length S = 2L+1), scanned over time.
+    """
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+
+    # transition mask: alpha[s] may come from s, s-1, and s-2 when
+    # ext[s] != blank and ext[s] != ext[s-2]
+    same = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (~same)
+
+    def emit(t):
+        return jnp.take_along_axis(log_probs[t], ext, axis=1)  # [B, S]
+
+    alpha0 = jnp.full((B, S), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, :, blank])
+    first = jnp.take_along_axis(log_probs[0], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, first,
+                                           _NEG_INF))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new_alpha = merged + emit(t)
+        # frozen past input length: carry alpha unchanged
+        live = (t < input_lengths)[:, None]
+        return jnp.where(live, new_alpha, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+
+    # likelihood ends at ext position 2*label_len (final blank) or
+    # 2*label_len - 1 (final label)
+    end = (2 * label_lengths).astype(jnp.int32)
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_end1 = jnp.take_along_axis(
+        alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(a_end, jnp.where(label_lengths > 0, a_end1,
+                                        _NEG_INF))
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(loss.dtype), 1)
+    return loss

@@ -1,0 +1,119 @@
+"""Serving-path kernels: KV-cache write + cache/paged attention.
+
+Reference: phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu (paged
+KV decode attention) and the write-cache/masked-attention pieces of the
+fused_multi_transformer serving path.
+
+TPU-native: fixed-capacity cache buffers with dynamic-slice writes (position
+is a TENSOR input, so every decode step reuses one compiled executable), and
+paged attention as block-table gather + masked SDPA — XLA keeps the gather
+and the attention in one fusion; a Pallas specialization can override via
+the same op names.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatcher import register_kernel
+from .nn import scaled_dot_product_attention
+
+
+@register_kernel("cache_write")
+def cache_write_kernel(cache, new, pos):
+    """cache[B,T,H,D]; new[B,S,H,D]; pos scalar → cache with new written at
+    [:, pos:pos+S]. Donation-friendly pure update."""
+    return jax.lax.dynamic_update_slice(
+        cache, new.astype(cache.dtype),
+        (jnp.zeros((), jnp.int32), pos.astype(jnp.int32),
+         jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
+
+
+@register_kernel("cache_attention")
+def cache_attention_kernel(q, k_cache, v_cache, pos, attn_mask=None,
+                           scale=None):
+    """Attend q[B,S,H,D] (query positions pos..pos+S-1) against the full
+    cache [B,T,KV,D], masking cache slots beyond each query's position.
+    attn_mask (bool, broadcastable to [B,H,S,T]) ANDs in padding masks."""
+    T = k_cache.shape[1]
+    S = q.shape[1]
+    qpos = pos.astype(jnp.int32) + jnp.arange(S, dtype=jnp.int32)
+    mask = (jnp.arange(T, dtype=jnp.int32)[None, None, None, :]
+            <= qpos[None, None, :, None])
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            mask = mask & attn_mask
+        else:
+            # additive float mask (0 keep / -inf drop), same convention as
+            # the non-cache sdpa path: fold the causal mask into the bias
+            bias = jnp.where(mask, 0.0, -jnp.inf) + attn_mask.astype(
+                jnp.float32)
+            return scaled_dot_product_attention(q, k_cache, v_cache,
+                                                attn_mask=bias, scale=scale)
+    return scaled_dot_product_attention(q, k_cache, v_cache, attn_mask=mask,
+                                        scale=scale)
+
+
+@register_kernel("paged_cache_write")
+def paged_cache_write_kernel(pool, new, slot_ids):
+    """pool[NB,BS,KV,D]; new[B,1,KV,D]; slot_ids[B] (flat block*BS+offset)
+    → pool with each sequence's token written into its slot."""
+    nb, bs = pool.shape[0], pool.shape[1]
+    flat = pool.reshape(nb * bs, *pool.shape[2:])
+    flat = flat.at[slot_ids.astype(jnp.int32)].set(
+        new[:, 0].astype(pool.dtype))
+    return flat.reshape(pool.shape)
+
+
+@register_kernel("paged_attention")
+def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
+                           scale=None):
+    """Decode attention over paged KV (block_multi_head_attention analog).
+
+    q[B,1,H,D]; pools [NB,BS,KV,D]; block_tables[B,MB] int32 (block ids per
+    sequence, padded arbitrarily); context_lens[B] valid token counts.
+    Routed to the Pallas block-table kernel (pallas/paged_attention.py —
+    streams pool blocks into VMEM, no dense HBM gather) when
+    FLAGS_use_pallas_kernels; XLA gather+SDPA composite otherwise.
+    """
+    from ... import flags
+    if (flags.get_flag("use_pallas_kernels")
+            and q.shape[1] == 1 and q.shape[3] == k_pool.shape[3]
+            and q.shape[2] % k_pool.shape[2] == 0):
+        from .pallas import paged_attention as pa
+        return pa.paged_attention(q, k_pool, v_pool, block_tables,
+                                  context_lens, scale)
+    B = q.shape[0]
+    nb, bs = k_pool.shape[0], k_pool.shape[1]
+    mb = block_tables.shape[1]
+    tbl = block_tables.astype(jnp.int32)
+    k = k_pool[tbl]                    # [B, MB, BS, KV, D]
+    v = v_pool[tbl]
+    k = k.reshape(B, mb * bs, *k.shape[3:])
+    v = v.reshape(B, mb * bs, *v.shape[3:])
+    mask = (jnp.arange(mb * bs, dtype=jnp.int32)[None, None, None, :]
+            < context_lens.astype(jnp.int32)[:, None, None, None])
+    return scaled_dot_product_attention(q, k, v, attn_mask=mask, scale=scale)
+
+
+@register_kernel("sample_logits")
+def sample_logits_kernel(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """Token sampling head: greedy when temperature==0, else
+    temperature/top-k/top-p filtered categorical draw. logits[B,V] → [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    V = logits.shape[-1]
+    if top_k and top_k < V:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p (keep at least 1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

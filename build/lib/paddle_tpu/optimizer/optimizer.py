@@ -1,0 +1,569 @@
+"""Optimizers (reference python/paddle/optimizer/optimizer.py:103 base +
+adamw.py, sgd.py, momentum.py).
+
+TPU-native design: each optimizer defines a pure `_update(param, grad,
+state, lr, ...)` rule; `step()` applies it to the WHOLE parameter pytree in
+ONE jitted XLA program (the analog — and superset — of the reference's
+multi-tensor fused adamw paths, phi/kernels/fusion fused_adam), with fp32
+master weights for low-precision params (multi_precision, reference
+mix_precision_utils).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip: Optional[ClipGradBase] = None,
+                 multi_precision: bool = True, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (list of Tensors)")
+        self._parameter_list = list(parameters)
+        self._lr = learning_rate
+        self._weight_decay = 0.0 if weight_decay is None else float(weight_decay) \
+            if not hasattr(weight_decay, "coeff") else float(weight_decay.coeff)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._apply_decay_param_fun = None  # set by AdamW
+        # per-param optimizer state: list of dicts of jax arrays
+        self._states: List[Optional[Dict]] = [None] * len(self._parameter_list)
+        self._masters: List[Optional[jax.Array]] = [None] * len(self._parameter_list)
+        self._step_count = 0
+        # ZeRO stage-1 state sharding (distributed.sharding): id(param) ->
+        # NamedSharding for that param's master + moments. Empty = off.
+        self._state_shardings: Dict[int, object] = {}
+        self._sharding_version = 0
+
+    def _state_sharding_of(self, param) -> Optional[object]:
+        return self._state_shardings.get(id(param))
+
+    def _place_state(self, param, arr):
+        """Put a freshly created master/moment on its ZeRO shard placement."""
+        ns = self._state_sharding_of(param)
+        if ns is not None and arr.shape == param._data.shape:
+            return jax.device_put(arr, ns)
+        return arr
+
+    def _param_weight_decay(self, i: int) -> float:
+        """Per-param decay coeff honoring apply_decay_param_fun (reference
+        adamw.py: the no-decay-on-bias/norm recipe)."""
+        fn = self._apply_decay_param_fun
+        if fn is not None:
+            p = self._parameter_list[i]
+            name = p.name or f"param_{i}"
+            if not fn(name):
+                return 0.0
+        return self._weight_decay
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("optimizer uses an LRScheduler; call scheduler APIs")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state rules (override) ----------------------------------------------
+    def _init_state(self, param: jax.Array) -> Dict:
+        return {}
+
+    def _update(self, p, g, state, lr, step, wd):
+        """Pure rule: returns (new_p, new_state). `wd` is this param's
+        weight-decay coeff as a traced scalar. Implemented by subclasses."""
+        raise NotImplementedError
+
+    # -- step ----------------------------------------------------------------
+    def step(self):
+        params, grads, idxs = [], [], []
+        for i, p in enumerate(self._parameter_list):
+            if p.grad is None or p.stop_gradient:
+                continue
+            params.append(p)
+            grads.append(p.grad)
+            idxs.append(i)
+        if not params:
+            return
+        if self._grad_clip is not None:
+            pg = self._grad_clip(list(zip(params, grads)))
+            grads = [g for _, g in pg]
+
+        self._step_count += 1
+        lr = self.get_lr()
+
+        # lazily create state + fp32 masters (ZeRO-sharded when configured)
+        for k, i in enumerate(idxs):
+            p = self._parameter_list[i]
+            if self._states[i] is None:
+                master = None
+                if self._multi_precision and p._data.dtype in (jnp.bfloat16, jnp.float16):
+                    master = self._place_state(p, p._data.astype(jnp.float32))
+                self._masters[i] = master
+                self._states[i] = jax.tree.map(
+                    lambda a: self._place_state(p, a),
+                    self._init_state(master if master is not None else p._data))
+
+        p_arrays = []
+        for k, i in enumerate(idxs):
+            m = self._masters[i]
+            p_arrays.append(m if m is not None else self._parameter_list[i]._data)
+        g_arrays = tuple(g._data for g in grads)
+        s_pytree = tuple(self._states[i] for i in idxs)
+        wd_arrays = tuple(jnp.asarray(self._param_weight_decay(i), jnp.float32)
+                          for i in idxs)
+
+        # pre-step placements (any sharding type) so stage-1 updates can
+        # restore params to exactly where they were
+        param_shardings = tuple(
+            getattr(self._parameter_list[i]._data, "sharding", None)
+            for i in idxs)
+
+        new_p, new_s = _apply_pytree_update(
+            self, self._update_static_key(),
+            tuple(p_arrays), g_arrays, s_pytree,
+            jnp.asarray(lr, jnp.float32), self._step_count, wd_arrays)
+
+        for k, i in enumerate(idxs):
+            p = self._parameter_list[i]
+            if self._masters[i] is not None:
+                self._masters[i] = new_p[k]
+                arr = new_p[k].astype(p._data.dtype)
+            else:
+                arr = new_p[k]
+            if self._state_shardings:
+                # ZeRO stage 1: the update ran on state shards; gather the
+                # param back to its pre-step (replicated) placement
+                orig = param_shardings[k]
+                if orig is not None and getattr(arr, "sharding", None) != orig:
+                    arr = jax.device_put(arr, orig)
+            p._set_data(arr)
+            self._states[i] = new_s[k]
+
+    def _update_static_key(self):
+        """Hashable config that changes the compiled update rule."""
+        return (self._weight_decay,)
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self) -> Dict:
+        out = {"step": self._step_count, "states": self._states,
+               "masters": self._masters}
+        if isinstance(self._lr, LRScheduler):
+            out["lr"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, sd: Dict):
+        from ..core.tensor import Tensor as _T
+
+        def unwrap(x):  # paddle.load rehydrates arrays as Tensor
+            return x._data if isinstance(x, _T) else x
+
+        self._step_count = sd.get("step", 0)
+        states = sd.get("states")
+        if states is not None:
+            self._states = [jax.tree.map(unwrap, s,
+                                         is_leaf=lambda x: isinstance(x, _T))
+                            if s is not None else None for s in states]
+        masters = sd.get("masters")
+        if masters is not None:
+            self._masters = [unwrap(m) for m in masters]
+        if "lr" in sd and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(sd["lr"])
+
+    # -- paddle compat -------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+_JIT_CACHE: Dict = {}
+
+
+def _apply_pytree_update(opt, static_key, p_tuple, g_tuple, s_tuple, lr, step,
+                         wd_tuple):
+    """One XLA program updating every parameter (fused multi-tensor step).
+
+    Cached per optimizer INSTANCE (weakly): the compiled rule closes over the
+    instance's hyperparameters, so sharing across instances would silently
+    reuse stale constants, and a strong ref would pin dead optimizers."""
+    import weakref
+    from ..distributed.sharding import pin as _pin, sharding_of as _sh
+    for k in [k for k, (ref, _) in _JIT_CACHE.items() if ref() is None]:
+        del _JIT_CACHE[k]  # drop rules for collected optimizers
+    cache_key = (id(opt), static_key, opt._sharding_version)
+    ent = _JIT_CACHE.get(cache_key)
+    if ent is None or ent[0]() is not opt:
+        ref = weakref.ref(opt)
+
+        # Output shardings are pinned to the CALL-TIME input shardings:
+        # sharded state stays sharded across steps (the ZeRO fixed point)
+        # instead of XLA deciding per-compile. A config change bumps
+        # _sharding_version, invalidating this entry.
+        if opt._state_shardings:
+            p_sh = tuple(_sh(a) for a in p_tuple)
+            s_sh = tuple({k2: _sh(v) for k2, v in s.items()} for s in s_tuple)
+        else:
+            p_sh = s_sh = None
+
+        def run(p_tuple, g_tuple, s_tuple, lr, step, wd_tuple):
+            o = ref()
+            outs = [o._update(p, g.astype(p.dtype) if g.dtype != p.dtype else g,
+                              s, lr, step, wd)
+                    for p, g, s, wd in zip(p_tuple, g_tuple, s_tuple, wd_tuple)]
+            new_p = tuple(x[0] for x in outs)
+            new_s = tuple(x[1] for x in outs)
+            if p_sh is not None:
+                new_p = tuple(_pin(x, sh) for x, sh in zip(new_p, p_sh))
+                new_s = tuple({k2: _pin(v, sh.get(k2)) for k2, v in st.items()}
+                              for st, sh in zip(new_s, s_sh))
+            return new_p, new_s
+
+        fn = jax.jit(run, donate_argnums=(0, 2))
+        _JIT_CACHE[cache_key] = (ref, fn)
+    else:
+        fn = ent[1]
+    return fn(p_tuple, g_tuple, s_tuple, lr, step, wd_tuple)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update(self, p, g, state, lr, step, wd):
+        g = g + wd.astype(p.dtype) * p
+        return p - lr.astype(p.dtype) * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._momentum, self._nesterov)
+
+    def _init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        g = g + wd.astype(p.dtype) * p
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return p - lr.astype(p.dtype) * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, lazy_mode=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._beta1, self._beta2, self._eps,
+                self._decoupled())
+
+    def _decoupled(self):
+        return False
+
+    def _init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        lr = lr.astype(p.dtype)
+        wd = wd.astype(p.dtype)
+        if not self._decoupled():
+            g = g + wd * p
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        bc1 = 1 - b1 ** step
+        bc2 = 1 - b2 ** step
+        m_hat = m / bc1
+        v_hat = v / bc2
+        upd = m_hat / (jnp.sqrt(v_hat) + eps)
+        if self._decoupled():
+            upd = upd + wd * p
+        return p - lr * upd, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 grad_clip=None, multi_precision=True,
+                 apply_decay_param_fun=None, lr_ratio=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decoupled(self):
+        return True
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference python/paddle/optimizer/lamb.py:30,
+    kernel funcs paddle/phi/kernels/funcs/lamb_functors.h:443-455): adam moments
+    with bias correction, trust_ratio_div = m_hat/(sqrt(v_hat)+eps) + wd*p,
+    per-layer trust ratio r = ||p|| / ||trust_ratio_div|| (1 when either norm
+    is 0), p -= lr * r * trust_ratio_div."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _param_weight_decay(self, i: int) -> float:
+        # reference lamb.py passes the PARAM (not its name) to the exclude fn
+        if self._exclude_fn is not None and \
+                self._exclude_fn(self._parameter_list[i]):
+            return 0.0
+        return self._weight_decay
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._beta1, self._beta2, self._eps)
+
+    def _init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        lr = lr.astype(p.dtype)
+        wd = wd.astype(p.dtype)
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+        m_hat = m / (1 - b1 ** step)
+        v_hat = v / (1 - b2 ** step)
+        tr_div = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+        pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+        tn = jnp.sqrt(jnp.sum(jnp.square(tr_div)))
+        r = jnp.where((pn > 0) & (tn > 0), pn / jnp.where(tn > 0, tn, 1.0), 1.0)
+        return p - lr * r * tr_div, {"m": m, "v": v}
+
+
+class Adamax(Optimizer):
+    """Adam with infinity norm (reference python/paddle/optimizer/adamax.py,
+    kernel paddle/phi/kernels/impl/adamax_kernel_impl.h:61-70):
+    inf_norm = max(|g|, beta2*inf_norm + eps), p -= lr/(1-b1^t) * m/inf_norm.
+    Weight decay is coupled (added to the gradient), as in the reference's
+    regularizer path."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._beta1, self._beta2, self._eps)
+
+    def _init_state(self, param):
+        return {"m": jnp.zeros_like(param), "inf": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        lr = lr.astype(p.dtype)
+        g = g + wd.astype(p.dtype) * p
+        m = b1 * state["m"] + (1 - b1) * g
+        inf = jnp.maximum(jnp.abs(g), b2 * state["inf"] + eps)
+        lr_t = lr / (1 - b1 ** step)
+        return p - lr_t * m / inf, {"m": m, "inf": inf}
+
+
+class Adadelta(Optimizer):
+    """Reference python/paddle/optimizer/adadelta.py, kernel
+    paddle/phi/kernels/impl/adadelta_kernel_impl.h:60-82:
+    E[g2] = rho*E[g2] + (1-rho)*g2; update = -sqrt(E[dx2]+eps)/sqrt(E[g2]+eps)*g;
+    E[dx2] = rho*E[dx2] + (1-rho)*update2; p += lr*update."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._eps = rho, epsilon
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._rho, self._eps)
+
+    def _init_state(self, param):
+        return {"g2": jnp.zeros_like(param), "dx2": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        rho, eps = self._rho, self._eps
+        g = g + wd.astype(p.dtype) * p
+        g2 = rho * state["g2"] + (1 - rho) * jnp.square(g)
+        upd = -jnp.sqrt(state["dx2"] + eps) / jnp.sqrt(g2 + eps) * g
+        dx2 = rho * state["dx2"] + (1 - rho) * jnp.square(upd)
+        return p + lr.astype(p.dtype) * upd, {"g2": g2, "dx2": dx2}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (reference python/paddle/optimizer/asgd.py
+    docstring math, kernel paddle/phi/kernels/impl/asgd_kernel_impl.h):
+    keeps the last `batch_num` gradients per param; each step replaces slot
+    i = t % n in the running sum d and updates
+    p -= lr * (d / min(t+1, n) + wd*p)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        if batch_num < 1:
+            raise ValueError("batch_num must be >= 1")
+        self._n = int(batch_num)
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._n)
+
+    def _init_state(self, param):
+        return {"d": jnp.zeros_like(param),
+                "ys": jnp.zeros((self._n,) + param.shape, param.dtype)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        n = self._n
+        idx = (step - 1) % n
+        y_old = jax.lax.dynamic_index_in_dim(state["ys"], idx, 0,
+                                             keepdims=False)
+        d = state["d"] - y_old + g
+        ys = jax.lax.dynamic_update_index_in_dim(state["ys"], g, idx, 0)
+        denom = jnp.minimum(step, n).astype(p.dtype)
+        upd = d / denom + wd.astype(p.dtype) * p
+        return p - lr.astype(p.dtype) * upd, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference python/paddle/optimizer/rprop.py math,
+    kernel paddle/phi/kernels/impl/rprop_kernel_impl.h). Per-element step
+    size: grows by etas[1] (capped at learning_rate_range[1]) when the
+    gradient keeps sign, shrinks by etas[0] (floored at range[0]) and skips
+    the update when it flips. Full-batch training only; the global LR
+    scheduler does not apply (learning_rate seeds the per-element steps)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=True, name=None):
+        if isinstance(learning_rate, LRScheduler):
+            raise TypeError(
+                "Rprop maintains per-element step sizes seeded from a float "
+                "learning_rate; LR schedulers do not apply (reference "
+                "rprop.py: full-batch only)")
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr0 = float(learning_rate)
+        self._lr_min, self._lr_max = (float(x) for x in learning_rate_range)
+        self._eta_minus, self._eta_plus = (float(x) for x in etas)
+
+    def _update_static_key(self):
+        return (self._lr0, self._lr_min, self._lr_max,
+                self._eta_minus, self._eta_plus)
+
+    def _init_state(self, param):
+        return {"prev": jnp.zeros_like(param),
+                "lrs": jnp.full_like(param, self._lr0)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        sign = g * state["prev"]
+        lrs = jnp.where(
+            sign > 0, jnp.minimum(state["lrs"] * self._eta_plus, self._lr_max),
+            jnp.where(sign < 0,
+                      jnp.maximum(state["lrs"] * self._eta_minus, self._lr_min),
+                      state["lrs"]))
+        step_w = jnp.where(sign < 0, jnp.zeros_like(p), jnp.sign(g) * lrs)
+        prev = jnp.where(sign < 0, jnp.zeros_like(g), g)
+        return p - step_w, {"prev": prev, "lrs": lrs}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._eps, self._init_acc)
+
+    def _init_state(self, param):
+        return {"acc": jnp.full_like(param, self._init_acc)}
+
+    def _update(self, p, g, state, lr, step, wd):
+        g = g + wd.astype(p.dtype) * p
+        acc = state["acc"] + jnp.square(g)
+        return p - lr.astype(p.dtype) * g / (jnp.sqrt(acc) + self._eps), {"acc": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-06,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_static_key(self):
+        return (self._weight_decay, self._rho, self._eps, self._momentum,
+                self._centered)
+
+    def _init_state(self, param):
+        s = {"ms": jnp.zeros_like(param), "mom": jnp.zeros_like(param)}
+        if self._centered:
+            s["mg"] = jnp.zeros_like(param)
+        return s
+
+    def _update(self, p, g, state, lr, step, wd):
+        g = g + wd.astype(p.dtype) * p
+        ms = self._rho * state["ms"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * state["mg"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            new_state = {"ms": ms, "mg": mg}
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+            new_state = {"ms": ms}
+        mom = self._momentum * state["mom"] + lr.astype(p.dtype) * g / denom
+        new_state["mom"] = mom
+        return p - mom, new_state

@@ -1,0 +1,21 @@
+"""paddle_tpu.profiler — tracing/profiling facade (SURVEY §5).
+
+Host spans recorded in-process; device activity via the jax/XLA profiler
+(XPlane) on TPU. Chrome-trace export, cyclic schedulers, summary statistics,
+and a throughput benchmark timer — mirroring python/paddle/profiler.
+"""
+
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, TracerEventType, RecordEvent,
+    make_scheduler, export_chrome_tracing, export_protobuf,
+    load_profiler_result, ProfilerResult,
+)
+from .profiler_statistic import SortedKeys, gen_summary  # noqa: F401
+from .timer import benchmark, Benchmark  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "TracerEventType",
+    "RecordEvent", "make_scheduler", "export_chrome_tracing",
+    "export_protobuf", "load_profiler_result", "ProfilerResult",
+    "SortedKeys", "benchmark", "Benchmark",
+]

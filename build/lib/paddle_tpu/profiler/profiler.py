@@ -1,0 +1,379 @@
+"""Profiler: state machine, host-event spans, chrome-trace export, TPU bridge.
+
+Rebuild of the reference profiler surface (python/paddle/profiler/profiler.py:
+ProfilerState state machine :79, make_scheduler :126, Profiler :346,
+chrome-trace exporter :215) on a TPU-native backing: host spans are recorded
+by a Python/threaded recorder (the reference uses a C++ HostEventRecorder,
+paddle/fluid/platform/profiler/host_tracer.cc), and device activity comes from
+the jax/XLA profiler (XPlane) instead of CUPTI
+(paddle/fluid/platform/profiler/cuda_tracer.cc).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1      # accepted for API parity; maps to device target
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class TracerEventType(Enum):
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    Forward = 3
+    Backward = 4
+    Optimization = 5
+    Communication = 6
+    PythonOp = 7
+    PythonUserDefined = 8
+    UserDefined = 9
+
+
+# -- host event recorder ------------------------------------------------------
+
+class _HostEvent:
+    __slots__ = ("name", "start_ns", "end_ns", "tid", "event_type")
+
+    def __init__(self, name, start_ns, end_ns, tid, event_type):
+        self.name = name
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.tid = tid
+        self.event_type = event_type
+
+
+class _HostEventRecorder:
+    """Process-wide span sink (C++ HostEventRecorder analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[_HostEvent] = []
+        self.enabled = False
+
+    def start(self):
+        with self._lock:
+            self._events = []
+            self.enabled = True
+
+    def stop(self) -> List[_HostEvent]:
+        with self._lock:
+            self.enabled = False
+            ev, self._events = self._events, []
+            return ev
+
+    def record(self, ev: _HostEvent):
+        if self.enabled:
+            with self._lock:
+                self._events.append(ev)
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """User/op span marker. Usable as context manager or via begin()/end().
+
+    Mirrors paddle.profiler.RecordEvent; spans land in the active profiler's
+    timeline and statistics.
+    """
+
+    def __init__(self, name: str,
+                 event_type: TracerEventType = TracerEventType.UserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._start_ns: Optional[int] = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        self._start_ns = time.perf_counter_ns()
+
+    def end(self):
+        if self._start_ns is None or not _recorder.enabled:
+            self._start_ns = None
+            return
+        _recorder.record(_HostEvent(
+            self.name, self._start_ns, time.perf_counter_ns(),
+            threading.get_ident(), self.event_type))
+        self._start_ns = None
+
+
+def _op_span_hook(op_name: str):
+    return RecordEvent(op_name, TracerEventType.Operator)
+
+
+# -- scheduler ----------------------------------------------------------------
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0
+                   ) -> Callable[[int], ProfilerState]:
+    """Cyclic state schedule (reference profiler.py:126)."""
+    if closed < 0 or ready < 0 or record < 1:
+        raise ValueError(
+            f"make_scheduler needs closed>=0, ready>=0, record>=1; got "
+            f"closed={closed}, ready={ready}, record={record}")
+    num_steps = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        period = step // num_steps
+        if repeat > 0 and period >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % num_steps
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == num_steps - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
+                          ) -> Callable:
+    """on_trace_ready callback writing chrome://tracing JSON."""
+
+    def handle(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_pid{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time()*1000)}.paddle_trace.json")
+        prof.export(path, format="json")
+
+    return handle
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    # kept for API parity; emits the same JSON payload (no proto dep baked in)
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+# -- result container ---------------------------------------------------------
+
+class ProfilerResult:
+    def __init__(self, events: List[_HostEvent],
+                 device_trace_dir: Optional[str] = None):
+        self.events = events
+        self.device_trace_dir = device_trace_dir
+
+    def to_chrome_json(self) -> Dict[str, Any]:
+        trace = []
+        for ev in self.events:
+            trace.append({
+                "name": ev.name, "ph": "X", "pid": os.getpid(),
+                "tid": ev.tid, "ts": ev.start_ns / 1e3,
+                "dur": (ev.end_ns - ev.start_ns) / 1e3,
+                "cat": ev.event_type.name,
+            })
+        return {"traceEvents": trace,
+                "displayTimeUnit": "ms",
+                "deviceTraceDir": self.device_trace_dir or ""}
+
+    def save(self, path: str, format: str = "json"):
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_json(), f)
+
+
+def load_profiler_result(filename: str) -> ProfilerResult:
+    with open(filename) as f:
+        payload = json.load(f)
+    events = []
+    for e in payload.get("traceEvents", []):
+        start = int(e["ts"] * 1e3)
+        events.append(_HostEvent(
+            e["name"], start, start + int(e.get("dur", 0) * 1e3),
+            e.get("tid", 0),
+            getattr(TracerEventType, e.get("cat", "UserDefined"),
+                    TracerEventType.UserDefined)))
+    return ProfilerResult(events, payload.get("deviceTraceDir") or None)
+
+
+# -- profiler -----------------------------------------------------------------
+
+class Profiler:
+    """paddle.profiler.Profiler parity (reference profiler.py:346).
+
+    targets: which tracers to enable — CPU host spans always; TPU adds a
+    jax.profiler trace (XPlane) captured to `trace_dir`.
+    scheduler: None (always RECORD), (start, end) step window, or a callable
+    from make_scheduler().
+    """
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 record_op_args: bool = False,
+                 trace_dir: str = "./profiler_log",
+                 timer_only: bool = False,
+                 profile_memory: bool = False,
+                 with_flops: bool = False):
+        self.targets = set(targets) if targets is not None else {
+            ProfilerTarget.CPU, ProfilerTarget.TPU}
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif callable(scheduler):
+            self._scheduler = scheduler
+        else:  # (start, end) tuple
+            start, end = scheduler
+            if end <= start or start < 0:
+                raise ValueError(
+                    f"scheduler window needs 0 <= start < end; got "
+                    f"({start}, {end})")
+            self._scheduler = make_scheduler(
+                closed=max(start - 1, 0), ready=1 if start > 0 else 0,
+                record=end - start, repeat=1)
+        self.on_trace_ready = on_trace_ready or export_chrome_tracing(
+            trace_dir)
+        self.trace_dir = trace_dir
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._result: Optional[ProfilerResult] = None
+        self._device_tracing = False
+        self._step_span: Optional[RecordEvent] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        from .timer import benchmark
+        benchmark().begin()
+        if self.timer_only:
+            return
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_tracers()
+        self._begin_step_span()
+
+    def stop(self):
+        from .timer import benchmark
+        benchmark().end()
+        if self.timer_only:
+            return
+        self._end_step_span()
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._stop_tracers()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        """Advance the schedule at an iteration boundary."""
+        from .timer import benchmark
+        benchmark().step(num_samples)
+        if self.timer_only:
+            return
+        self._end_step_span()
+        prev = self.current_state
+        self.step_num += 1
+        new = self._scheduler(self.step_num)
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if prev in recording and new not in recording:
+            self._stop_tracers()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        elif prev not in recording and new in recording:
+            self._start_tracers()
+        elif prev is ProfilerState.RECORD_AND_RETURN and new in recording:
+            self._stop_tracers()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+            self._start_tracers()
+        self.current_state = new
+        self._begin_step_span()
+
+    # -- tracer control ------------------------------------------------------
+    def _start_tracers(self):
+        from ..ops import dispatcher
+        _recorder.start()
+        dispatcher.set_op_span_hook(_op_span_hook)
+        if ProfilerTarget.TPU in self.targets or \
+                ProfilerTarget.GPU in self.targets:
+            try:
+                import jax
+                if jax.default_backend() != "cpu":
+                    os.makedirs(self.trace_dir, exist_ok=True)
+                    jax.profiler.start_trace(self.trace_dir)
+                    self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _stop_tracers(self):
+        from ..ops import dispatcher
+        dispatcher.set_op_span_hook(None)
+        events = _recorder.stop()
+        had_device_trace = self._device_tracing
+        if had_device_trace:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+        self._result = ProfilerResult(
+            events, self.trace_dir if had_device_trace else None)
+
+    def _begin_step_span(self):
+        self._step_span = RecordEvent(
+            f"ProfileStep#{self.step_num}", TracerEventType.ProfileStep)
+        self._step_span.begin()
+
+    def _end_step_span(self):
+        if self._step_span is not None:
+            self._step_span.end()
+            self._step_span = None
+
+    # -- results -------------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        if self._result is not None:
+            self._result.save(path, format)
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        from .profiler_statistic import gen_summary
+        if self._result is None:
+            print("[paddle_tpu.profiler] no recorded data")
+            return
+        print(gen_summary(self._result.events, sorted_by=sorted_by,
+                          time_unit=time_unit))
+
+    def get_profiler_result(self) -> Optional[ProfilerResult]:
+        return self._result

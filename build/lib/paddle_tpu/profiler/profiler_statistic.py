@@ -1,0 +1,84 @@
+"""Aggregate statistics over recorded host spans.
+
+Reference: python/paddle/profiler/profiler_statistic.py (per-event-type and
+per-op tables). Here: name-keyed aggregation with totals/avg/min/max and a
+formatted table, plus SortedKeys parity.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Dict, List, Optional
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+_UNIT = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+
+
+class EventSummary:
+    __slots__ = ("name", "call", "total_ns", "max_ns", "min_ns", "type")
+
+    def __init__(self, name, event_type):
+        self.name = name
+        self.type = event_type
+        self.call = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = None
+
+    def add(self, dur_ns: int):
+        self.call += 1
+        self.total_ns += dur_ns
+        self.max_ns = max(self.max_ns, dur_ns)
+        self.min_ns = dur_ns if self.min_ns is None else min(self.min_ns, dur_ns)
+
+    @property
+    def avg_ns(self):
+        return self.total_ns / self.call if self.call else 0
+
+
+def collect(events) -> Dict[str, EventSummary]:
+    table: Dict[str, EventSummary] = {}
+    for ev in events:
+        s = table.get(ev.name)
+        if s is None:
+            s = table[ev.name] = EventSummary(ev.name, ev.event_type)
+        s.add(ev.end_ns - ev.start_ns)
+    return table
+
+def gen_summary(events, sorted_by=None, time_unit: str = "ms",
+                row_limit: int = 100) -> str:
+    div = _UNIT.get(time_unit, 1e6)
+    table = collect(events)
+    key = {
+        SortedKeys.CPUAvg: lambda s: s.avg_ns,
+        SortedKeys.CPUMax: lambda s: s.max_ns,
+        SortedKeys.CPUMin: lambda s: s.min_ns or 0,
+    }.get(sorted_by, lambda s: s.total_ns)
+    # ratio denominator spans ALL collected events, not just displayed rows
+    total = sum(s.total_ns for s in table.values()) or 1
+    rows = sorted(table.values(), key=key, reverse=True)[:row_limit]
+
+    name_w = max([len("Name")] + [min(len(s.name), 48) for s in rows]) + 2
+    hdr = (f"{'Name':<{name_w}}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+           f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"
+           f"{'Min(' + time_unit + ')':>12}{'Ratio(%)':>10}")
+    lines = ["-" * len(hdr), hdr, "-" * len(hdr)]
+    for s in rows:
+        lines.append(
+            f"{s.name[:48]:<{name_w}}{s.call:>8}"
+            f"{s.total_ns / div:>14.4f}{s.avg_ns / div:>12.4f}"
+            f"{s.max_ns / div:>12.4f}{(s.min_ns or 0) / div:>12.4f}"
+            f"{100.0 * s.total_ns / total:>10.2f}")
+    lines.append("-" * len(hdr))
+    return "\n".join(lines)

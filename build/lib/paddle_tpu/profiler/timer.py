@@ -1,0 +1,86 @@
+"""Throughput benchmark timer (reference python/paddle/profiler/timer.py).
+
+Tracks per-step wall time and samples/sec with warmup discard; surfaced via
+`paddle.profiler.benchmark()`. Profiler.start()/stop() begin/end it and
+Profiler.step(num_samples) feeds it, so `Profiler(timer_only=True)` is a
+zero-overhead throughput meter.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class _Stat:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = 0.0
+
+    def add(self, v: float):
+        self.count += 1
+        self.total += v
+        self.max = max(self.max, v)
+        self.min = v if self.min is None else min(self.min, v)
+
+    @property
+    def avg(self):
+        return self.total / self.count if self.count else 0.0
+
+
+class Benchmark:
+    def __init__(self):
+        self.reader_cost = _Stat()
+        self.batch_cost = _Stat()
+        self.ips = _Stat()
+        self._last: Optional[float] = None
+        self._warmup = 2
+        self._steps = 0
+        self.running = False
+
+    def begin(self):
+        self.reader_cost.reset()
+        self.batch_cost.reset()
+        self.ips.reset()
+        self._last = time.perf_counter()
+        self._steps = 0
+        self.running = True
+
+    def step(self, num_samples: Optional[int] = None):
+        if not self.running:
+            return
+        now = time.perf_counter()
+        if self._last is not None:
+            dt = now - self._last
+            self._steps += 1
+            if self._steps > self._warmup:
+                self.batch_cost.add(dt)
+                if num_samples and dt > 0:
+                    self.ips.add(num_samples / dt)
+        self._last = now
+
+    def end(self):
+        self.running = False
+
+    def speed_average(self) -> float:
+        return self.ips.avg
+
+    def report(self) -> dict:
+        return {
+            "batch_cost_avg_s": self.batch_cost.avg,
+            "batch_cost_max_s": self.batch_cost.max,
+            "ips_avg": self.ips.avg,
+            "steps": self._steps,
+        }
+
+
+_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    return _benchmark

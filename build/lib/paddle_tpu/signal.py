@@ -1,0 +1,10 @@
+"""paddle.signal namespace (reference python/paddle/signal.py — frame/stft/
+istft over the fft kernels)."""
+
+from .ops.dispatcher import get_op as _get_op
+
+frame = _get_op("frame")
+stft = _get_op("stft")
+istft = _get_op("istft")
+
+__all__ = ["frame", "stft", "istft"]

@@ -1,0 +1,321 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors and ops (SURVEY §2.2).
+
+Reference: paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h +
+phi/kernels/sparse/ (103 files) + python/paddle/sparse.
+
+TPU stance (SURVEY §2 "TPU equivalent"): sparse kept as *composite* —
+fixed-nnz index/value arrays with gather/scatter/segment-sum lowering, which
+XLA tiles well — rather than hand CUDA kernels. Shapes stay static (nnz is
+part of the compiled shape), so the ops jit; the exceptions are
+`coalesce()`/`to_sparse_csr()`, whose post-merge nnz is data-dependent and
+therefore eager-only (host decision points).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import nn  # noqa: F401  (after class defs would cycle; nn imports lazily)
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "matmul", "masked_matmul", "add",
+    "multiply", "subtract", "transpose", "sum", "nn",
+]
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """Coordinate-format sparse tensor (indices [sparse_ndim, nnz] + values).
+
+    Reference: paddle/phi/core/sparse_coo_tensor.h:30.
+    """
+
+    def __init__(self, indices, values, shape: Sequence[int],
+                 coalesced: bool = False):
+        self._indices = _as_array(indices).astype(jnp.int32)
+        self._values = _as_array(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+        if self._indices.ndim != 2:
+            raise ValueError("indices must be [sparse_ndim, nnz]")
+        if self._indices.shape[1] != self._values.shape[0]:
+            raise ValueError(
+                f"nnz mismatch: indices {self._indices.shape[1]} vs values "
+                f"{self._values.shape[0]}")
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def indices(self) -> Tensor:
+        return Tensor(self._indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    def nnz(self) -> int:
+        return int(self._indices.shape[1])
+
+    @property
+    def sparse_dim(self) -> int:
+        return int(self._indices.shape[0])
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # -- conversion ----------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        dense = jnp.zeros(self._shape, dtype=self._values.dtype)
+        dense = dense.at[tuple(self._indices)].add(self._values)
+        return Tensor(dense)
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate coordinates (sum values), sort row-major.
+
+        Eager-only: the post-merge nnz is data-dependent, so this is a host
+        decision point (like the reference's DenseToCoo sync) — call it
+        outside jit; all other ops keep static shapes and jit fine."""
+        if isinstance(self._values, jax.core.Tracer) or isinstance(
+                self._indices, jax.core.Tracer):
+            raise RuntimeError(
+                "coalesce() shrinks nnz (data-dependent shape) and cannot "
+                "run under jit; coalesce eagerly before compiling")
+        lin = _linearize(self._indices, self._shape[:self.sparse_dim])
+        uniq, inv = jnp.unique(lin, return_inverse=True,
+                               size=self.nnz(), fill_value=-1)
+        summed = jax.ops.segment_sum(self._values, inv.reshape(-1),
+                                     num_segments=self.nnz())
+        keep = uniq >= 0
+        n_keep = int(keep.sum())
+        idx = _delinearize(jnp.where(keep, uniq, 0)[:n_keep],
+                           self._shape[:self.sparse_dim])
+        return SparseCooTensor(idx, summed[:n_keep], self._shape,
+                               coalesced=True)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if self.sparse_dim != 2 or len(self._shape) != 2:
+            raise ValueError("to_sparse_csr: 2-D COO only")
+        c = self.coalesce()
+        rows, cols = c._indices
+        m = self._shape[0]
+        counts = jax.ops.segment_sum(jnp.ones_like(rows), rows,
+                                     num_segments=m)
+        crows = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                 jnp.cumsum(counts).astype(jnp.int32)])
+        return SparseCsrTensor(crows, cols, c._values, self._shape)
+
+    def astype(self, dtype) -> "SparseCooTensor":
+        return SparseCooTensor(self._indices, self._values.astype(dtype),
+                               self._shape, self._coalesced)
+
+
+class SparseCsrTensor:
+    """Compressed-row sparse matrix (crows [m+1], cols [nnz], values [nnz]).
+
+    Reference: paddle/phi/core/sparse_csr_tensor.h:29.
+    """
+
+    def __init__(self, crows, cols, values, shape: Sequence[int]):
+        self._crows = _as_array(crows).astype(jnp.int32)
+        self._cols = _as_array(cols).astype(jnp.int32)
+        self._values = _as_array(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("CSR supports 2-D matrices")
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    def nnz(self) -> int:
+        return int(self._cols.shape[0])
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def _row_ids(self) -> jax.Array:
+        counts = jnp.diff(self._crows)
+        return jnp.repeat(jnp.arange(self._shape[0], dtype=jnp.int32),
+                          counts, total_repeat_length=self.nnz())
+
+    def to_dense(self) -> Tensor:
+        dense = jnp.zeros(self._shape, dtype=self._values.dtype)
+        dense = dense.at[self._row_ids(), self._cols].add(self._values)
+        return Tensor(dense)
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        idx = jnp.stack([self._row_ids(), self._cols])
+        return SparseCooTensor(idx, self._values, self._shape,
+                               coalesced=True)
+
+
+SparseTensor = Union[SparseCooTensor, SparseCsrTensor]
+
+
+def _linearize(indices: jax.Array, dims: Tuple[int, ...]) -> jax.Array:
+    # int32 is the native TPU index width (x64 disabled); fine up to 2^31
+    # linearized coordinates
+    lin = jnp.zeros(indices.shape[1], dtype=jnp.int32)
+    for d, size in enumerate(dims):
+        lin = lin * size + indices[d]
+    return lin
+
+
+def _delinearize(lin: jax.Array, dims: Tuple[int, ...]) -> jax.Array:
+    out = []
+    for size in reversed(dims):
+        out.append(lin % size)
+        lin = lin // size
+    return jnp.stack(list(reversed(out))).astype(jnp.int32)
+
+
+# -- constructors -------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None) -> SparseCooTensor:
+    idx = _as_array(indices)
+    vals = _as_array(values)
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        vals = vals.astype(dtype_mod.convert_dtype(dtype))
+    if shape is None:
+        sparse_shape = tuple(int(s) + 1 for s in np.asarray(idx).max(axis=1))
+        shape = sparse_shape + vals.shape[1:]
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape: Sequence[int],
+                      dtype=None) -> SparseCsrTensor:
+    vals = _as_array(values)
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        vals = vals.astype(dtype_mod.convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x: SparseTensor, y: SparseTensor) -> bool:
+    return x.shape == y.shape
+
+
+# -- ops ----------------------------------------------------------------------
+
+def matmul(x: SparseTensor, y: Tensor) -> Tensor:
+    """sparse @ dense → dense (phi/kernels/sparse/matmul_kernel: SpMM).
+
+    Lowering: gather the needed rows of `y` per nonzero, scale by the value,
+    segment-sum into output rows — three XLA-friendly primitives.
+    """
+    yd = _as_array(y)
+    if isinstance(x, SparseCsrTensor):
+        rows, cols, vals = x._row_ids(), x._cols, x._values
+    else:
+        if x.sparse_dim != 2:
+            raise ValueError("matmul: 2-D sparse only")
+        rows, cols = x._indices
+        vals = x._values
+    contrib = vals[:, None] * yd[cols]                      # [nnz, n]
+    out = jax.ops.segment_sum(contrib, rows, num_segments=x.shape[0])
+    return Tensor(out)
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask: SparseTensor) -> SparseTensor:
+    """dense @ dense sampled at mask's sparsity (SDDMM,
+    phi/kernels/sparse/gpu/masked_matmul_grad_kernel analog)."""
+    xd, yd = _as_array(x), _as_array(y)
+    if isinstance(mask, SparseCsrTensor):
+        rows, cols = mask._row_ids(), mask._cols
+        vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask.shape)
+    rows, cols = mask._indices
+    vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+    return SparseCooTensor(mask._indices, vals, mask.shape)
+
+
+def _coo_binary(x: SparseCooTensor, y: SparseCooTensor, op) -> SparseCooTensor:
+    if x.shape != y.shape:
+        raise ValueError("shape mismatch")
+    # union of coordinates by concatenation: a valid UNcoalesced COO (dense
+    # scatter-add merges duplicates), fixed nnz_a+nnz_b shape → jittable.
+    # Callers wanting merged storage run .coalesce() eagerly.
+    idx = jnp.concatenate([x._indices, y._indices], axis=1)
+    vals = jnp.concatenate([op(x._values, True), op(y._values, False)])
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+def add(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    return _coo_binary(x, y, lambda v, is_x: v)
+
+
+def subtract(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    return _coo_binary(x, y, lambda v, is_x: v if is_x else -v)
+
+
+def multiply(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    """Elementwise product: intersection of supports — evaluated by sampling
+    the dense of y at x's coordinates."""
+    yd = y.to_dense()._data
+    vals = x._values * yd[tuple(x._indices)]
+    return SparseCooTensor(x._indices, vals, x.shape)
+
+
+def transpose(x: SparseCooTensor, perm: Sequence[int]) -> SparseCooTensor:
+    if len(perm) != x.sparse_dim:
+        raise ValueError("transpose: perm must cover sparse dims")
+    idx = x._indices[jnp.asarray(perm)]
+    shape = tuple(x.shape[p] for p in perm) + x.shape[x.sparse_dim:]
+    return SparseCooTensor(idx, x._values, shape)
+
+
+def sum(x: SparseCooTensor, axis: Optional[int] = None,
+        keepdim: bool = False):
+    if axis is None:
+        return Tensor(jnp.sum(x._values))
+    dense = x.to_dense()._data
+    return Tensor(jnp.sum(dense, axis=axis, keepdims=keepdim))
+
+
+# -- BCSR (block-sparse) ------------------------------------------------------
+
+def bcsr_from_dense(dense, block_m: int, block_k: int, tol: float = 0.0):
+    """Tile a dense matrix into block-CSR (see pallas/bcsr_spmm.py)."""
+    from ..ops.kernels.pallas.bcsr_spmm import bcsr_from_dense as _f
+    return _f(_as_array(dense), block_m, block_k, tol)
+
+
+def bcsr_matmul(crows, cols, values, x) -> Tensor:
+    """Block-CSR sparse @ dense via the Pallas BCSR SpMM kernel — MXU
+    [bm x bk] @ [bk x bn] products per nonzero block (SURVEY §2.2 "BCSR
+    Pallas where hot"; the unstructured path stays `matmul` above)."""
+    from ..ops.kernels.pallas.bcsr_spmm import bcsr_spmm as _f
+    return Tensor(_f(crows, cols, _as_array(values), _as_array(x)))

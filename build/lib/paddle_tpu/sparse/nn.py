@@ -1,0 +1,38 @@
+"""Sparse activations/layers (reference python/paddle/sparse/nn)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class _SparseUnary:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x):
+        from . import SparseCooTensor, SparseCsrTensor
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, self._fn(x._values), x.shape,
+                                   x._coalesced)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, self._fn(x._values),
+                                   x.shape)
+        raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+class ReLU(_SparseUnary):
+    def __init__(self):
+        super().__init__(lambda v: jnp.maximum(v, 0))
+
+
+class LeakyReLU(_SparseUnary):
+    def __init__(self, negative_slope: float = 0.01):
+        super().__init__(lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def relu(x):
+    return ReLU()(x)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return LeakyReLU(negative_slope)(x)

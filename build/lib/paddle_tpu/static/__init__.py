@@ -1,0 +1,122 @@
+"""paddle_tpu.static — static-graph API (SURVEY §2.6 `python/paddle/static`).
+
+data() placeholders + ops recorded under program_guard build a Program;
+Executor jit-compiles the replay. gradients/append_backward differentiate the
+recorded graph; save/load_inference_model round-trip program + parameters.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from .executor import (Executor, Scope, append_backward, global_scope,
+                       gradients)
+from .graph import (Block, Operator, Program, Variable, default_main_program,
+                    default_startup_program, disable_static, enable_static,
+                    in_static_mode, program_guard)
+
+__all__ = [
+    "data", "create_parameter", "program_guard", "Program", "Variable",
+    "Executor", "global_scope", "gradients", "append_backward",
+    "default_main_program", "default_startup_program", "enable_static",
+    "disable_static", "in_static_mode", "save_inference_model",
+    "load_inference_model", "InputSpec",
+]
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0) -> Variable:
+    """Feed placeholder (reference static/input.py data())."""
+    dt = dtype_mod.convert_dtype(dtype)
+    block = default_main_program().global_block
+    return block.create_var(tuple(shape), dt, name=name, is_data=True)
+
+
+def create_parameter(shape: Sequence[int], dtype="float32",
+                     name: Optional[str] = None,
+                     default_initializer=None) -> Variable:
+    """Trainable parameter in the current program (static/nn/common.py)."""
+    prog = default_main_program()
+    block = prog.global_block
+    dt = dtype_mod.convert_dtype(dtype)
+    v = block.create_var(tuple(shape), dt, name=name, is_parameter=True,
+                         stop_gradient=False)
+    if default_initializer is None:
+        fan_in = shape[0] if shape else 1
+        bound = float(np.sqrt(6.0 / max(fan_in, 1)))
+        init = np.random.uniform(-bound, bound, size=shape).astype(
+            np.dtype(dt) if not str(dt).startswith("bfloat") else np.float32)
+    elif callable(default_initializer):
+        init = np.asarray(default_initializer(shape))
+    else:
+        init = np.full(shape, default_initializer, dtype=np.float32)
+    prog.param_init[v.name] = init
+    return v
+
+
+class InputSpec:
+    """Shape/dtype signature used by jit.save / inference export."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def save_inference_model(path_prefix: str, feed_vars: List[Variable],
+                         fetch_vars: List[Variable], executor: Executor,
+                         program: Optional[Program] = None) -> None:
+    """Serialize program spec + parameter values (reference
+    static/io.py save_inference_model: .pdmodel/.pdiparams pair)."""
+    program = program or default_main_program()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    params = {}
+    for p in program.parameters():
+        arr = executor.scope.var(p.name)
+        params[p.name] = (np.asarray(arr) if arr is not None
+                          else program.param_init[p.name])
+    spec = {
+        "feed_names": [v.name for v in feed_vars],
+        "fetch_names": [v.name for v in fetch_vars],
+        "program": program,
+    }
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(spec, f)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(params, f)
+
+
+def load_inference_model(path_prefix: str, executor: Executor):
+    """Returns (program, feed_names, fetch_names); parameters land in the
+    executor's scope.
+
+    Format note (PARITY.md): these artifacts reuse the reference's
+    .pdmodel/.pdiparams extensions for API parity but serialize THIS
+    framework's Program (pickle), not the reference's ProgramDesc
+    protobuf. Loading an actual upstream artifact fails loudly here with
+    a pointer, instead of an opaque unpickling error."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        head = f.read(2)
+        f.seek(0)
+        if head and head[:1] not in (b"\x80",):  # pickle protocol 2+ magic
+            raise ValueError(
+                f"'{path_prefix}.pdmodel' is not a paddle_tpu artifact "
+                "(likely an upstream ProgramDesc protobuf). The formats "
+                "share extensions but are not interchangeable — re-export "
+                "the model with paddle_tpu's jit.save/save_inference_model "
+                "(see PARITY.md, inference row).")
+        spec = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    program: Program = spec["program"]
+    for name, arr in params.items():
+        executor.scope.set_var(name, arr)
+    return program, spec["feed_names"], spec["fetch_names"]

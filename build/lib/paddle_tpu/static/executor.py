@@ -1,0 +1,193 @@
+"""Static-graph Executor + gradients.
+
+Reference: python/paddle/base/executor.py (Executor:1158, run:1618) backed by
+StandaloneExecutor/PirInterpreter (§3.3 of SURVEY). Here the recorded
+program replays through its registered kernels inside one `jax.jit` — the
+dependency analysis, stream assignment and fusion the reference does by hand
+(dependency_builder.cc, stream_analyzer.cc, CINN) are XLA's job. Parameters
+live in the Executor's scope (name → jax.Array) and are passed as jit inputs
+so updates (optimizer ops / set_var) never retrace.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .graph import Operator, Program, Variable
+
+
+class GradOp(Operator):
+    """Recorded backward "super-op": one node whose execution differentiates
+    the replay of its forward slice with jax.grad. Module-level (not a
+    closure) so Programs containing backward ops stay picklable."""
+
+    def __init__(self, inputs: List[Variable], outputs: List[Variable],
+                 fwd_ops: List[Operator], in_names: List[str],
+                 tgt_names: List[str]):
+        self.type = "grad"
+        self.kernel = "__grad__"
+        self.slots = list(inputs)
+        self.present = []
+        self.attrs = {}
+        self.outputs = outputs
+        self.fwd_ops = fwd_ops
+        self.in_names = in_names
+        self.tgt_names = tgt_names
+
+    def loss_value(self, in_vals, env0):
+        env = dict(env0)
+        env.update(zip(self.in_names, in_vals))
+        sub = Program()
+        sub.global_block.ops = self.fwd_ops
+        env = _replay(sub, env, jax.random.key(0))
+        total = None
+        for n in self.tgt_names:
+            s = jnp.sum(env[n])
+            total = s if total is None else total + s
+        return total
+
+
+class Scope:
+    def __init__(self):
+        self.vars: Dict[str, jax.Array] = {}
+
+    def set_var(self, name: str, value):
+        self.vars[name] = jnp.asarray(
+            value._data if isinstance(value, Tensor) else value)
+
+    def var(self, name: str):
+        return self.vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _replay(program: Program, env: Dict[str, jax.Array], key: jax.Array):
+    """Run the recorded op list; env maps Variable name -> array."""
+    from ..ops.dispatcher import KERNELS, _reassemble
+    for op in program.global_block.ops:
+        if isinstance(op, GradOp):
+            in_vals = [env[n] for n in op.in_names]
+            grads = jax.grad(lambda vals: op.loss_value(vals, env))(in_vals)
+            for var, g in zip(op.outputs, grads):
+                env[var.name] = g
+            continue
+        primals = []
+        for s in op.slots:
+            if isinstance(s, Variable):
+                primals.append(env[s.name])
+            elif isinstance(s, str) and s == "__key__":
+                key, sub = jax.random.split(key)
+                primals.append(sub)
+            else:
+                primals.append(s)
+        res = KERNELS[op.kernel](*_reassemble(primals, op.present),
+                                 **op.attrs)
+        res = tuple(res) if isinstance(res, (tuple, list)) else (res,)
+        for var, arr in zip(op.outputs, res):
+            env[var.name] = arr
+    return env
+
+
+class Executor:
+    """exe.run(program, feed=..., fetch_list=...) with per-(program, shapes)
+    compiled executables (the _ExecutorCache analog)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self.scope = _global_scope
+        self._cache: Dict[Tuple, Any] = {}
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            return_numpy: bool = True):
+        from .graph import default_main_program
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+
+        # materialize parameters into the scope on first touch
+        for p in program.parameters():
+            if self.scope.var(p.name) is None:
+                init = program.param_init.get(p.name)
+                if init is None:
+                    raise RuntimeError(
+                        f"parameter '{p.name}' has no initializer; run the "
+                        f"startup program or set it via global_scope()")
+                self.scope.set_var(p.name, jnp.asarray(init))
+
+        feed_items = sorted(feed.items())
+        feed_names = tuple(n for n, _ in feed_items)
+        feed_arrays = [jnp.asarray(np.asarray(v)) for _, v in feed_items]
+        param_names = tuple(p.name for p in program.parameters())
+        param_arrays = [self.scope.vars[n] for n in param_names]
+
+        cache_key = (id(program), len(program.global_block.ops), feed_names,
+                     tuple((a.shape, str(a.dtype)) for a in feed_arrays),
+                     tuple(fetch_names))
+        compiled = self._cache.get(cache_key)
+        if compiled is None:
+            def fn(feed_vals, param_vals, seed):
+                env = dict(zip(feed_names, feed_vals))
+                env.update(zip(param_names, param_vals))
+                env = _replay(program, env, jax.random.key(seed))
+                return [env[n] for n in fetch_names]
+
+            compiled = jax.jit(fn)
+            self._cache[cache_key] = compiled
+
+        outs = compiled(feed_arrays, param_arrays,
+                        np.uint32(program.random_seed))
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return outs
+
+    def close(self):
+        self._cache.clear()
+
+
+# -- autodiff over the recorded graph -----------------------------------------
+
+def gradients(targets, inputs, target_gradients=None) -> List[Variable]:
+    """paddle.static.gradients: append grad ops for d(targets)/d(inputs).
+
+    TPU-native: instead of per-op grad-op insertion (reference
+    autograd/ir_backward.py), one recorded "grad super-op" computes all input
+    grads via jax.grad over the program replay — XLA sees the whole backward.
+    """
+    from .graph import _main_program
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    program = _main_program
+    block = program.global_block
+
+    # ops recorded so far form the forward slice this gradient differentiates
+    fwd_ops = list(block.ops)
+    in_names = [v.name for v in inputs]
+    tgt_names = [t.name for t in targets]
+    grad_vars = [block.create_var(v.shape, v.dtype,
+                                  name=f"{v.name}@GRAD_{len(block.ops)}")
+                 for v in inputs]
+    block.ops.append(GradOp(list(inputs), grad_vars, fwd_ops, in_names,
+                            tgt_names))
+    return grad_vars
+
+
+def append_backward(loss: Variable, parameter_list=None):
+    """Returns [(param, grad_param), ...] (reference base/backward.py)."""
+    from .graph import _main_program
+    params = parameter_list or _main_program.parameters()
+    grads = gradients([loss], list(params))
+    return list(zip(params, grads))

@@ -1,0 +1,303 @@
+"""Static-graph mode: Program/Block/Variable/Operator + recorder.
+
+Reference: python/paddle/base/framework.py (Program:5741, Block:4073,
+Variable:1467) — ops called between program_guard() append OpDescs to the
+current Block; Executor later runs the program.
+
+TPU-native: the Program is a recorded op-list over symbolic Variables.
+Recording rides the SAME dispatcher path as eager (ops/dispatcher.py checks
+`in_static_mode()` and routes here), shape/dtype inference is
+`jax.eval_shape` over the already-registered kernel (InferMeta for free), and
+execution compiles the whole replay with `jax.jit` — the reference's
+ProgramDesc→executor pipeline collapses into trace→XLA.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+
+
+class Variable:
+    """Symbolic tensor inside a Program (reference framework.py Variable)."""
+
+    def __init__(self, block: "Block", name: str, shape: Tuple[int, ...],
+                 dtype, stop_gradient: bool = True, is_data: bool = False,
+                 is_parameter: bool = False):
+        self.block = block
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.is_parameter = is_parameter
+        self.persistable = is_parameter
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def aval(self) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(self.shape, self.dtype)
+
+    def __repr__(self):
+        kind = ("param" if self.is_parameter else
+                "data" if self.is_data else "tmp")
+        return f"Variable({self.name}, shape={self.shape}, {kind})"
+
+    # arithmetic sugar so static code reads like eager code
+    def _op(self, name, *args, **kw):
+        from .. import ops
+        return ops.dispatcher.call_op(name, self, *args, **kw)
+
+    def __add__(self, o):
+        return self._op("add", o)
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._op("subtract", o)
+
+    def __mul__(self, o):
+        return self._op("multiply", o)
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._op("divide", o)
+
+    def __matmul__(self, o):
+        return self._op("matmul", o)
+
+    def __neg__(self):
+        return self._op("scale", scale=-1.0)
+
+
+class Operator:
+    """One recorded op application: kernel + slot bindings.
+
+    slots: per-primal entry — Variable (graph edge), jax.Array (literal
+    constant), or the string "__key__" (RNG key injected at run time).
+    """
+
+    def __init__(self, schema_name: str, kernel: str, slots: List[Any],
+                 present: List[int], attrs: Dict[str, Any],
+                 outputs: List[Variable]):
+        self.type = schema_name
+        self.kernel = kernel
+        self.slots = slots
+        self.present = present
+        self.attrs = attrs
+        self.outputs = outputs
+
+    def input_names(self) -> List[str]:
+        return [s.name for s in self.slots if isinstance(s, Variable)]
+
+    # literal jax arrays are not picklable — round-trip them as numpy
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["slots"] = [("__np__", np.asarray(s)) if isinstance(s, jax.Array)
+                      else s for s in self.slots]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.slots = [jnp.asarray(s[1])
+                      if isinstance(s, tuple) and s and s[0] == "__np__"
+                      else s for s in self.slots]
+
+    def __repr__(self):
+        return (f"{{{', '.join(v.name for v in self.outputs)}}} = "
+                f"{self.type}({', '.join(self.input_names())}, "
+                f"{self.attrs})")
+
+
+class Block:
+    def __init__(self, program: "Program", idx: int = 0):
+        self.program = program
+        self.idx = idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+        self._counter = 0
+
+    def create_var(self, shape, dtype, name: Optional[str] = None,
+                   **kw) -> Variable:
+        if name is None:
+            name = f"tmp_{self._counter}"
+            self._counter += 1
+        if name in self.vars:
+            raise ValueError(f"variable '{name}' already exists")
+        v = Variable(self, name, shape, dtype, **kw)
+        self.vars[name] = v
+        return v
+
+    def var(self, name: str) -> Variable:
+        return self.vars[name]
+
+
+class Program:
+    """Reference framework.py Program: blocks of ops + persistable state."""
+
+    def __init__(self):
+        self.blocks = [Block(self)]
+        self.random_seed = 0
+        # parameter name -> initial value (np array); Executor materializes
+        self.param_init: Dict[str, np.ndarray] = {}
+
+    @property
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def list_vars(self) -> List[Variable]:
+        return list(self.global_block.vars.values())
+
+    def parameters(self) -> List[Variable]:
+        return [v for v in self.list_vars() if v.is_parameter]
+
+    def data_vars(self) -> List[Variable]:
+        return [v for v in self.list_vars() if v.is_data]
+
+    def clone(self, for_test: bool = False) -> "Program":
+        import copy
+        return copy.deepcopy(self)
+
+    def __repr__(self):
+        lines = [f"Program ({len(self.global_block.ops)} ops)"]
+        lines += [f"  {op!r}" for op in self.global_block.ops]
+        return "\n".join(lines)
+
+
+# -- mode state ---------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+_static_mode = False
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    global _main_program, _startup_program, _static_mode
+    prev = (_main_program, _startup_program, _static_mode)
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    _static_mode = True
+    try:
+        yield
+    finally:
+        _main_program, _startup_program, _static_mode = prev
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+# -- recording ----------------------------------------------------------------
+
+def involves_symbolic(arguments: Dict[str, Any]) -> bool:
+    for v in arguments.values():
+        if isinstance(v, Variable):
+            return True
+        if isinstance(v, (list, tuple)) and any(
+                isinstance(x, Variable) for x in v):
+            return True
+    return False
+
+
+def record(schema, arguments: Dict[str, Any]):
+    """Static-mode twin of dispatcher._dispatch_impl: same slot walk, but
+    Variables stay symbolic and outputs come from jax.eval_shape."""
+    from ..ops.dispatcher import KERNELS, _reassemble
+
+    block = _main_program.global_block
+    slots: List[Any] = []
+    present: List[int] = []
+    attrs: Dict[str, Any] = {}
+
+    for p in schema.params:
+        v = arguments.get(p.name, p.default)
+        if p.kind == "tensor":
+            if v is None:
+                present.append(0)
+                continue
+            present.append(1)
+            if isinstance(v, Variable):
+                slots.append(v)
+            else:
+                t = v if isinstance(v, Tensor) else Tensor(v)
+                slots.append(t._data)
+        elif p.kind == "tensors":
+            vs = list(v or ())
+            present.append(len(vs) + 2)
+            for x in vs:
+                if isinstance(x, Variable):
+                    slots.append(x)
+                else:
+                    slots.append((x if isinstance(x, Tensor)
+                                  else Tensor(x))._data)
+        else:
+            if isinstance(v, (list, np.ndarray)):
+                v = tuple(np.asarray(v).tolist()) if isinstance(
+                    v, np.ndarray) else tuple(v)
+            if p.name == "dtype" and v is not None:
+                v = dtype_mod.convert_dtype(v)
+            attrs[p.name] = v
+
+    if schema.key:
+        slots.append("__key__")
+        present.append(1)
+
+    def aval_of(s):
+        if isinstance(s, Variable):
+            return s.aval()
+        if s == "__key__":
+            return jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+        return jax.ShapeDtypeStruct(np.shape(s), s.dtype)
+
+    kernel = KERNELS[schema.kernel]
+    structs = [aval_of(s) for s in slots]
+    out_avals = jax.eval_shape(
+        lambda *ps: kernel(*_reassemble(list(ps), present), **attrs),
+        *structs)
+    if not isinstance(out_avals, (tuple, list)):
+        out_avals = (out_avals,)
+
+    stop = all(not isinstance(s, Variable) or s.stop_gradient for s in slots)
+    outs = [block.create_var(a.shape, a.dtype, stop_gradient=stop)
+            for a in out_avals]
+    block.ops.append(Operator(schema.name, schema.kernel, slots, present,
+                              attrs, outs))
+    if len(outs) == 1:
+        return outs[0]
+    return outs
+
+
+# register the static-mode probe with the dispatcher (zero overhead until
+# this module is imported)
+from ..ops import dispatcher as _dispatcher  # noqa: E402
+
+_dispatcher.set_static_hook(in_static_mode)

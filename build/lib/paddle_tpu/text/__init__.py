@@ -1,0 +1,208 @@
+"""paddle_tpu.text — text utilities + dataset parsers (SURVEY §2.6).
+
+Reference: python/paddle/text (ViterbiDecoder/viterbi_decode in
+ops/viterbi_decode; datasets Imdb/Imikolov/UCIHousing/... in datasets/).
+Datasets parse LOCAL files (no network in this stack — the download step of
+the reference's DATA_HOME cache is the caller's job).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..nn.layer_base import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb",
+           "Vocab"]
+
+
+def viterbi_decode(potentials: Tensor, transition: Tensor,
+                   lengths: Optional[Tensor] = None,
+                   include_bos_eos_tag: bool = True):
+    """CRF Viterbi decoding (reference paddle.text.viterbi_decode /
+    phi/kernels/cpu|gpu/viterbi_decode_kernel).
+
+    potentials: [batch, seq, n_tags] unary emission scores
+    transition: [n_tags, n_tags] (transition[i, j]: score of i -> j)
+    lengths:    [batch] actual lengths (defaults to full seq)
+    Returns (scores [batch], paths [batch, seq]).
+
+    TPU-native: the forward max-product recursion is a `lax.scan` over time
+    with backpointer stacking — one compiled loop, no host sync per step.
+    """
+    pot = potentials._data if isinstance(potentials, Tensor) else \
+        jnp.asarray(potentials)
+    trans = transition._data if isinstance(transition, Tensor) else \
+        jnp.asarray(transition)
+    b, s, n = pot.shape
+    if lengths is None:
+        lens = jnp.full((b,), s, jnp.int32)
+    else:
+        lens = (lengths._data if isinstance(lengths, Tensor)
+                else jnp.asarray(lengths)).astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        # reference semantics: tag n-2 = BOS, n-1 = EOS
+        alpha0 = pot[:, 0] + trans[n - 2][None, :]
+    else:
+        alpha0 = pot[:, 0]
+
+    def step(carry, t):
+        alpha, _ = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + pot[b, t, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)             # [b, n]
+        new_alpha = jnp.max(scores, axis=1) + pot[:, t]
+        # masked steps (t >= len) carry alpha through, backptr = identity
+        live = (t < lens)[:, None]
+        new_alpha = jnp.where(live, new_alpha, alpha)
+        best_prev = jnp.where(live, best_prev,
+                              jnp.arange(n)[None, :])
+        return (new_alpha, t), best_prev
+
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (alpha0, jnp.asarray(0)), jnp.arange(1, s))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, n - 1][None, :]
+
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1)                   # [b]
+
+    def backtrace(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan emits ys[i] = tag at time i+1; final carry = tag at time 0
+    first_tag, path_tail = jax.lax.scan(backtrace, last_tag, backptrs,
+                                        reverse=True)
+    paths = jnp.concatenate([first_tag[None, :], path_tail], axis=0).T
+    return Tensor(scores), Tensor(paths.astype(jnp.int32))
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions: Tensor, include_bos_eos_tag: bool = True):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials: Tensor, lengths: Optional[Tensor] = None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class Vocab:
+    """Token ↔ id mapping (reference paddlenlp-style vocab, kept minimal)."""
+
+    def __init__(self, tokens: Sequence[str], unk_token: str = "<unk>",
+                 pad_token: str = "<pad>"):
+        self.itos = [pad_token, unk_token] + [t for t in tokens
+                                              if t not in (pad_token,
+                                                           unk_token)]
+        self.stoi = {t: i for i, t in enumerate(self.itos)}
+        self.unk_id = self.stoi[unk_token]
+        self.pad_id = self.stoi[pad_token]
+
+    def __len__(self):
+        return len(self.itos)
+
+    def to_indices(self, tokens: Sequence[str]) -> List[int]:
+        return [self.stoi.get(t, self.unk_id) for t in tokens]
+
+    def to_tokens(self, ids: Sequence[int]) -> List[str]:
+        return [self.itos[i] for i in ids]
+
+    @staticmethod
+    def build_from_corpus(corpus, max_size: Optional[int] = None,
+                          min_freq: int = 1, **kw) -> "Vocab":
+        from collections import Counter
+        counts = Counter(t for line in corpus for t in line)
+        items = [t for t, c in counts.most_common(max_size) if c >= min_freq]
+        return Vocab(items, **kw)
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression set from a local data file (reference
+    text/datasets/uci_housing.py; 13 features + price)."""
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file: str, mode: str = "train"):
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"UCIHousing: '{data_file}' not found — place the UCI "
+                f"housing.data file locally (no network in this stack)")
+        raw = np.loadtxt(data_file).reshape(-1, self.FEATURE_NUM)
+        # normalize features (reference feature scaling), split 80/20
+        maxs, mins = raw.max(axis=0), raw.min(axis=0)
+        feats = (raw[:, :-1] - mins[:-1]) / np.maximum(
+            maxs[:-1] - mins[:-1], 1e-8)
+        n_train = int(len(raw) * 0.8)
+        if mode == "train":
+            self.data = feats[:n_train].astype(np.float32)
+            self.label = raw[:n_train, -1:].astype(np.float32)
+        else:
+            self.data = feats[n_train:].astype(np.float32)
+            self.label = raw[n_train:, -1:].astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment set from a local aclImdb tar.gz (reference
+    text/datasets/imdb.py — parses the archive, builds a word dict)."""
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 cutoff: int = 150):
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"Imdb: '{data_file}' not found — place aclImdb_v1.tar.gz "
+                f"locally (no network in this stack)")
+        self._tar = tarfile.open(data_file)
+        # vocabulary is built over BOTH splits (reference imdb.py builds one
+        # word dict) so train/test datasets share a consistent mapping
+        all_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        self.docs: List[List[int]] = []
+        self.labels: List[int] = []
+        texts: List[Tuple[List[str], int]] = []
+        from collections import Counter
+        counts: Counter = Counter()
+        for member in self._tar.getmembers():
+            m = all_pat.match(member.name)
+            if not m:
+                continue
+            body = self._tar.extractfile(member).read().decode(
+                "utf-8", errors="ignore").lower()
+            toks = re.findall(r"[a-z]+", body)
+            counts.update(toks)
+            if m.group(1) == mode:
+                texts.append((toks, 0 if m.group(2) == "neg" else 1))
+        vocab = [w for w, c in counts.most_common() if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
+        for toks, label in texts:
+            self.docs.append([self.word_idx.get(t, unk) for t in toks])
+            self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.asarray(self.docs[idx]), self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)

@@ -1,0 +1,6 @@
+"""paddle_tpu.utils — interop + extension utilities."""
+
+from . import dlpack  # noqa: F401
+from .custom_op import register_op  # noqa: F401
+
+__all__ = ["dlpack", "register_op"]

@@ -1,0 +1,191 @@
+"""Custom-device plugin loader over the C_DeviceInterface ABI.
+
+Reference counterpart: `paddle/phi/backends/custom/custom_device.cc` +
+`device_ext.h:94` (plugin dlopened, `InitPlugin(CustomRuntimeParams*)`
+called, interface table validated and registered with DeviceManager);
+proven hardware-free by the fake CPU plugin
+(`test/custom_runtime/test_custom_cpu_plugin.py`). The C structs live in
+csrc/device_ext.h; this module mirrors them in ctypes and exposes the
+loaded plugin as a `CustomDevice` with the runtime surface (alloc / free /
+h2d / d2h / sync / stats). Compute stays on XLA; the plugin ABI covers the
+runtime-management surface the reference offers out-of-tree devices.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, Optional
+
+_MAJOR, _MINOR, _PATCH = 1, 0, 0
+
+
+class C_DeviceSt(ctypes.Structure):
+    _fields_ = [("id", ctypes.c_int)]
+
+
+_C_Device = ctypes.POINTER(C_DeviceSt)
+_Status = ctypes.c_int
+_voidp = ctypes.c_void_p
+_size_t = ctypes.c_size_t
+
+_FN = ctypes.CFUNCTYPE
+
+
+class C_DeviceInterface(ctypes.Structure):
+    _fields_ = [
+        ("size", _size_t),
+        ("initialize", _FN(_Status)),
+        ("finalize", _FN(_Status)),
+        ("init_device", _FN(_Status, _C_Device)),
+        ("set_device", _FN(_Status, _C_Device)),
+        ("get_device", _FN(_Status, _C_Device)),
+        ("deinit_device", _FN(_Status, _C_Device)),
+        ("create_stream", _FN(_Status, _C_Device, ctypes.POINTER(_voidp))),
+        ("destroy_stream", _FN(_Status, _C_Device, _voidp)),
+        ("synchronize_device", _FN(_Status, _C_Device)),
+        ("synchronize_stream", _FN(_Status, _C_Device, _voidp)),
+        ("create_event", _FN(_Status, _C_Device, ctypes.POINTER(_voidp))),
+        ("record_event", _FN(_Status, _C_Device, _voidp, _voidp)),
+        ("destroy_event", _FN(_Status, _C_Device, _voidp)),
+        ("synchronize_event", _FN(_Status, _C_Device, _voidp)),
+        ("device_memory_allocate",
+         _FN(_Status, _C_Device, ctypes.POINTER(_voidp), _size_t)),
+        ("device_memory_deallocate", _FN(_Status, _C_Device, _voidp,
+                                         _size_t)),
+        ("host_memory_allocate",
+         _FN(_Status, _C_Device, ctypes.POINTER(_voidp), _size_t)),
+        ("host_memory_deallocate", _FN(_Status, _C_Device, _voidp, _size_t)),
+        ("memory_copy_h2d", _FN(_Status, _C_Device, _voidp, _voidp,
+                                _size_t)),
+        ("memory_copy_d2h", _FN(_Status, _C_Device, _voidp, _voidp,
+                                _size_t)),
+        ("memory_copy_d2d", _FN(_Status, _C_Device, _voidp, _voidp,
+                                _size_t)),
+        ("get_device_count", _FN(_Status, ctypes.POINTER(_size_t))),
+        ("get_device_list", _FN(_Status, ctypes.POINTER(_size_t))),
+        ("device_memory_stats", _FN(_Status, _C_Device,
+                                    ctypes.POINTER(_size_t),
+                                    ctypes.POINTER(_size_t))),
+        ("device_min_chunk_size", _FN(_Status, _C_Device,
+                                      ctypes.POINTER(_size_t))),
+    ]
+
+
+class CustomRuntimeVersion(ctypes.Structure):
+    _fields_ = [("major", _size_t), ("minor", _size_t), ("patch", _size_t)]
+
+
+class CustomRuntimeParams(ctypes.Structure):
+    _fields_ = [
+        ("size", _size_t),
+        ("interface", ctypes.POINTER(C_DeviceInterface)),
+        ("version", CustomRuntimeVersion),
+        ("device_type", ctypes.c_char_p),
+        ("device_type_size", _size_t),
+        ("sub_device_type", ctypes.c_char_p),
+        ("sub_device_type_size", _size_t),
+    ]
+
+
+class CustomDevice:
+    """A loaded plugin: the DeviceManager-registered runtime handle."""
+
+    def __init__(self, lib_path: str):
+        self._cdll = ctypes.CDLL(lib_path)
+        self._iface = C_DeviceInterface()
+        params = CustomRuntimeParams()
+        params.size = ctypes.sizeof(CustomRuntimeParams)
+        params.interface = ctypes.pointer(self._iface)
+        name_buf = ctypes.create_string_buffer(64)
+        sub_buf = ctypes.create_string_buffer(64)
+        params.device_type = ctypes.cast(name_buf, ctypes.c_char_p)
+        params.device_type_size = 64
+        params.sub_device_type = ctypes.cast(sub_buf, ctypes.c_char_p)
+        params.sub_device_type_size = 64
+        init = self._cdll.InitPlugin
+        init.argtypes = [ctypes.POINTER(CustomRuntimeParams)]
+        init.restype = None
+        init(ctypes.byref(params))
+        self.device_type = name_buf.value.decode()
+        v = params.version
+        if (v.major, v.minor) != (_MAJOR, _MINOR):
+            raise RuntimeError(
+                f"plugin '{self.device_type}' built against custom-runtime "
+                f"{v.major}.{v.minor}.{v.patch}, host is "
+                f"{_MAJOR}.{_MINOR}.{_PATCH}")
+        if self._iface.size != ctypes.sizeof(C_DeviceInterface):
+            raise RuntimeError("C_DeviceInterface size mismatch")
+        self._dev = C_DeviceSt(0)
+        self._check(self._iface.initialize(), "initialize")
+        self._check(self._iface.init_device(ctypes.byref(self._dev)),
+                    "init_device")
+
+    @staticmethod
+    def _check(status: int, what: str):
+        if status != 0:
+            raise RuntimeError(f"custom device call '{what}' failed "
+                               f"(status {status})")
+
+    # -- runtime surface ------------------------------------------------------
+    def device_count(self) -> int:
+        n = _size_t()
+        self._check(self._iface.get_device_count(ctypes.byref(n)),
+                    "get_device_count")
+        return int(n.value)
+
+    def alloc(self, size: int) -> int:
+        ptr = _voidp()
+        self._check(self._iface.device_memory_allocate(
+            ctypes.byref(self._dev), ctypes.byref(ptr), size), "alloc")
+        return ptr.value
+
+    def free(self, ptr: int, size: int):
+        self._check(self._iface.device_memory_deallocate(
+            ctypes.byref(self._dev), ptr, size), "free")
+
+    def copy_h2d(self, dst: int, src_bytes: bytes):
+        buf = ctypes.create_string_buffer(src_bytes, len(src_bytes))
+        self._check(self._iface.memory_copy_h2d(
+            ctypes.byref(self._dev), dst,
+            ctypes.cast(buf, _voidp), len(src_bytes)), "h2d")
+
+    def copy_d2h(self, src: int, size: int) -> bytes:
+        out = ctypes.create_string_buffer(size)
+        self._check(self._iface.memory_copy_d2h(
+            ctypes.byref(self._dev), ctypes.cast(out, _voidp), src, size),
+            "d2h")
+        return out.raw
+
+    def synchronize(self):
+        self._check(self._iface.synchronize_device(ctypes.byref(self._dev)),
+                    "synchronize")
+
+    def memory_stats(self):
+        total, free = _size_t(), _size_t()
+        self._check(self._iface.device_memory_stats(
+            ctypes.byref(self._dev), ctypes.byref(total),
+            ctypes.byref(free)), "memory_stats")
+        return int(total.value), int(free.value)
+
+    def finalize(self):
+        self._iface.deinit_device(ctypes.byref(self._dev))
+        self._iface.finalize()
+
+
+_REGISTRY: Dict[str, CustomDevice] = {}
+
+
+def load_custom_device(lib_path: str) -> CustomDevice:
+    """dlopen a plugin and register it (DeviceManager::Register analog)."""
+    dev = CustomDevice(lib_path)
+    _REGISTRY[dev.device_type] = dev
+    return dev
+
+
+def get_custom_device(device_type: str) -> Optional[CustomDevice]:
+    return _REGISTRY.get(device_type)
+
+
+def list_custom_devices():
+    return sorted(_REGISTRY)

@@ -1,0 +1,44 @@
+"""Out-of-tree custom op registration (reference PD_BUILD_OP /
+paddle/fluid/framework/custom_operator.cc + utils/cpp_extension).
+
+TPU-native: a custom op is a jax-traceable function (jnp / pallas kernel)
+registered into the same dispatcher as the YAML ops — it gets the per-op jit
+cache, autograd wiring (jax.vjp, honoring any jax.custom_vjp inside), Tensor
+method binding, and static-graph recording for free.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..ops import dispatcher
+from ..ops.dispatcher import OpSchema, ParamSpec, _OP_FNS, make_op_fn
+
+
+def register_op(name: str, kernel: Callable, *,
+                num_inputs: int = 1, attrs: Optional[dict] = None,
+                differentiable: bool = True, jit: bool = True,
+                method: Optional[str] = None, doc: str = "") -> Callable:
+    """Register `kernel(x1, ..., xn, **attrs)` as op `name`; returns the
+    public op function (also reachable via paddle_tpu.ops dispatcher).
+
+    attrs: mapping attr_name -> default value.
+    """
+    if name in dispatcher.OPS:
+        raise ValueError(f"op '{name}' already registered")
+    params = [ParamSpec(f"x{i}" if num_inputs > 1 else "x", "tensor")
+              for i in range(num_inputs)]
+    for aname, default in (attrs or {}).items():
+        params.append(ParamSpec(aname, "attr", has_default=True,
+                                default=default))
+    dispatcher.KERNELS[name] = kernel
+    schema = OpSchema(name=name, params=params, kernel=name,
+                      differentiable=differentiable, jit=jit, method=method,
+                      doc=doc or f"custom op '{name}'")
+    dispatcher.OPS[name] = schema
+    fn = make_op_fn(schema)
+    _OP_FNS[name] = fn
+    if method:
+        from ..core.tensor import Tensor
+        setattr(Tensor, method, lambda self, *a, **k: fn(self, *a, **k))
+    return fn

@@ -1,0 +1,67 @@
+"""DLPack interop (reference paddle.utils.dlpack over
+paddle/fluid/framework/dlpack_tensor.cc) — zero-copy exchange with torch,
+numpy, cupy etc.
+
+Modern DLPack is object-protocol based (`__dlpack__`/`__dlpack_device__`);
+`to_dlpack` returns a protocol object every current consumer
+(torch.from_dlpack, np.from_dlpack, jnp.from_dlpack) accepts directly.
+Legacy one-shot capsules (e.g. from torch.utils.dlpack.to_dlpack) are
+wrapped with a host-device shim on import.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class _DLPackExporter:
+    """Protocol view over the underlying jax array (consumable by torch,
+    numpy, cupy, jax)."""
+
+    def __init__(self, array: jax.Array):
+        self._array = array
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._array.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._array.__dlpack_device__()
+
+
+class _CapsuleShim:
+    """Adapter for legacy one-shot PyCapsules (host-memory producers such as
+    torch.utils.dlpack.to_dlpack on CPU): presents the protocol interface."""
+
+    _KDLCPU = 1
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, *args, **kwargs):
+        cap, self._capsule = self._capsule, None
+        if cap is None:
+            raise RuntimeError("DLPack capsule already consumed")
+        return cap
+
+    def __dlpack_device__(self):
+        return (self._KDLCPU, 0)
+
+
+def to_dlpack(x: Tensor):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return _DLPackExporter(data)
+
+
+def from_dlpack(ext) -> Tensor:
+    """Accepts any __dlpack__-bearing object (torch/numpy/cupy/jax arrays,
+    to_dlpack results) or a legacy PyCapsule (assumed host memory)."""
+    if hasattr(ext, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(ext))
+    if type(ext).__name__ == "PyCapsule":
+        return Tensor(jnp.from_dlpack(_CapsuleShim(ext)))
+    raise TypeError(f"from_dlpack: unsupported source {type(ext)!r}")

@@ -1,0 +1,94 @@
+"""Vision ops (reference python/paddle/vision/ops.py). Box utilities are
+vectorised jnp composites; NMS is a host-side op (data-dependent output
+shape — a jit boundary by design, like the reference's dynamic-shape GPU op).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["box_area", "box_iou", "nms", "deform_conv2d"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def box_area(boxes):
+    """boxes: [N, 4] (x1, y1, x2, y2)."""
+    boxes = _t(boxes)
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU: [N, 4] x [M, 4] -> [N, M]."""
+    import jax.numpy as jnp
+    b1 = _t(boxes1)._data
+    b2 = _t(boxes2)._data
+    area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return Tensor(inter / jnp.maximum(union, 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS. Host loop over candidates (output length is
+    data-dependent); returns kept indices as an int64 Tensor.
+    Reference: vision/ops.py ``nms``.
+    """
+    boxes_np = _t(boxes).numpy()
+    n = boxes_np.shape[0]
+    scores_np = (np.arange(n - 1, -1, -1, dtype=np.float32)
+                 if scores is None else _t(scores).numpy())
+
+    if category_idxs is not None:
+        cat = _t(category_idxs).numpy()
+        keep_all = []
+        cats = categories if categories is not None else np.unique(cat)
+        for c in cats:
+            idx = np.nonzero(cat == c)[0]
+            if idx.size == 0:
+                continue
+            kept = _nms_single(boxes_np[idx], scores_np[idx], iou_threshold)
+            keep_all.append(idx[kept])
+        keep = np.concatenate(keep_all) if keep_all else np.empty(0, np.int64)
+        keep = keep[np.argsort(-scores_np[keep], kind="stable")]
+    else:
+        keep = _nms_single(boxes_np, scores_np, iou_threshold)
+
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep.astype(np.int64))
+
+
+def _nms_single(boxes, scores, thresh):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = (x2 - x1) * (y2 - y1)
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1[order[1:]])
+        yy1 = np.maximum(y1[i], y1[order[1:]])
+        xx2 = np.minimum(x2[i], x2[order[1:]])
+        yy2 = np.minimum(y2[i], y2[order[1:]])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        iou = inter / np.maximum(areas[i] + areas[order[1:]] - inter, 1e-10)
+        order = order[1:][iou <= thresh]
+    return np.asarray(keep, dtype=np.int64)
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d: irregular gathers don't map to the MXU; use "
+        "resampling composites or file an issue if this blocks a model")

@@ -1,0 +1,37 @@
+"""Wheel build for paddle-tpu (reference analog: /root/reference/setup.py,
+which drives the CMake superbuild; here the native ring is three small C++
+libs built by csrc/Makefile).
+
+The native libs are OPTIONAL at build time: if a C++ toolchain exists the
+wheel ships them prebuilt; otherwise the wheel is pure-Python and
+`paddle_tpu.native` falls back to (a) building via `make` at first import
+or (b) documented pure-Python stand-ins. Metadata lives in pyproject.toml.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        self._build_native()
+        super().run()
+
+    def _build_native(self):
+        root = os.path.dirname(os.path.abspath(__file__))
+        csrc = os.path.join(root, "csrc")
+        if not os.path.isdir(csrc) or shutil.which("make") is None \
+                or shutil.which(os.environ.get("CXX", "g++")) is None:
+            print("paddle-tpu: no C++ toolchain; building pure-Python wheel "
+                  "(native libs will build on demand at import)")
+            return
+        r = subprocess.run(["make", "-C", csrc, "all"])
+        if r.returncode != 0:
+            print("paddle-tpu: native build failed; continuing pure-Python")
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})
