@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ...native.tcp_store import TCPStore
 
@@ -61,7 +61,10 @@ class ElasticManager:
             try:
                 self._beat()
             except Exception:
-                return
+                # transient store hiccup: keep the lease alive by retrying —
+                # a permanent exit here would silently evict this node from
+                # membership while it is still healthy
+                continue
 
     # -- membership ----------------------------------------------------------
     def _known_nodes(self) -> List[str]:
@@ -84,7 +87,11 @@ class ElasticManager:
         self.store.set(f"{self.prefix}/index/{slot}", self.node_id)
 
     def alive_nodes(self) -> List[str]:
-        """Nodes whose lease (heartbeat) is fresh within TTL."""
+        """Nodes whose lease (heartbeat) is fresh within TTL.
+
+        Freshness compares the writer's clock to the reader's: cross-host
+        skew must stay below ttl (the reference's etcd leases are
+        server-side; a store-side lease would remove the assumption)."""
         now = time.time()
         alive = []
         for n in self._known_nodes():
@@ -92,6 +99,23 @@ class ElasticManager:
             if raw is not None and now - float(raw) < self.ttl:
                 alive.append(n)
         return alive
+
+    def membership_snapshot(self) -> Tuple[List[str], List[str]]:
+        """(alive, alive-and-not-preempted) in ONE pass over the store —
+        the watch-loop primitive (3 polls/sec × n nodes each doing 3
+        separate scans would hammer the single store)."""
+        nodes = self._known_nodes()
+        now = time.time()
+        alive, usable = [], []
+        for n in nodes:
+            raw = self.store.get(f"{self.prefix}/beat/{n}", wait=False)
+            if raw is None or now - float(raw) >= self.ttl:
+                continue
+            alive.append(n)
+            notice = self.store.get(f"{self.prefix}/preempt/{n}", wait=False)
+            if not self._notice_fresh(notice):
+                usable.append(n)
+        return alive, usable
 
     def pod_status(self) -> str:
         # nodes under preemption notice leave the membership immediately,

@@ -20,7 +20,8 @@ def free_port() -> int:
 @dataclass
 class Args:
     master: Optional[str] = None      # ip:port of the rendezvous store
-    nnodes: int = 1
+    nnodes: int = 1                   # max/target node count
+    np_min: int = 1                   # elastic lower bound (--nnodes MIN:MAX)
     node_rank: int = 0
     nproc_per_node: int = 1
     job_id: str = "default"
@@ -34,6 +35,23 @@ class Args:
     training_script_args: List[str] = field(default_factory=list)
 
 
+def _nnodes_spec(raw: str):
+    """'N' or 'MIN:MAX' -> (np_min, np_max); argparse-friendly errors."""
+    try:
+        if ":" in raw:
+            lo, hi = raw.split(":", 1)
+            np_min, np_max = int(lo), int(hi)
+        else:
+            np_min = np_max = int(raw)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected N or MIN:MAX, got {raw!r}")
+    if np_min < 1 or np_min > np_max:
+        raise argparse.ArgumentTypeError(
+            f"{raw!r}: need 1 <= MIN <= MAX")
+    return np_min, np_max
+
+
 def parse_args(argv: Optional[List[str]] = None) -> Args:
     env = os.environ
     p = argparse.ArgumentParser(
@@ -43,8 +61,10 @@ def parse_args(argv: Optional[List[str]] = None) -> Args:
     p.add_argument("--master",
                    default=env.get("PADDLE_MASTER"),
                    help="rendezvous endpoint ip:port (node 0 hosts it)")
-    p.add_argument("--nnodes", type=int,
-                   default=int(env.get("PADDLE_NNODES", 1)))
+    p.add_argument("--nnodes", type=_nnodes_spec,
+                   default=env.get("PADDLE_NNODES", "1"),
+                   help="node count N, or elastic range MIN:MAX "
+                        "(reference --nnodes '2:4' syntax)")
     p.add_argument("--node_rank", type=int,
                    default=int(env.get("PADDLE_NODE_RANK", 0)))
     p.add_argument("--nproc_per_node", type=int,
@@ -62,7 +82,14 @@ def parse_args(argv: Optional[List[str]] = None) -> Args:
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     ns = p.parse_args(argv)
-    return Args(**vars(ns))
+    np_min, np_max = (_nnodes_spec(ns.nnodes)
+                      if isinstance(ns.nnodes, str) else ns.nnodes)
+    ns.nnodes = np_max
+    if np_min < np_max and ns.elastic_level < 0:
+        ns.elastic_level = 1  # a range implies elastic mode
+    args = Args(**vars(ns))
+    args.np_min = np_min
+    return args
 
 
 class Context:

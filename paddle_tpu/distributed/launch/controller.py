@@ -16,7 +16,7 @@ from typing import List, Optional
 
 from .context import Context, free_port
 from .job import Container, Pod
-from .master import Master
+from .master import Master, _NotInMembership
 
 
 class CollectiveController:
@@ -25,6 +25,30 @@ class CollectiveController:
         self.master: Optional[Master] = None
         self.pod = Pod(f"pod_{ctx.args.node_rank}")
         self._generation = 0
+        self._restart_count = 0
+        self.elastic = None  # ElasticManager when elastic_level >= 0
+        self._members: List[int] = []  # node ranks deployed this generation
+
+    def _elastic_on(self) -> bool:
+        a = self.ctx.args
+        return a.nnodes > 1 and a.elastic_level >= 0
+
+    def _ensure_elastic(self):
+        """Membership heartbeats over the master store (reference
+        ElasticManager etcd leases, fleet/elastic/manager.py:221-256)."""
+        if self.elastic is not None or not self._elastic_on():
+            return
+        from ..fleet.elastic import ElasticManager
+        a = self.ctx.args
+        self.elastic = ElasticManager(
+            self.master.store, node_id=str(a.node_rank),
+            np_min=a.np_min, np_max=a.nnodes,
+            ttl=max(2.0, a.elastic_timeout / 10.0), job_id=a.job_id)
+        self.elastic.register()
+
+    def _alive_ranks(self) -> List[str]:
+        _, usable = self.elastic.membership_snapshot()
+        return usable
 
     # -- pod construction ----------------------------------------------------
     def build_pod(self) -> Pod:
@@ -36,13 +60,24 @@ class CollectiveController:
             if self.master is None:  # reused across restarts (server keeps
                 self.master = Master(a.master, a.node_rank, a.nnodes,
                                      a.job_id)  # its port; see run())
+            self._ensure_elastic()
             # generation comes from the shared store counter so every node
             # (the failed one and the co-restarting ones) syncs on one tag
             self._generation = self.master.current_generation()
-            peers = self.master.sync_peers(
-                {"ip": self.ctx.node_ip, "nproc": nproc,
-                 "node_rank": a.node_rank}, generation=self._generation)
-            rank_offset = sum(p["nproc"] for p in peers[:a.node_rank])
+            payload = {"ip": self.ctx.node_ip, "nproc": nproc,
+                       "node_rank": a.node_rank}
+            if self.elastic is not None:
+                peers = self.master.sync_peers_elastic(
+                    payload, self._generation, self._alive_ranks,
+                    np_min=a.np_min, np_max=a.nnodes,
+                    timeout=float(a.elastic_timeout))
+            else:
+                peers = self.master.sync_peers(payload,
+                                               generation=self._generation)
+            self._members = [p["node_rank"] for p in peers]
+            my_pos = self._members.index(a.node_rank)
+            # re-ranked over the CURRENT membership (scale-in shifts ranks)
+            rank_offset = sum(p["nproc"] for p in peers[:my_pos])
             world = sum(p["nproc"] for p in peers)
             endpoints = []
             for p in peers:
@@ -72,6 +107,10 @@ class CollectiveController:
                 "PADDLE_DIST_COORDINATOR": coordinator,
                 "RANK": str(rank),
                 "WORLD_SIZE": str(world),
+                # restart observability: scripts key checkpoint-resume off
+                # these (reference PADDLE_RESTART / elastic generation)
+                "PADDLE_RESTART_GENERATION": str(self._generation),
+                "PADDLE_RESTART_COUNT": str(self._restart_count),
             }
             if a.devices:
                 env["PADDLE_DEVICES"] = a.devices
@@ -87,20 +126,36 @@ class CollectiveController:
     def run(self) -> int:
         a = self.ctx.args
         restarts = 0
+        missed_rounds = 0
         try:
             while True:
-                self.build_pod()
+                try:
+                    self.build_pod()
+                    missed_rounds = 0
+                except _NotInMembership:
+                    # missed this round's snapshot; rejoin at the (already
+                    # bumped) next generation. Bounded with backoff: a node
+                    # that can NEVER join (clock skew > ttl, partitioned)
+                    # must not livelock the healthy peers by bumping the
+                    # generation forever
+                    missed_rounds += 1
+                    if missed_rounds > max(a.max_restart, 1) + 2:
+                        self.ctx.status = "unreachable"
+                        return 1
+                    time.sleep(min(0.5 * (2 ** missed_rounds), 10.0))
+                    continue
                 self.pod.deploy()
                 status = self._watch()
                 if status == "done":
                     return 0
                 if status == "gen_changed":
-                    # a peer failed and bumped the shared generation: rejoin
-                    # the rendezvous (does not consume this node's restarts)
+                    # a peer failed/joined and the shared generation moved:
+                    # rejoin the rendezvous (does not consume restarts)
                     self.ctx.status = "restarting"
                     self.pod.stop()
                     continue
                 restarts += 1
+                self._restart_count = restarts
                 if restarts > max(a.max_restart, 0) or a.elastic_level < 0:
                     self.pod.stop()
                     return 1
@@ -110,11 +165,20 @@ class CollectiveController:
                     self.master.bump_generation()  # pull peers into re-sync
                 time.sleep(1.0)
         finally:
+            if self.elastic is not None:
+                self.elastic.stop()
+                self.elastic = None
             if self.master is not None:
                 self.master.close()
                 self.master = None
 
     def _watch(self) -> str:
+        a = self.ctx.args
+        # membership scan is O(n) store round-trips: poll it at lease
+        # granularity, not at pod-poll granularity
+        member_poll = max(1.0, (self.elastic.ttl / 2
+                                if self.elastic is not None else 1.0))
+        next_member_check = time.monotonic()
         while True:
             status = self.pod.poll()
             if status != "running":
@@ -124,10 +188,24 @@ class CollectiveController:
             if self.master is not None:
                 if self.master.current_generation() != self._generation:
                     return "gen_changed"
+            if self.elastic is not None and \
+                    time.monotonic() >= next_member_check:
+                next_member_check = time.monotonic() + member_poll
+                alive = sorted(int(n) for n in self._alive_ranks())
+                lost = [m for m in self._members if m not in alive]
+                joined = [n for n in alive if n not in self._members]
+                # level 0 (fault-tolerant): react to lost members only;
+                # level 1 (elastic): also re-rank when fresh nodes join
+                if lost or (joined and a.elastic_level >= 1):
+                    self.master.bump_generation()
+                    return "gen_changed"
             time.sleep(0.5)
 
     def stop(self):
         self.pod.stop()
+        if self.elastic is not None:
+            self.elastic.stop()
+            self.elastic = None
         if self.master is not None:
             self.master.close()
             self.master = None

@@ -15,6 +15,15 @@ from typing import List, Optional, Tuple
 from ...native.tcp_store import TCPStore
 
 
+class _NotInMembership(RuntimeError):
+    """This node missed the leader's membership snapshot for a generation;
+    the caller should rejoin at the (already bumped) next generation."""
+
+    def __init__(self, generation: int):
+        super().__init__(f"not in membership snapshot g{generation}")
+        self.generation = generation
+
+
 class Master:
     def __init__(self, endpoint: str, node_rank: int, nnodes: int,
                  job_id: str = "default", timeout: float = 300.0):
@@ -38,6 +47,49 @@ class Master:
             raw = self.store.get(f"{tag}/node/{r}")
             peers.append(json.loads(raw.decode()))
         return peers
+
+    def sync_peers_elastic(self, payload: dict, generation: int,
+                           alive_fn, np_min: int, np_max: int,
+                           timeout: float = 30.0,
+                           poll: float = 0.25) -> List[dict]:
+        """Membership-based rendezvous (reference ElasticManager + master
+        watch, fleet/elastic/manager.py:221-256): proceed as soon as every
+        expected node has registered, or — after `timeout` — with whatever
+        ALIVE subset (>= np_min) has. The lowest-ranked alive node publishes
+        the canonical member list so all peers agree on one snapshot; ranks
+        are re-assigned over that list (scale-in re-ranking)."""
+        tag = f"{self.prefix}/g{generation}"
+        self.store.set(f"{tag}/node/{self.node_rank}", json.dumps(payload))
+        deadline = time.monotonic() + timeout
+        hard_deadline = deadline + timeout  # leader-vanished safety net
+        while True:
+            raw = self.store.get(f"{tag}/members", wait=False)
+            if raw is not None:  # a leader already decided this round
+                members = json.loads(raw.decode())
+                if self.node_rank not in members:
+                    # snapshot taken before we arrived: force a new round
+                    # so everyone (including us) re-syncs
+                    self.bump_generation()
+                    raise _NotInMembership(generation)
+                return [json.loads(self.store.get(
+                    f"{tag}/node/{r}").decode()) for r in members]
+            alive = sorted(int(n) for n in alive_fn())
+            registered = [r for r in alive
+                          if self.store.get(f"{tag}/node/{r}", wait=False)]
+            decided = len(registered) >= np_max or (
+                time.monotonic() >= deadline and len(registered) >= np_min)
+            if decided and registered[0] == self.node_rank:
+                # lowest alive rank in OUR view tries to publish; views can
+                # diverge under lease TTL, so publication is guarded by an
+                # atomic first-claimer-wins counter — a second self-elected
+                # leader loses the claim and adopts the published snapshot
+                if self.store.add(f"{tag}/members_claim", 1) == 1:
+                    self.store.set(f"{tag}/members", json.dumps(registered))
+                continue
+            if time.monotonic() >= hard_deadline:
+                self.bump_generation()
+                raise _NotInMembership(generation)
+            time.sleep(poll)
 
     def heartbeat(self, ttl_info: Optional[str] = None):
         """Publish a liveness timestamp. Not called on the controller's hot

@@ -127,3 +127,7 @@ class TestDistModel:
             assert np.isfinite(l0) and np.isfinite(l1)
         finally:
             topo.set_hybrid_communicate_group(None)
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy

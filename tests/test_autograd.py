@@ -158,3 +158,7 @@ class TestNumpyInterop:
         assert isinstance(y, paddle.Tensor)
         y.sum().backward()
         np.testing.assert_allclose(x.grad.numpy(), [0.5])
+
+# fast subset for `pytest -m smoke` pre-commit runs (<60s total)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.smoke

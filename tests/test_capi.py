@@ -138,3 +138,7 @@ class TestInferenceCAPI:
 
         lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
         lib.PD_PredictorDestroy(pred)
+
+# fast subset for `pytest -m smoke` pre-commit runs (<60s total)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.smoke

@@ -310,3 +310,7 @@ class TestCollectiveExtras:
         from paddle_tpu.distributed import collective as C
         with pytest.raises(NotImplementedError):
             C.scatter_object_list([], None)
+
+# fast subset for `pytest -m smoke` pre-commit runs (<60s total)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.smoke

@@ -306,3 +306,7 @@ class TestReviewRegressions:
         x = q.numpy()
         rot = np.stack([-x[..., 1::2], x[..., ::2]], axis=-1).reshape(x.shape)
         np.testing.assert_allclose(out.numpy(), x * c + rot * s, rtol=1e-5)
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy

@@ -156,3 +156,7 @@ class TestBert:
         s2, _ = m(ids)
         np.testing.assert_allclose(np.asarray(s1._data), np.asarray(s2._data),
                                    rtol=1e-5, atol=1e-5)
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy

@@ -243,3 +243,7 @@ class TestExpertParallel:
             assert losses[1] < losses[0]
         finally:
             topo.set_hybrid_communicate_group(None)
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy

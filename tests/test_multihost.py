@@ -83,3 +83,7 @@ class TestSingleProcessNoop:
         penv = dist.init_parallel_env()
         assert penv.world_size == 1
         assert not jax.distributed.is_initialized()
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy

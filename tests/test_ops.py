@@ -297,3 +297,7 @@ class TestCreation:
     def test_dtype_defaults(self):
         assert paddle.zeros([1]).dtype == np.float32
         assert paddle.arange(3).dtype == np.int32
+
+# fast subset for `pytest -m smoke` pre-commit runs (<60s total)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.smoke

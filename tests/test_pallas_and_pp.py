@@ -309,3 +309,7 @@ class TestRingAttention:
         loss_sep = crit(m_sep(ids), ids)
         loss_sep.backward()
         assert abs(float(loss_ref._data) - float(loss_sep._data)) < 1e-5
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy

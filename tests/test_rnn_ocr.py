@@ -180,3 +180,7 @@ class TestVariableLength:
                                    atol=1e-5)
         np.testing.assert_allclose(h_p.numpy(), h_t.detach().numpy(),
                                    atol=1e-5)
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy

@@ -183,3 +183,7 @@ class TestTrainStepStage1:
                 assert "sharding" in str(v.sharding.spec)
                 assert v.addressable_shards[0].data.size == v.size // 4
             assert _replicated(p._data)
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy

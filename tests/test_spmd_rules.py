@@ -395,3 +395,7 @@ class TestReviewRegressions:
         info = get_spmd_rule("matmul").infer_forward(
             spec((1, 64, 32), [-1, -1, -1]), spec((5, 32, 48), [0, -1, -1]))
         assert info.output_specs[0].shape == (5, 64, 48)
+
+# fast subset for `pytest -m smoke` pre-commit runs (<60s total)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.smoke

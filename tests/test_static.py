@@ -167,3 +167,7 @@ class TestBackwardPickle:
         blob = pickle.dumps(prog)
         prog2 = pickle.loads(blob)
         assert any(op.type == "grad" for op in prog2.global_block.ops)
+
+# fast subset for `pytest -m smoke` pre-commit runs (<60s total)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.smoke

@@ -318,3 +318,7 @@ class TestVisionOps:
                         category_idxs=paddle.to_tensor(cats),
                         categories=[0, 1]).numpy()
         assert set(keep.tolist()) == {0, 1}
+
+# multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
+import pytest as _pytest_mark  # noqa: E402
+pytestmark = _pytest_mark.mark.heavy
