@@ -1,23 +1,35 @@
-"""Headline benchmark: Llama pretrain step throughput on one chip.
+"""Benchmarks for all 5 BASELINE configs + kernel micro-benches.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line. The headline metric stays the Llama pretrain MFU
+(BASELINE.json: target 40% on v5p); `detail.configs` carries the other
+BASELINE configs and kernel micro-benchmarks, each with its own
+vs_baseline ratio:
 
-Headline metric (BASELINE.json): Llama pretrain MFU (target 40% on v5p).
-We run a scaled Llama (same arch as Llama-3, sized for one chip), compile
-the full train step (fwd+bwd+AdamW, bf16 params + fp32 master), and report
-model FLOPs utilisation: 6 * params * tokens/sec / peak_flops.
+  - model configs (resnet/bert/ocr): ratio = native_jax_step_time /
+    our_step_time against a hand-written JAX training step of the SAME
+    architecture (benchmarks/native_jax.py) — measures framework overhead
+    over raw XLA (SURVEY §6 BERT exit criterion: within 1.5x of a flax
+    equivalent, i.e. ratio >= 0.67; >= 1.0 means we match raw JAX).
+  - moe + kernel micros: ratio = xla_composite_time / pallas_time on the
+    same shapes (PARITY.md's perf claims, recorded).
+  - eager_dispatch: per-op eager overhead vs the jit path (VERDICT r2
+    Next#3 evidence).
+
+Env knobs: PTPU_BENCH_CONFIGS=llama,resnet,bert,ocr,moe,micro,dispatch
+(comma list; default all on TPU, tiny smoke set on CPU).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # bf16 peak FLOP/s per chip by TPU generation
 _PEAK = {
@@ -36,15 +48,34 @@ def _peak_flops(device) -> float:
     return 275e12  # conservative default (v4)
 
 
-def main():
+def _time_steps(fn, steps: int, *args, final=None):
+    """fn(*args) -> a jax array (or pytree); returns seconds/step.
+
+    Steps chain through device-resident state, so timing N launches and
+    blocking once at the end measures the true sequential cost. `final`
+    (optional) returns the array to block on — pass the UPDATED PARAMS for
+    train steps (the last loss alone would not cover the final update)."""
+    out = fn(*args)  # warmup/compile
+    jax.block_until_ready(out)
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(final() if final is not None else out)
+    return (time.perf_counter() - t0) / steps
+
+
+# --------------------------------------------------------------------------
+# headline: Llama pretrain MFU (BASELINE config 3 proxy)
+# --------------------------------------------------------------------------
+
+def bench_llama(on_tpu: bool, dev):
     import paddle_tpu as paddle
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.jit.api import TrainStep
     from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
                                    LlamaPretrainingCriterion)
-
-    dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
 
     if on_tpu:
         # sized for one v5e chip (16G HBM): ~620M params, bf16 + fp32 master.
@@ -72,14 +103,17 @@ def main():
         cfg = LlamaConfig.tiny()
         batch, seq, steps = 2, 64, 3
 
-    model = LlamaForCausalLM(cfg)
+    try:
+        model = LlamaForCausalLM(cfg)
+    finally:
+        if on_tpu:
+            paddle.set_default_dtype("float32")
     crit = LlamaPretrainingCriterion(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
                                  parameters=model.parameters())
     train = TrainStep(model, lambda logits, labels: crit(logits, labels), opt)
 
-    n_params = sum(
-        int(p._data.size) for p in model.parameters())
+    n_params = sum(int(p._data.size) for p in model.parameters())
     # standard MFU accounting: embeddings are a gather, not a matmul —
     # exclude them from the 6N term (the lm_head matmul stays counted);
     # attention scores add 6*seq*hidden*layers per token (causal-halved
@@ -90,33 +124,525 @@ def main():
         (jnp.arange(batch * seq) % cfg.vocab_size).reshape(batch, seq),
         dtype=jnp.int32))
 
-    loss = train((ids,), (ids,))  # compile + warmup
-    jax.block_until_ready(loss._data)
+    p0 = model.parameters()[-1]
+    sec = _time_steps(lambda: train((ids,), (ids,))._data, steps,
+                      final=lambda: p0._data)
     loss = train((ids,), (ids,))
-    jax.block_until_ready(loss._data)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = train((ids,), (ids,))
-    jax.block_until_ready(loss._data)
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * steps / dt
+    tokens_per_sec = batch * seq / sec
     flops_per_token = (6 * n_matmul
                        + 6 * seq * cfg.hidden_size * cfg.num_hidden_layers)
     mfu = tokens_per_sec * flops_per_token / _peak_flops(dev)
+    return {
+        "mfu": mfu,
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "params": n_params,
+        "batch": batch, "seq": seq,
+        "final_loss": float(loss._data),
+    }
 
+
+# --------------------------------------------------------------------------
+# config 1: ResNet-18 / CIFAR-10 shapes — imgs/s vs native JAX
+# --------------------------------------------------------------------------
+
+def bench_resnet(on_tpu: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.vision.models import resnet18
+    from benchmarks.native_jax import make_resnet18_step
+
+    batch = int(os.environ.get("PTPU_BENCH_RESNET_BATCH",
+                               256 if on_tpu else 8))
+    steps = 10 if on_tpu else 2
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(batch, 3, 32, 32).astype(np.float32)
+    y_np = rng.randint(0, 10, batch).astype(np.int32)
+
+    model = resnet18(num_classes=10)
+    ce = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    train = TrainStep(model, lambda logits, y: ce(logits, y), opt)
+    x, y = Tensor(jnp.asarray(x_np)), Tensor(jnp.asarray(y_np))
+    ours = _time_steps(lambda: train((x,), (y,))._data, steps,
+                       final=lambda: model.fc.weight._data)
+
+    nstep, nstate = make_resnet18_step(batch)
+    xj, yj = jnp.asarray(x_np), jnp.asarray(y_np)
+    state = [nstate]
+
+    def native():
+        state[0], loss = nstep(state[0], xj, yj)
+        return loss
+
+    native_t = _time_steps(native, steps,
+                           final=lambda: state[0][0]["fc_w"])
+    return {
+        "metric": "resnet18_cifar_imgs_per_sec",
+        "value": round(batch / ours, 1),
+        "unit": "imgs/sec",
+        "vs_baseline": round(native_t / ours, 4),
+        "detail": {"batch": batch, "our_step_ms": round(ours * 1e3, 3),
+                   "native_jax_step_ms": round(native_t * 1e3, 3),
+                   "baseline": "hand-written JAX resnet18 train step"},
+    }
+
+
+# --------------------------------------------------------------------------
+# config 2: BERT-base SQuAD shapes — step time vs native JAX
+# --------------------------------------------------------------------------
+
+def bench_bert(on_tpu: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import BertConfig, BertForQuestionAnswering
+    from benchmarks.native_jax import make_bert_step
+
+    if on_tpu:
+        cfg = BertConfig.base()
+        batch, seq, steps = 8, 384, 8
+    else:
+        cfg = BertConfig.tiny()
+        batch, seq, steps = 2, 64, 2
+
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    s_np = rng.randint(0, seq, batch).astype(np.int32)
+    e_np = rng.randint(0, seq, batch).astype(np.int32)
+
+    model = BertForQuestionAnswering(BertConfig(**{**cfg.__dict__}))
+    opt = paddle.optimizer.AdamW(learning_rate=3e-5,
+                                 parameters=model.parameters())
+
+    def qa_loss(start_logits, end_logits, starts, ends):
+        import paddle_tpu.nn.functional as F
+        return (F.cross_entropy(start_logits, starts).mean()
+                + F.cross_entropy(end_logits, ends).mean())
+
+    train = TrainStep(model, qa_loss, opt)
+    ids = Tensor(jnp.asarray(ids_np))
+    st, en = Tensor(jnp.asarray(s_np)), Tensor(jnp.asarray(e_np))
+    ours = _time_steps(lambda: train((ids,), (st, en))._data, steps,
+                       final=lambda: model.classifier.weight._data)
+
+    nstep, nstate = make_bert_step(
+        batch, seq, vocab=cfg.vocab_size, hidden=cfg.hidden_size,
+        layers=cfg.num_hidden_layers, heads=cfg.num_attention_heads,
+        ffn=cfg.intermediate_size, dropout=cfg.hidden_dropout_prob)
+    idsj = jnp.asarray(ids_np)
+    sj, ej = jnp.asarray(s_np), jnp.asarray(e_np)
+    state = [nstate]
+
+    def native():
+        state[0], loss = nstep(state[0], idsj, sj, ej)
+        return loss
+
+    native_t = _time_steps(native, steps,
+                           final=lambda: state[0][0]["qa_w"])
+    return {
+        "metric": "bert_base_squad_step_ms",
+        "value": round(ours * 1e3, 2),
+        "unit": "ms/step",
+        "vs_baseline": round(native_t / ours, 4),
+        "detail": {"batch": batch, "seq": seq,
+                   "native_jax_step_ms": round(native_t * 1e3, 3),
+                   "baseline": "hand-written JAX BERT-base QA train step "
+                               "(SURVEY exit: ratio >= 0.67)"},
+    }
+
+
+# --------------------------------------------------------------------------
+# config 4: PP-OCR rec (CRNN) — conv+BiLSTM step vs native JAX
+# --------------------------------------------------------------------------
+
+def bench_ocr(on_tpu: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models.ocr import CRNN, DBNet
+    from benchmarks.native_jax import make_crnn_step
+
+    batch = int(os.environ.get("PTPU_BENCH_OCR_BATCH", 32 if on_tpu else 2))
+    width = 320 if on_tpu else 64
+    steps = 8 if on_tpu else 2
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(batch, 3, 32, width).astype(np.float32)
+    y_np = rng.randint(0, 97, batch).astype(np.int32)
+
+    model = CRNN(num_classes=97, hidden_size=96)
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=model.parameters())
+
+    def frame_ce(logits, y):
+        # per-frame CE proxy (same loss as the native baseline so the
+        # ratio isolates the conv+BiLSTM+head compute; real CTC training
+        # is covered by tests/test_rnn_ocr.py)
+        import paddle_tpu.nn.functional as F
+        T = logits.shape[0]
+        yt = paddle.broadcast_to(y.unsqueeze(0), [T, y.shape[0]])
+        return F.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]),
+            yt.reshape([-1])).mean()
+
+    train = TrainStep(model, frame_ce, opt)
+    x, y = Tensor(jnp.asarray(x_np)), Tensor(jnp.asarray(y_np))
+    ours = _time_steps(lambda: train((x,), (y,))._data, steps,
+                       final=lambda: model.fc.weight._data)
+
+    nstep, nstate = make_crnn_step(batch, width=width)
+    xj, yj = jnp.asarray(x_np), jnp.asarray(y_np)
+    state = [nstate]
+
+    def native():
+        state[0], loss = nstep(state[0], xj, yj)
+        return loss
+
+    native_t = _time_steps(native, steps,
+                           final=lambda: state[0][0]["fc_w"])
+
+    # det (DBNet) forward step time, recorded for coverage (no native twin)
+    det = DBNet()
+    det_size = 320 if on_tpu else 64
+    dx = Tensor(jnp.asarray(rng.randn(4, 3, det_size, det_size)
+                            .astype(np.float32)))
+    det_t = _time_steps(lambda: det(dx)["maps"]._data,
+                    max(2, steps // 2))
+    return {
+        "metric": "ocr_crnn_rec_step_ms",
+        "value": round(ours * 1e3, 2),
+        "unit": "ms/step",
+        "vs_baseline": round(native_t / ours, 4),
+        "detail": {"batch": batch, "width": width,
+                   "native_jax_step_ms": round(native_t * 1e3, 3),
+                   "det_dbnet_fwd_ms": round(det_t * 1e3, 3),
+                   "baseline": "hand-written JAX CRNN train step"},
+    }
+
+
+# --------------------------------------------------------------------------
+# config 5: MoE — grouped-GEMM Pallas routing vs XLA composite
+# --------------------------------------------------------------------------
+
+def bench_moe(on_tpu: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models.moe import (MoEConfig, MoEForCausalLM,
+                                       MoEPretrainingCriterion)
+
+    if on_tpu:
+        cfg_kw = dict(vocab_size=32000, hidden_size=1024,
+                      intermediate_size=2816, num_hidden_layers=4,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=1024, num_experts=8,
+                      num_experts_per_tok=2, moe_intermediate_size=1408,
+                      num_shared_experts=1, first_k_dense_replace=1,
+                      dtype="bfloat16")
+        batch, seq, steps = 8, 1024, 8
+    else:
+        cfg_kw = dict()
+        batch, seq, steps = 2, 64, 2
+
+    def run(use_pallas: bool):
+        paddle.set_flags({"FLAGS_use_pallas_kernels": use_pallas})
+        cfg = (MoEConfig(**cfg_kw) if cfg_kw else MoEConfig.tiny_moe())
+        if on_tpu:
+            paddle.set_default_dtype("bfloat16")
+        try:
+            model = MoEForCausalLM(cfg)
+        finally:
+            if on_tpu:
+                paddle.set_default_dtype("float32")
+        crit = MoEPretrainingCriterion(cfg, model)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        train = TrainStep(model, lambda lg, lb: crit(lg, lb), opt)
+        ids = Tensor(jnp.asarray(
+            (jnp.arange(batch * seq) % cfg.vocab_size)
+            .reshape(batch, seq).astype(jnp.int32)))
+        p0 = model.parameters()[-1]
+        sec = _time_steps(lambda: train((ids,), (ids,))._data, steps,
+                          final=lambda: p0._data)
+        return sec
+
+    composite = run(False)
+    pallas = run(True)
+    paddle.set_flags({"FLAGS_use_pallas_kernels": True})
+    return {
+        "metric": "moe_ep_tok_per_sec",
+        "value": round(batch * seq / pallas, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(composite / pallas, 4),
+        "detail": {"batch": batch, "seq": seq,
+                   "pallas_step_ms": round(pallas * 1e3, 3),
+                   "xla_composite_step_ms": round(composite * 1e3, 3),
+                   "baseline": "same model, XLA-composite grouped matmul"},
+    }
+
+
+# --------------------------------------------------------------------------
+# kernel micro-benches: paged attention + grouped GEMM, Pallas vs composite
+# --------------------------------------------------------------------------
+
+def bench_micro(on_tpu: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.kernels.serving import paged_attention_kernel
+    from paddle_tpu.ops.kernels.pallas.grouped_gemm import grouped_matmul
+
+    out = []
+    rng = np.random.RandomState(0)
+
+    # paged attention: serving decode shapes
+    if on_tpu:
+        B, H, KV, D, NB, BS, MB = 32, 32, 8, 128, 512, 64, 16
+    else:
+        B, H, KV, D, NB, BS, MB = 4, 8, 4, 64, 16, 16, 4
+    q = jnp.asarray(rng.randn(B, 1, H, D), jnp.bfloat16)
+    kp = jnp.asarray(rng.randn(NB, BS, KV, D), jnp.bfloat16)
+    vp = jnp.asarray(rng.randn(NB, BS, KV, D), jnp.bfloat16)
+    tbl = jnp.asarray(rng.randint(0, NB, (B, MB)), jnp.int32)
+    lens = jnp.asarray(rng.randint(BS, MB * BS, B), jnp.int32)
+
+    def run_paged(use_pallas):
+        paddle.set_flags({"FLAGS_use_pallas_kernels": use_pallas})
+        fn = jax.jit(lambda *a: paged_attention_kernel(*a))
+        return _time_steps(fn, 20, q, kp, vp, tbl, lens)
+
+    comp = run_paged(False)
+    pall = run_paged(True)
+    paddle.set_flags({"FLAGS_use_pallas_kernels": True})
+    out.append({
+        "metric": "paged_attention_us",
+        "value": round(pall * 1e6, 1),
+        "unit": "us/call",
+        "vs_baseline": round(comp / pall, 4),
+        "detail": {"shape": f"B{B} H{H} KV{KV} D{D} blocks{NB}x{BS}",
+                   "xla_composite_us": round(comp * 1e6, 1),
+                   "baseline": "XLA gather+SDPA composite"},
+    })
+
+    # ring-attention block: flash_block vs the XLA composite block at SEP
+    # shard shapes — fwd+bwd, measuring the (s/P)^2 HBM round-trip the
+    # Pallas path removes (VERDICT r2 Next#4 evidence)
+    from paddle_tpu.ops.kernels.pallas.flash_attention import flash_block
+    from paddle_tpu.ops.kernels.pallas.ring_attention import _block_attn
+
+    if on_tpu:
+        rb, rsl, rh, rd = 2, 2048, 16, 128     # one ring shard at seq 16k/8
+    else:
+        rb, rsl, rh, rd = 1, 256, 4, 64
+    qr = jnp.asarray(rng.randn(rb * rh, rsl, rd), jnp.bfloat16)
+    kr = jnp.asarray(rng.randn(rb * rh, rsl, rd), jnp.bfloat16)
+    vr = jnp.asarray(rng.randn(rb * rh, rsl, rd), jnp.bfloat16)
+    q4 = jnp.asarray(rng.randn(rb, rsl, rh, rd), jnp.bfloat16)
+    k4 = jnp.asarray(rng.randn(rb, rsl, rh, rd), jnp.bfloat16)
+    v4 = jnp.asarray(rng.randn(rb, rsl, rh, rd), jnp.bfloat16)
+
+    @jax.jit
+    def pallas_block_step(q_, k_, v_):
+        def f(a, b_, c):
+            o, lse = flash_block(a, b_, c, True, rd ** -0.5)
+            return (o.astype(jnp.float32) ** 2).sum() + (lse ** 2).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
+
+    @jax.jit
+    def xla_block_step(q_, k_, v_):
+        def f(a, b_, c):
+            o, lse = _block_attn(a, b_, c, 0, 0, rsl, True, rd ** -0.5)
+            return (o ** 2).sum() + (lse ** 2).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
+
+    pall = _time_steps(pallas_block_step, 10, qr, kr, vr)
+    comp = _time_steps(xla_block_step, 10, q4, k4, v4)
+    out.append({
+        "metric": "ring_block_attention_us",
+        "value": round(pall * 1e6, 1),
+        "unit": "us/fwd+bwd",
+        "vs_baseline": round(comp / pall, 4),
+        "detail": {"shape": f"bh{rb * rh} sl{rsl} d{rd} causal",
+                   "xla_composite_us": round(comp * 1e6, 1),
+                   "baseline": "XLA einsum+logsumexp ring block "
+                               "(fwd+bwd, same shard shape)"},
+    })
+
+    # grouped GEMM: MoE expert shapes [E, C, K] @ [E, K, N]
+    if on_tpu:
+        E, C, K, N = 8, 2048, 1024, 2816
+    else:
+        E, C, K, N = 4, 64, 32, 64
+    xg = jnp.asarray(rng.randn(E, C, K), jnp.bfloat16)
+    wg = jnp.asarray(rng.randn(E, K, N), jnp.bfloat16)
+    counts = jnp.asarray(rng.randint(C // 2, C, E), jnp.int32)
+
+    def run_gmm(use_pallas):
+        fn = jax.jit(lambda x_, w_, c_: grouped_matmul(
+            x_, w_, c_, 1, use_pallas))
+        return _time_steps(fn, 20, xg, wg, counts)
+
+    comp = run_gmm(False)
+    pall = run_gmm(True)
+    out.append({
+        "metric": "grouped_gemm_us",
+        "value": round(pall * 1e6, 1),
+        "unit": "us/call",
+        "vs_baseline": round(comp / pall, 4),
+        "detail": {"shape": f"E{E} C{C} K{K} N{N} (ragged counts)",
+                   "xla_composite_us": round(comp * 1e6, 1),
+                   "baseline": "XLA composite grouped matmul"},
+    })
+    return out
+
+
+# --------------------------------------------------------------------------
+# eager dispatch overhead (VERDICT r2 Next#3)
+# --------------------------------------------------------------------------
+
+def bench_dispatch(on_tpu: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+
+    x = Tensor(jnp.asarray(np.ones((8, 8), np.float32)))
+    chain = 50
+
+    def eager_chain():
+        y = x
+        for _ in range(chain):
+            y = y * 1.0001 + 0.0
+        return y._data
+
+    jax.block_until_ready(eager_chain())  # warm per-op exec caches
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        out = eager_chain()
+    jax.block_until_ready(out)
+    eager_us_per_op = (time.perf_counter() - t0) / (reps * chain * 2) * 1e6
+
+    xj = jnp.ones((8, 8), jnp.float32)
+
+    @jax.jit
+    def jit_chain(v):
+        for _ in range(chain):
+            v = v * 1.0001 + 0.0
+        return v
+
+    jax.block_until_ready(jit_chain(xj))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jit_chain(xj)
+    jax.block_until_ready(out)
+    jit_us_per_op = (time.perf_counter() - t0) / (reps * chain * 2) * 1e6
+
+    # autograd tape variant: the full hot path incl. GradNode recording
+    xg = Tensor(jnp.asarray(np.ones((8, 8), np.float32)))
+    xg.stop_gradient = False
+
+    def eager_grad_chain():
+        y = xg
+        for _ in range(chain):
+            y = y * 1.0001 + 0.0
+        return y._data
+
+    jax.block_until_ready(eager_grad_chain())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = eager_grad_chain()
+    jax.block_until_ready(out)
+    tape_us_per_op = (time.perf_counter() - t0) / (reps * chain * 2) * 1e6
+
+    # isolate the FRAMEWORK's Python overhead from the device-launch
+    # latency: call the SAME cached per-op jitted executable directly in a
+    # loop (launch only, no dispatcher) — overhead = eager - direct.
+    # On tunneled devices (axon) the launch term dominates both numbers.
+    from paddle_tpu.ops.dispatcher import _get_exec
+    fwd, _ = _get_exec("multiply", (), (1, 1), (False, False), 0, True)
+    c = jnp.float32(1.0001)
+    a = x._data
+    jax.block_until_ready(fwd(a, c)[0])
+    t0 = time.perf_counter()
+    a2 = a
+    for _ in range(reps * chain):
+        a2 = fwd(a2, c)[0]
+    jax.block_until_ready(a2)
+    direct_us = (time.perf_counter() - t0) / (reps * chain) * 1e6
+    overhead = eager_us_per_op - direct_us
+
+    return {
+        "metric": "eager_dispatch_overhead_us_per_op",
+        "value": round(overhead, 2),
+        "unit": "us/op",
+        # VERDICT r2 Next#3 waiver criterion: Python dispatch must stay
+        # within ~2x of the reference's C++ per-op budget (~5us); ratio
+        # >= 1.0 here means overhead <= 10us and the C++ fast path is
+        # waived on numbers. On tunneled devices launch latency dominates
+        # and the subtraction can go ~0/negative; clamp to [0.1us, ...]
+        "vs_baseline": round(min(10.0 / max(overhead, 0.1), 100.0), 4),
+        "detail": {
+            "eager_us_per_op": round(eager_us_per_op, 2),
+            "direct_executable_launch_us": round(direct_us, 2),
+            "jit_us_per_op": round(jit_us_per_op, 2),
+            "eager_with_tape_us_per_op": round(tape_us_per_op, 2),
+            "note": "overhead = eager - direct launch of the same cached "
+                    "executable: schema bind + exec-cache hit + Tensor "
+                    "wrap [+ GradNode record]; reference keeps this "
+                    "micro-benchmark in C++ "
+                    "(test/cpp/eager/performance_tests/)",
+        },
+    }
+
+
+def main():
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    which = os.environ.get(
+        "PTPU_BENCH_CONFIGS", "llama,resnet,bert,ocr,moe,micro,dispatch")
+    which = [w.strip() for w in which.split(",") if w.strip()]
+
+    configs = []
+    errors = {}
+
+    def guard(name, fn, *a):
+        if name not in which:
+            return None
+        try:
+            return fn(*a)
+        except Exception as e:  # record, never break the headline line
+            errors[name] = f"{type(e).__name__}: {e}"
+            return None
+
+    llama = guard("llama", bench_llama, on_tpu, dev)
+    for name, fn in (("resnet", bench_resnet), ("bert", bench_bert),
+                     ("ocr", bench_ocr), ("moe", bench_moe)):
+        r = guard(name, fn, on_tpu)
+        if r:
+            configs.append(r)
+    micro = guard("micro", bench_micro, on_tpu)
+    if micro:
+        configs.extend(micro)
+    disp = guard("dispatch", bench_dispatch, on_tpu)
+    if disp:
+        configs.append(disp)
+
+    mfu = llama["mfu"] if llama else 0.0
     print(json.dumps({
         "metric": "llama_pretrain_mfu_1chip",
         "value": round(mfu, 4),
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.40, 4),
         "detail": {
-            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
-            "params": n_params,
+            **({k: v for k, v in llama.items() if k != "mfu"}
+               if llama else {}),
             "device": getattr(dev, "device_kind", str(dev)),
-            "batch": batch, "seq": seq,
-            "final_loss": float(loss._data),
             # BASELINE's headline is Llama-3-8B on v5p-64; one v5e chip
             # (16G HBM) cannot hold 8B + fp32 master, so this measures a
             # same-architecture proxy sized for the chip. vs_baseline
@@ -125,6 +651,8 @@ def main():
             "model": "llama-arch proxy sized for one chip "
                      "(headline model: Llama-3-8B)",
             "baseline_hw": "v5p-64 (BASELINE) vs this device",
+            "configs": configs,
+            **({"errors": errors} if errors else {}),
         },
     }))
 

@@ -321,7 +321,13 @@ class TrainStep:
             return _pin_sh(x, sh if pin_active else None)
 
         def step(accum, param_arrays, master_arrays, opt_states, buffer_arrays,
-                 frozen_arrays, rng, inputs, labels, lr, stepno):
+                 frozen_arrays, key, inputs, labels, lr, stepno):
+            # rng/step live ON DEVICE and chain through the donated state:
+            # shipping a fresh host scalar per call costs a full host->device
+            # round trip (tens of ms on tunneled devices) and serialises the
+            # step stream
+            key, rng = jax.random.split(key)
+            stepno = stepno + 1
             (loss, new_buf), grads = grad_fn(param_arrays, frozen_arrays,
                                              buffer_arrays, rng, inputs, labels)
             if n_accum > 1:
@@ -345,10 +351,14 @@ class TrainStep:
                     new_params.append(_pin(np_, psh))
                 new_states.append(ns_)
             return (tuple(new_params), tuple(new_masters), tuple(new_states),
-                    new_buf, loss)
+                    new_buf, loss, key, stepno)
 
-        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 6, 10))
         self._params, self._buffers, self._frozen = params, buffers, frozen
+        # device-resident step chain state (re-seeded on rebuild/resume)
+        self._dev_key = generator.next_key()
+        self._dev_step = jnp.asarray(self._step, jnp.int32)
+        self._lr_cache = (None, None)
 
     def __call__(self, inputs, labels):
         loss = self._call_impl(inputs, labels)
@@ -381,6 +391,11 @@ class TrainStep:
             self._compiled = None   # sharding reconfigured: stale pins
         if self._compiled is None:
             self._build()
+        if opt._step_count != self._step:
+            # optimizer state was loaded/reset externally: re-sync the
+            # device-resident step counter (one transfer)
+            self._step = opt._step_count
+            self._dev_step = jnp.asarray(self._step, jnp.int32)
         params, buffers = self._params, self._buffers
         to_arr = lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t)
         inputs = jax.tree.map(to_arr, inputs,
@@ -406,15 +421,19 @@ class TrainStep:
 
         self._step += 1
         opt._step_count = self._step
-        new_p, new_m, new_s, new_buf, loss = self._compiled(
-            self._accum if self.grad_accum > 1 else (),
-            tuple(p._data for p in params),
-            tuple(opt._masters[i] for i in range(len(params))),
-            tuple(opt._states[i] for i in range(len(params))),
-            tuple(b._data for b in buffers),
-            tuple(f._data for f in self._frozen),
-            generator.next_key(), inputs, labels,
-            jnp.asarray(opt.get_lr(), jnp.float32), self._step)
+        lr_val = float(opt.get_lr())
+        if self._lr_cache[0] != lr_val:  # one transfer per lr CHANGE
+            self._lr_cache = (lr_val, jnp.asarray(lr_val, jnp.float32))
+        new_p, new_m, new_s, new_buf, loss, self._dev_key, self._dev_step = \
+            self._compiled(
+                self._accum if self.grad_accum > 1 else (),
+                tuple(p._data for p in params),
+                tuple(opt._masters[i] for i in range(len(params))),
+                tuple(opt._states[i] for i in range(len(params))),
+                tuple(b._data for b in buffers),
+                tuple(f._data for f in self._frozen),
+                self._dev_key, inputs, labels,
+                self._lr_cache[1], self._dev_step)
         for i, p in enumerate(params):
             p._set_data(new_p[i])
             opt._masters[i] = new_m[i]

@@ -231,7 +231,11 @@ class Layer:
                         f"shape mismatch for '{k}': {tuple(arr.shape)} vs "
                         f"expected {tuple(target._data.shape)}")
                 import jax.numpy as jnp
-                target._set_data(jnp.asarray(arr, dtype=target._data.dtype))
+                # COPY the value in (paddle copy-on-load semantics): an
+                # alias would be invalidated when the source model's next
+                # compiled TrainStep donates its param buffers
+                target._set_data(jnp.array(arr, dtype=target._data.dtype,
+                                           copy=True))
             else:
                 unexpected.append(k)
         for k in own:

@@ -269,6 +269,27 @@ def _dispatch(schema: OpSchema, arguments: Dict[str, Any]):
     return _dispatch_impl(schema, arguments)
 
 
+_CONST_CACHE: Dict = {}
+
+
+def _const_tensor(v) -> Tensor:
+    """Python-scalar operand -> cached device constant. Eager chains like
+    `y * 1.0001 + 0.0` otherwise pay a full jnp.asarray primitive bind
+    (~70us host time) per op for the same scalar, dominating dispatch."""
+    # repr distinguishes -0.0 from 0.0 (equal under ==) and collapses all
+    # NaNs onto one entry (NaN != NaN would leak a fresh entry per call)
+    key = (type(v), repr(v), dtype_mod.get_default_dtype())
+    hit = _CONST_CACHE.get(key)
+    if hit is None:
+        if len(_CONST_CACHE) > 4096:  # unbounded distinct scalars guard
+            _CONST_CACHE.clear()
+        hit = Tensor(v)
+        if isinstance(hit._data, jax.core.Tracer):
+            return hit  # under jit tracing: caching would leak the tracer
+        _CONST_CACHE[key] = hit
+    return hit
+
+
 def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
     primals: List[jax.Array] = []
     in_tensors: List[Optional[Tensor]] = []
@@ -282,7 +303,8 @@ def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
                 present.append(0)
                 continue
             if not isinstance(v, Tensor):
-                v = Tensor(v)
+                v = (_const_tensor(v) if type(v) in (int, float, bool)
+                     else Tensor(v))
             present.append(1)
             primals.append(v._data)
             in_tensors.append(v)
@@ -511,15 +533,19 @@ def _attach_inplace_ops():
 
 def _attach_dunders():
     def binop(op_name, reflect=False):
-        fn = _OP_FNS[op_name]
+        # fast path: skip inspect.Signature.bind (~15us/op) — dunders are
+        # the hottest eager call sites and their two operands are always
+        # the schema's first two params
+        schema = OPS[op_name]
+        n0, n1 = schema.params[0].name, schema.params[1].name
         if not reflect:
             def dunder(self, other):
                 if other is NotImplemented:
                     return NotImplemented
-                return fn(self, other)
+                return _dispatch(schema, {n0: self, n1: other})
         else:
             def dunder(self, other):
-                return fn(Tensor(other) if not isinstance(other, Tensor) else other, self)
+                return _dispatch(schema, {n0: other, n1: self})
         return dunder
 
     T = Tensor

@@ -22,10 +22,22 @@ the merge is a no-op (wasted flops, not wrong results; zigzag load
 balancing is a later optimisation).
 
 Backward is jax AD through the rotation scan: ppermute transposes to the
-reverse rotation, which IS the ring-attention backward pass. The per-block
-math is plain XLA (einsum + logsumexp) so the whole thing differentiates;
-swapping the block kernel for the Pallas flash kernel is a planned
-optimisation that needs a custom block-vjp.
+reverse rotation, which IS the ring-attention backward pass.
+
+Per-block math has TWO implementations, selected by shard shape
+(`_pallas_block_supported`):
+  - `_ring_local_pallas` (s/P >= 128, block-aligned): each block runs the
+    Pallas flash kernel via `flash_block` — a custom_vjp whose lse output
+    is differentiable (the merge weights consume it; its cotangent folds
+    into the backward delta term, flash_attention.py:242-249) — so BOTH
+    forward and backward are flash-style: no (s/P)^2 score matrix ever
+    round-trips HBM. Ring position picks the mask branch statically
+    (full / diagonal-causal / masked) via lax.switch.
+  - `_ring_local` (small/unaligned shards, CPU tests): plain XLA einsum +
+    logsumexp blocks, differentiated by AD.
+Parity for values and all three grads: tests/test_pallas_and_pp.py
+(TestRingAttention); block-level perf vs the XLA composite: bench.py
+`ring_block_attention` micro-bench.
 """
 
 from __future__ import annotations

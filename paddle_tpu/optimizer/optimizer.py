@@ -167,8 +167,15 @@ class Optimizer:
 
     # -- checkpointing -------------------------------------------------------
     def state_dict(self) -> Dict:
-        out = {"step": self._step_count, "states": self._states,
-               "masters": self._masters}
+        # COPIES, not references: the compiled TrainStep donates optimizer
+        # state buffers, so a live reference here would be invalidated by
+        # the very next step ("Array has been deleted" on restore)
+        def cp(x):
+            return None if x is None else jax.tree.map(jnp.copy, x)
+
+        out = {"step": self._step_count,
+               "states": [cp(s) for s in self._states],
+               "masters": [cp(m) for m in self._masters]}
         if isinstance(self._lr, LRScheduler):
             out["lr"] = self._lr.state_dict()
         return out
