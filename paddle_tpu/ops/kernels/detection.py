@@ -1,0 +1,583 @@
+"""Detection-model op tranche (VERDICT r2 Missing#4 / Next#7).
+
+Reference counterparts (semantics mirrored, implementations TPU-first):
+  yolo_box        paddle/phi/kernels/cpu/yolo_box_kernel.cc +
+                  funcs/yolo_box_util.h:26-96 (decode formulas)
+  yolo_loss       paddle/phi/kernels/cpu/yolo_loss_kernel.cc (target
+                  assignment, ignore mask, loss terms)
+  deformable_conv paddle/phi/kernels/cpu/deformable_conv_kernel.cc (v2
+                  modulated bilinear sampling)
+  psroi_pool      paddle/phi/kernels/cpu/psroi_pool_kernel.cc
+  multiclass_nms3 paddle/phi/kernels/cpu/multiclass_nms3_kernel.cc
+  matrix_nms      paddle/phi/kernels/cpu/matrix_nms_kernel.cc (SOLOv2
+                  parallel decay NMS)
+  generate_proposals        paddle/phi/kernels/cpu/generate_proposals_kernel.cc
+  distribute_fpn_proposals  paddle/phi/kernels/cpu/
+                            distribute_fpn_proposals_kernel.cc
+
+Dense decode/sampling/loss ops are vectorised jnp (static shapes, jit- and
+AD-friendly, MXU/VPU execution). Selection ops with data-dependent output
+sizes (the NMS family, proposal generation, FPN distribution) are host-side
+numpy at `jit: false`, the same host-sync stance the reference takes by
+running them on CPU for most pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .nn import register_kernel
+
+
+# ---------------------------------------------------------------------------
+# yolo_box — dense decode, fully vectorised
+# ---------------------------------------------------------------------------
+
+@register_kernel("yolo_box")
+def yolo_box_kernel(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+                    downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+                    iou_aware=False, iou_aware_factor=0.5):
+    """x [n, an*(5+C)(+an if iou_aware), h, w]; img_size [n, 2] (h, w) int.
+    Returns boxes [n, an*h*w, 4] (x1 y1 x2 y2 in image pixels) and scores
+    [n, an*h*w, C]; predictions below conf_thresh are zeroed (static-shape
+    analog of the reference's skip)."""
+    anchors = tuple(int(a) for a in anchors)
+    an_num = len(anchors) // 2
+    n, _, h, w = x.shape
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+    in_h, in_w = downsample_ratio * h, downsample_ratio * w
+
+    if iou_aware:
+        iou_pred = jax.nn.sigmoid(x[:, :an_num].astype(jnp.float32))
+        x = x[:, an_num:]
+    x = x.reshape(n, an_num, 5 + class_num, h, w).astype(jnp.float32)
+
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    gx = jax.lax.broadcasted_iota(jnp.float32, (h, w), 1)
+    gy = jax.lax.broadcasted_iota(jnp.float32, (h, w), 0)
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+
+    cx = (gx + jax.nn.sigmoid(x[:, :, 0]) * scale + bias) * img_w / w
+    cy = (gy + jax.nn.sigmoid(x[:, :, 1]) * scale + bias) * img_h / h
+    bw = jnp.exp(x[:, :, 2]) * aw * img_w / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah * img_h / in_h
+
+    x1, y1 = cx - bw / 2, cy - bh / 2
+    x2, y2 = cx + bw / 2, cy + bh / 2
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, None)
+        y1 = jnp.clip(y1, 0, None)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1.0 - iou_aware_factor) * \
+            iou_pred ** float(iou_aware_factor)
+    keep = conf >= conf_thresh
+
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)          # [n, an, h, w, 4]
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    cls = jax.nn.sigmoid(x[:, :, 5:])                     # [n, an, C, h, w]
+    scores = jnp.moveaxis(cls, 2, -1) * conf[..., None]
+    scores = jnp.where(keep[..., None], scores, 0.0)
+    return (boxes.reshape(n, an_num * h * w, 4),
+            scores.reshape(n, an_num * h * w, class_num))
+
+
+# ---------------------------------------------------------------------------
+# yolo_loss — vectorised target assignment + loss terms
+# ---------------------------------------------------------------------------
+
+def _sigmoid_ce(x, label):
+    # numerically-stable BCE-with-logits (reference SigmoidCrossEntropy)
+    return jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _iou_cwh(b1, b2):
+    """IoU of boxes given as (cx, cy, w, h), broadcasting leading dims."""
+    lo = jnp.maximum(b1[..., :2] - b1[..., 2:] / 2,
+                     b2[..., :2] - b2[..., 2:] / 2)
+    hi = jnp.minimum(b1[..., :2] + b1[..., 2:] / 2,
+                     b2[..., :2] + b2[..., 2:] / 2)
+    wh = hi - lo
+    inter = jnp.where((wh[..., 0] < 0) | (wh[..., 1] < 0), 0.0,
+                      wh[..., 0] * wh[..., 1])
+    union = (b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter)
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register_kernel("yolo_loss")
+def yolo_loss_kernel(x, gt_box, gt_label, gt_score=None, anchors=(),
+                     anchor_mask=(), class_num=1, ignore_thresh=0.7,
+                     downsample_ratio=32, use_label_smooth=True,
+                     scale_x_y=1.0):
+    """x [n, M*(5+C), h, w]; gt_box [n, B, 4] normalised (cx cy w h);
+    gt_label [n, B] int; gt_score [n, B] (mixup weight, default 1).
+    Returns (loss [n], objectness_mask [n, M, h, w], gt_match_mask [n, B]).
+    Mirrors yolo_loss_kernel.cc:249-369 including its square-grid
+    assumption (grid_size = h for both axes in the ignore-pass decode)."""
+    anchors = tuple(int(a) for a in anchors)
+    anchor_mask = tuple(int(a) for a in anchor_mask)
+    an_num = len(anchors) // 2
+    M = len(anchor_mask)
+    n, _, h, w = x.shape
+    B = gt_box.shape[1]
+    input_size = downsample_ratio * h
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    xf = x.reshape(n, M, 5 + class_num, h, w).astype(jnp.float32)
+    gt = gt_box.astype(jnp.float32)
+    if gt_score is None:
+        gscore = jnp.ones((n, B), jnp.float32)
+    else:
+        gscore = gt_score.astype(jnp.float32)
+    valid = (gt[..., 2] > 0) & (gt[..., 3] > 0)          # [n, B]
+
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - sw, sw
+    else:
+        label_pos, label_neg = 1.0, 0.0
+
+    # -- ignore pass: every prediction's best IoU against valid gts --------
+    gx = jax.lax.broadcasted_iota(jnp.float32, (h, w), 1)
+    gy = jax.lax.broadcasted_iota(jnp.float32, (h, w), 0)
+    aw = jnp.asarray([anchors[2 * m] for m in anchor_mask],
+                     jnp.float32)[None, :, None, None]
+    ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask],
+                     jnp.float32)[None, :, None, None]
+    pred = jnp.stack([
+        (gx + jax.nn.sigmoid(xf[:, :, 0]) * scale + bias) / h,
+        (gy + jax.nn.sigmoid(xf[:, :, 1]) * scale + bias) / h,
+        jnp.exp(xf[:, :, 2]) * aw / input_size,
+        jnp.exp(xf[:, :, 3]) * ah / input_size,
+    ], axis=-1)                                          # [n, M, h, w, 4]
+    iou = _iou_cwh(pred[:, :, :, :, None, :],
+                   gt[:, None, None, None, :, :])        # [n, M, h, w, B]
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    best_iou = iou.max(axis=-1)
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+    # -- gt -> best anchor (shape IoU), positive assignment ----------------
+    aw_all = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    ah_all = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    inter = (jnp.minimum(gt[..., 2:3], aw_all[None, None])
+             * jnp.minimum(gt[..., 3:4], ah_all[None, None]))
+    union = (gt[..., 2:3] * gt[..., 3:4]
+             + aw_all[None, None] * ah_all[None, None] - inter)
+    shape_iou = inter / jnp.maximum(union, 1e-10)        # [n, B, an_num]
+    best_n = jnp.argmax(shape_iou, axis=-1)              # [n, B]
+    # mask index of best_n (-1 when the best anchor is not in this head)
+    mask_arr = jnp.asarray(anchor_mask, jnp.int32)
+    eq = best_n[..., None] == mask_arr[None, None, :]
+    mask_idx = jnp.where(eq.any(-1), jnp.argmax(eq, -1), -1)
+    gt_match = jnp.where(valid, mask_idx, -1).astype(jnp.int32)
+
+    gi = jnp.clip((gt[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    pos = valid & (mask_idx >= 0)                        # [n, B]
+
+    # positives overwrite the ignore marks cell-by-cell IN GT ORDER
+    # (reference loop order; duplicate cells -> later gt wins)
+    bidx = jnp.arange(n)
+    for t in range(B):
+        upd = jnp.where(pos[:, t], gscore[:, t],
+                        obj_mask[bidx, jnp.maximum(mask_idx[:, t], 0),
+                                 gj[:, t], gi[:, t]])
+        obj_mask = obj_mask.at[
+            bidx, jnp.maximum(mask_idx[:, t], 0), gj[:, t], gi[:, t]].set(upd)
+
+    # -- location + class losses at positive cells -------------------------
+    m_safe = jnp.maximum(mask_idx, 0)
+    picked = xf[bidx[:, None], m_safe, :, gj, gi]        # [n, B, 5+C]
+    tx = gt[..., 0] * w - gi
+    ty = gt[..., 1] * h - gj
+    tw = jnp.log(jnp.maximum(gt[..., 2], 1e-10) * input_size
+                 / jnp.maximum(aw_all[best_n] * input_size, 1e-10))
+    th = jnp.log(jnp.maximum(gt[..., 3], 1e-10) * input_size
+                 / jnp.maximum(ah_all[best_n] * input_size, 1e-10))
+    loc_scale = (2.0 - gt[..., 2] * gt[..., 3]) * gscore
+    loc = (_sigmoid_ce(picked[..., 0], tx)
+           + _sigmoid_ce(picked[..., 1], ty)
+           + jnp.abs(tw - picked[..., 2])
+           + jnp.abs(th - picked[..., 3])) * loc_scale
+    labels = jax.nn.one_hot(gt_label.astype(jnp.int32), class_num,
+                            dtype=jnp.float32)
+    cls_target = labels * label_pos + (1 - labels) * label_neg
+    cls = (_sigmoid_ce(picked[..., 5:], cls_target).sum(-1)) * gscore
+    pos_loss = jnp.where(pos, loc + cls, 0.0).sum(axis=1)
+
+    # -- objectness loss over the final mask -------------------------------
+    obj_logit = xf[:, :, 4]
+    obj_pos = jnp.where(obj_mask > 1e-5,
+                        _sigmoid_ce(obj_logit, 1.0) * obj_mask, 0.0)
+    obj_neg = jnp.where((obj_mask <= 1e-5) & (obj_mask > -0.5),
+                        _sigmoid_ce(obj_logit, 0.0), 0.0)
+    obj_loss = (obj_pos + obj_neg).sum(axis=(1, 2, 3))
+
+    return pos_loss + obj_loss, obj_mask, gt_match
+
+
+# ---------------------------------------------------------------------------
+# deformable_conv (v2, modulated)
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(img, yy, xx):
+    """img [C, H, W]; yy/xx [...]; zero-padded bilinear sample -> [C, ...]."""
+    H, W = img.shape[-2:]
+    y0 = jnp.floor(yy)
+    x0 = jnp.floor(xx)
+    wy1, wx1 = yy - y0, xx - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yi = y0.astype(jnp.int32) + dy
+            xi = x0.astype(jnp.int32) + dx
+            ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            v = img[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+            out = out + v * (jnp.where(ok, wy * wx, 0.0))[None]
+    return out
+
+
+@register_kernel("deformable_conv")
+def deformable_conv_kernel(x, offset, filter, mask=None, strides=(1, 1),
+                           paddings=(0, 0), dilations=(1, 1),
+                           deformable_groups=1, groups=1, im2col_step=64):
+    """x [N,Cin,H,W]; offset [N, 2*dg*kh*kw, Ho, Wo] ((dy,dx) interleaved);
+    mask [N, dg*kh*kw, Ho, Wo] (v2 modulation; None -> v1);
+    filter [Cout, Cin/g, kh, kw]. Bilinear-sampled im2col + one big matmul
+    (the MXU-friendly layout of the reference's im2col_step batching)."""
+    N, Cin, H, W = x.shape
+    Cout, _, kh, kw = filter.shape
+    dg = deformable_groups
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) \
+        else dilations
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    off = offset.astype(jnp.float32).reshape(N, dg, kh * kw, 2, Ho, Wo)
+    base_y = (jax.lax.broadcasted_iota(jnp.float32, (Ho, Wo), 0) * sh - ph)
+    base_x = (jax.lax.broadcasted_iota(jnp.float32, (Ho, Wo), 1) * sw - pw)
+    ky = jnp.arange(kh, dtype=jnp.float32).repeat(kw) * dh
+    kx = jnp.tile(jnp.arange(kw, dtype=jnp.float32) * dw, kh)
+    yy = base_y[None, None] + ky[None, :, None, None] + off[:, :, :, 0]
+    xx = base_x[None, None] + kx[None, :, None, None] + off[:, :, :, 1]
+    # [N, dg, kh*kw, Ho, Wo]
+
+    xg = x.astype(jnp.float32).reshape(N, dg, Cin // dg, H, W)
+    sample = jax.vmap(jax.vmap(_bilinear_sample))(
+        xg, yy, xx)                                  # [N, dg, C/dg, K, Ho, Wo]
+    if mask is not None:
+        mm = mask.astype(jnp.float32).reshape(N, dg, 1, kh * kw, Ho, Wo)
+        sample = sample * mm
+    cols = sample.reshape(N, Cin, kh * kw, Ho, Wo)
+
+    cpg_in, cpg_out = Cin // groups, Cout // groups
+    cols = cols.reshape(N, groups, cpg_in, kh * kw, Ho, Wo)
+    wg = filter.astype(jnp.float32).reshape(groups, cpg_out, cpg_in, kh, kw)
+    out = jnp.einsum("ngckhw,gock->ngohw",
+                     cols.reshape(N, groups, cpg_in, kh * kw, Ho, Wo),
+                     wg.reshape(groups, cpg_out, cpg_in, kh * kw))
+    return out.reshape(N, Cout, Ho, Wo).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# psroi_pool (position-sensitive ROI average pooling, R-FCN)
+# ---------------------------------------------------------------------------
+
+@register_kernel("psroi_pool")
+def psroi_pool_kernel(x, boxes, boxes_num=None, pooled_height=1,
+                      pooled_width=1, output_channels=1, spatial_scale=1.0):
+    """x [N, C, H, W] with C == output_channels*ph*pw; boxes [R, 4]
+    (x1 y1 x2 y2); boxes_num [N] maps rois to images. Bin (i, j) of output
+    channel c averages input channel c*ph*pw + i*pw + j over the bin."""
+    N, C, H, W = x.shape
+    ph, pw = int(pooled_height), int(pooled_width)
+    R = boxes.shape[0]
+    if boxes_num is None:
+        img_of = jnp.zeros((R,), jnp.int32)
+    else:
+        img_of = jnp.repeat(jnp.arange(N, dtype=jnp.int32),
+                            boxes_num.astype(jnp.int32),
+                            total_repeat_length=R)
+    b = boxes.astype(jnp.float32) * spatial_scale
+    x0 = jnp.round(b[:, 0])
+    y0 = jnp.round(b[:, 1])
+    x1 = jnp.round(b[:, 2]) + 1.0
+    y1 = jnp.round(b[:, 3]) + 1.0
+    rw = jnp.maximum(x1 - x0, 0.1)
+    rh = jnp.maximum(y1 - y0, 0.1)
+    bin_h = rh / ph
+    bin_w = rw / pw
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+    xr = x.astype(jnp.float32).reshape(N, output_channels, ph * pw, H, W)
+
+    def one_roi(img_i, px0, py0, pbh, pbw):
+        # membership weights of every pixel in every bin: [ph, H] x [pw, W]
+        i = jnp.arange(ph, dtype=jnp.float32)[:, None]
+        j = jnp.arange(pw, dtype=jnp.float32)[:, None]
+        hs = jnp.floor(py0 + i * pbh)
+        he = jnp.ceil(py0 + (i + 1) * pbh)
+        wss = jnp.floor(px0 + j * pbw)
+        wse = jnp.ceil(px0 + (j + 1) * pbw)
+        wy = ((ys[None, :] >= jnp.clip(hs, 0, H))
+              & (ys[None, :] < jnp.clip(he, 0, H))).astype(jnp.float32)
+        wx = ((xs[None, :] >= jnp.clip(wss, 0, W))
+              & (xs[None, :] < jnp.clip(wse, 0, W))).astype(jnp.float32)
+        weights = wy[:, None, :, None] * wx[None, :, None, :]  # [ph,pw,H,W]
+        weights = weights.reshape(ph * pw, H, W)
+        cnt = jnp.maximum(weights.sum((-2, -1)), 1e-10)        # [ph*pw]
+        img = xr[img_i]                                        # [oc,ph*pw,H,W]
+        pooled = jnp.einsum("cbhw,bhw->cb", img, weights) / cnt
+        return pooled.reshape(output_channels, ph, pw)
+
+    return jax.vmap(one_roi)(img_of, x0, y0, bin_h, bin_w).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# NMS family + proposals — host-side (data-dependent output sizes)
+# ---------------------------------------------------------------------------
+
+def _np_iou_matrix(b):
+    """b [M, 4] xyxy -> [M, M] IoU (normalized=True convention)."""
+    area = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    lo = np.maximum(b[:, None, :2], b[None, :, :2])
+    hi = np.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(hi - lo, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def _np_iou_row(box, boxes):
+    """IoU of one box [4] against boxes [M, 4] (O(M), not O(M^2))."""
+    area = np.maximum(box[2] - box[0], 0) * np.maximum(box[3] - box[1], 0)
+    areas = (np.maximum(boxes[:, 2] - boxes[:, 0], 0)
+             * np.maximum(boxes[:, 3] - boxes[:, 1], 0))
+    lo = np.maximum(box[None, :2], boxes[:, :2])
+    hi = np.minimum(box[None, 2:], boxes[:, 2:])
+    wh = np.maximum(hi - lo, 0)
+    inter = wh[:, 0] * wh[:, 1]
+    return inter / np.maximum(area + areas - inter, 1e-10)
+
+
+def _np_greedy_nms(boxes, scores, thresh, eta=1.0):
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    adaptive = float(thresh)
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        iou = _np_iou_row(boxes[i], boxes[order[1:]])
+        order = order[1:][iou <= adaptive]
+        if eta < 1.0 and adaptive > 0.5:
+            adaptive *= eta
+    return np.asarray(keep, np.int64)
+
+
+@register_kernel("multiclass_nms3")
+def multiclass_nms3_kernel(bboxes, scores, rois_num=None, score_threshold=0.0,
+                           nms_top_k=-1, keep_top_k=-1, nms_threshold=0.3,
+                           normalized=True, nms_eta=1.0, background_label=0):
+    """bboxes [N, M, 4], scores [N, C, M] -> out [T, 6] (label, score,
+    x1 y1 x2 y2), index [T, 1] (flat box index), nms_rois_num [N]."""
+    bb = np.asarray(bboxes, np.float32)
+    sc = np.asarray(scores, np.float32)
+    N, C, M = sc.shape
+    outs, idxs, nums = [], [], []
+    for n in range(N):
+        dets, det_idx = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s > score_threshold)[0]  # strict, as reference
+            if sel.size == 0:
+                continue
+            if nms_top_k > -1 and sel.size > nms_top_k:
+                sel = sel[np.argsort(-s[sel], kind="stable")[:nms_top_k]]
+            keep = _np_greedy_nms(bb[n, sel], s[sel], nms_threshold, nms_eta)
+            for k in sel[keep]:
+                dets.append([c, s[k], *bb[n, k]])
+                det_idx.append(n * M + k)
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        det_idx = np.asarray(det_idx, np.int64)
+        if keep_top_k > -1 and len(dets) > keep_top_k:
+            top = np.argsort(-dets[:, 1], kind="stable")[:keep_top_k]
+            dets, det_idx = dets[top], det_idx[top]
+        outs.append(dets)
+        idxs.append(det_idx)
+        nums.append(len(dets))
+    out = np.concatenate(outs, 0) if outs else np.zeros((0, 6), np.float32)
+    index = (np.concatenate(idxs, 0) if idxs
+             else np.zeros((0,), np.int64))[:, None]
+    return (jnp.asarray(out), jnp.asarray(index.astype(np.int32)),
+            jnp.asarray(np.asarray(nums, np.int32)))
+
+
+@register_kernel("matrix_nms")
+def matrix_nms_kernel(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                      keep_top_k=-1, post_threshold=0.0, use_gaussian=False,
+                      gaussian_sigma=2.0, background_label=0,
+                      normalized=True):
+    """SOLOv2 matrix NMS: parallel score decay instead of sequential
+    suppression. Same I/O contract as multiclass_nms3."""
+    bb = np.asarray(bboxes, np.float32)
+    sc = np.asarray(scores, np.float32)
+    N, C, M = sc.shape
+    outs, idxs, nums = [], [], []
+    for n in range(N):
+        dets, det_idx = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = np.argsort(-s[sel], kind="stable")
+            if nms_top_k > -1:
+                order = order[:nms_top_k]
+            sel = sel[order]
+            ss = s[sel]
+            iou = np.triu(_np_iou_matrix(bb[n, sel]), 1)   # iou[i,j], i<j
+            # max_iou[k]: box k's own max IoU with its higher-scored
+            # predecessors; the decay of target j by suppressor i is
+            # compensated by the SUPPRESSOR's max_iou (matrix_nms_kernel.cc
+            # :139-147: decay_fn(iou_ij, iou_max[j<i], sigma))
+            max_iou = np.max(iou, axis=0, initial=0.0)
+            if use_gaussian:
+                decay = np.exp((max_iou[:, None] ** 2 - iou ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - max_iou[:, None], 1e-10)
+            # only rows with HIGHER score (i<j in sorted order) decay col j
+            upper = np.triu(np.ones_like(iou), 1) > 0
+            ds = ss * np.where(upper, decay, 1.0).min(
+                axis=0, initial=1.0, where=upper)
+            keep = ds > post_threshold
+            for k, d in zip(sel[keep], ds[keep]):
+                dets.append([c, d, *bb[n, k]])
+                det_idx.append(n * M + k)
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        det_idx = np.asarray(det_idx, np.int64)
+        if keep_top_k > -1 and len(dets) > keep_top_k:
+            top = np.argsort(-dets[:, 1], kind="stable")[:keep_top_k]
+            dets, det_idx = dets[top], det_idx[top]
+        outs.append(dets)
+        idxs.append(det_idx)
+        nums.append(len(dets))
+    out = np.concatenate(outs, 0) if outs else np.zeros((0, 6), np.float32)
+    index = (np.concatenate(idxs, 0) if idxs
+             else np.zeros((0,), np.int64))[:, None]
+    return (jnp.asarray(out), jnp.asarray(index.astype(np.int32)),
+            jnp.asarray(np.asarray(nums, np.int32)))
+
+
+@register_kernel("generate_proposals")
+def generate_proposals_kernel(scores, bbox_deltas, im_shape, anchors,
+                              variances, pre_nms_top_n=6000,
+                              post_nms_top_n=1000, nms_thresh=0.5,
+                              min_size=0.1, eta=1.0, pixel_offset=True):
+    """RPN proposal generation (Faster R-CNN). scores [N, A, H, W];
+    bbox_deltas [N, A*4, H, W]; anchors/variances [H, W, A, 4] (or
+    [H*W*A, 4]); im_shape [N, 2]. Returns rois [T, 4], roi_probs [T, 1],
+    rois_num [N]."""
+    sc = np.asarray(scores, np.float32)
+    dl = np.asarray(bbox_deltas, np.float32)
+    im = np.asarray(im_shape, np.float32)
+    an = np.asarray(anchors, np.float32).reshape(-1, 4)
+    va = np.asarray(variances, np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    rois, probs, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)            # H, W, A order
+        d = dl[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")
+        if pre_nms_top_n > 0:
+            order = order[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        # paddle box_coder decode_center_size with variances
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + 0.5 * aw
+        acy = a[:, 1] + 0.5 * ah
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000. / 16.))) * aw
+        bh = np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000. / 16.))) * ah
+        box = np.stack([cx - bw / 2, cy - bh / 2,
+                        cx + bw / 2 - off, cy + bh / 2 - off], 1)
+        box[:, 0::2] = np.clip(box[:, 0::2], 0, im[n, 1] - off)
+        box[:, 1::2] = np.clip(box[:, 1::2], 0, im[n, 0] - off)
+        ws = box[:, 2] - box[:, 0] + off
+        hs = box[:, 3] - box[:, 1] + off
+        ok = (ws >= min_size) & (hs >= min_size)
+        box, s = box[ok], s[ok]
+        keep = _np_greedy_nms(box, s, nms_thresh, eta)
+        if post_nms_top_n > 0:
+            keep = keep[:post_nms_top_n]
+        rois.append(box[keep])
+        probs.append(s[keep, None])
+        nums.append(len(keep))
+    rois = np.concatenate(rois, 0) if rois else np.zeros((0, 4), np.float32)
+    probs = np.concatenate(probs, 0) if probs else np.zeros((0, 1),
+                                                            np.float32)
+    return (jnp.asarray(rois), jnp.asarray(probs),
+            jnp.asarray(np.asarray(nums, np.int32)))
+
+
+@register_kernel("distribute_fpn_proposals")
+def distribute_fpn_proposals_kernel(fpn_rois, rois_num=None, min_level=2,
+                                    max_level=5, refer_level=4,
+                                    refer_scale=224, pixel_offset=True):
+    """FPN level assignment: level = floor(refer_level +
+    log2(sqrt(area) / refer_scale)), clamped to [min, max]. Returns
+    (per-level roi lists, per-level rois_num lists, restore_index)."""
+    rois = np.asarray(fpn_rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    R = rois.shape[0]
+    if rois_num is not None:
+        rn = np.asarray(rois_num, np.int64)
+        img_of = np.repeat(np.arange(len(rn)), rn)
+        n_imgs = len(rn)
+    else:
+        img_of = np.zeros((R,), np.int64)
+        n_imgs = 1
+    w = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    h = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    multi_rois, multi_nums, order = [], [], []
+    for level in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == level)[0]
+        multi_rois.append(jnp.asarray(rois[sel]))
+        counts = np.bincount(img_of[sel], minlength=n_imgs)
+        multi_nums.append(jnp.asarray(counts.astype(np.int32)))
+        order.append(sel)
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore = np.empty((R,), np.int64)
+    restore[order] = np.arange(R)
+    # flat output tuple (L rois, L nums, restore) — the functional wrapper
+    # (vision.ops.distribute_fpn_proposals) regroups into the reference's
+    # (Tensor[], Tensor[], Tensor) structure
+    return (*multi_rois, *multi_nums,
+            jnp.asarray(restore.astype(np.int32)[:, None]))

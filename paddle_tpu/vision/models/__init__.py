@@ -14,6 +14,7 @@ from .mobilenet import (  # noqa: F401
     MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small, mobilenet_v3_large,
 )
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .yolov3 import YOLOv3, yolov3_darknet53  # noqa: F401
 from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201,
     densenet264,
