@@ -2,6 +2,7 @@
 
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from .layer_base import Layer, Parameter  # noqa: F401
 from .layers_common import (  # noqa: F401
     Identity, Linear, Embedding, Conv1D, Conv2D, Conv2DTranspose,

@@ -1,0 +1,98 @@
+"""Weight-only quantized GEMM — the int8/int4 serving matmul.
+
+Reference counterpart: `paddle/phi/kernels/gpu/weight_only_linear_kernel.cu`
+(cutlass fpA_intB dequant-in-kernel GEMM). TPU-first design: int8 weights
+feed the MXU THROUGH the matmul's operand convert — per-channel scales
+commute out of the dot entirely:
+
+    x @ (q * s[None, :])  ==  (x @ q) * s[None, :]
+
+so the weight is read from HBM as int8 (half the bf16 bytes) and the
+convert fuses into the MXU feed; the scale lands on the tiny [m, n]
+output. Measured on v5e (m16 k4096 n11008): parity with the bf16 matmul
+at half the weight footprint — the HBM savings convert to capacity (a 2x
+bigger model per chip), and to bandwidth wherever the weight stream is
+the bound. A hand Pallas tile kernel was tried and REJECTED: int8 vector
+loads repack against the (32, 128) native int8 tiling and ran ~100x
+slower than this formulation (see round-3 history).
+
+Per-group scales cannot commute out; that path dequantizes group-wise
+and materialises a bf16 weight (one extra HBM round trip, still int8 at
+rest). int4 unpacks nibbles first (int4-at-rest, int8 in flight).
+
+Layout (ours, documented divergence from the reference's opaque cutlass
+layout): quantized weight [k, n] int8 (int4: [k//2, n], two nibbles per
+byte, row 2i in low bits); scales f32 [n] per-channel or [k//gs, n]
+per-group.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _unpack_int4(qweight, n):
+    """[k//2, n] packed bytes -> [k, n] int8 nibble values (sign-extended)."""
+    w32 = qweight.astype(jnp.int32)
+    lo = jnp.right_shift(jnp.left_shift(w32, 28), 28)
+    hi = jnp.right_shift(w32, 4)                 # arithmetic: sign kept
+    return (jnp.stack([lo, hi], axis=1)
+            .reshape(qweight.shape[0] * 2, n).astype(jnp.int8))
+
+
+def dequantize(qweight, scales, int4: bool, n: int):
+    """Quantized weight -> f32 [k, n]; group size derives from scales' row
+    count (scales [n] -> per-channel, [k//gs, n] -> per-group)."""
+    w = _unpack_int4(qweight, n) if int4 else qweight
+    w = w.astype(jnp.float32)
+    k = w.shape[0]
+    sc = scales.astype(jnp.float32)
+    if sc.ndim == 1 or sc.shape[0] == 1:
+        return w * sc.reshape(1, n)
+    groups = sc.shape[0]
+    gs = k // groups
+    return (w.reshape(groups, gs, n) * sc[:, None, :]).reshape(k, n)
+
+
+def weight_only_matmul(x, qweight, scales, weight_dtype: str = "int8",
+                       group_size: int = -1):
+    """x [m, k] (f32/bf16) @ dequant(qweight) -> [m, n]."""
+    int4 = weight_dtype == "int4"
+    m, k = x.shape
+    n = qweight.shape[1]
+    per_channel = scales.ndim == 1 or scales.shape[0] == 1
+    q = _unpack_int4(qweight, n) if int4 else qweight
+    if per_channel:
+        sc = scales.reshape(n).astype(jnp.float32)
+        acc = jnp.dot(x.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+        return (acc * sc[None, :]).astype(x.dtype)
+    # per-group: scales do not commute; dequantize group-wise then dot
+    w = dequantize(q, scales, False, n).astype(jnp.bfloat16)
+    return jnp.dot(x.astype(jnp.bfloat16), w,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def quantize(w, weight_dtype: str = "int8", group_size: int = -1):
+    """f32/bf16 weight [k, n] -> (qweight, scales) in OUR layout (module
+    docstring). Symmetric per-channel (group_size=-1) or per-group."""
+    int4 = weight_dtype == "int4"
+    k, n = w.shape
+    bound = 7.0 if int4 else 127.0
+    wf = w.astype(jnp.float32)
+    if group_size > 0:
+        groups = k // group_size
+        wg = wf.reshape(groups, group_size, n)
+        scales = jnp.max(jnp.abs(wg), axis=1) / bound        # [groups, n]
+        q = jnp.round(wg / jnp.maximum(scales[:, None, :], 1e-10))
+        q = q.reshape(k, n)
+    else:
+        scales = jnp.max(jnp.abs(wf), axis=0) / bound        # [n]
+        q = jnp.round(wf / jnp.maximum(scales[None, :], 1e-10))
+    q = jnp.clip(q, -bound, bound).astype(jnp.int8)
+    if int4:
+        lo = q[0::2] & 0xF
+        hi = q[1::2] & 0xF
+        q = (jnp.left_shift(hi, 4) | lo).astype(jnp.int8)    # [k//2, n]
+    return q, scales.astype(jnp.float32)
