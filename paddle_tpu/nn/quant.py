@@ -50,13 +50,23 @@ class WeightOnlyLinear(Layer):
                  weight_dtype: str = "int8", group_size: int = -1,
                  bias=None):
         super().__init__()
+        import jax.numpy as jnp
         self.in_features = in_features
         self.out_features = out_features
         self.weight_dtype = weight_dtype
         self.group_size = group_size
         self.bias = bias
-        # qweight/weight_scale become buffers via set_quantized; no None
-        # placeholders (a plain instance attr would shadow the buffer)
+        # zero-initialised buffers with the derived shapes so a freshly
+        # constructed skeleton can LOAD a saved quantized checkpoint
+        # (set_state_dict copies into registered buffers only)
+        k = in_features // 2 if weight_dtype == "int4" else in_features
+        srows = (in_features // group_size) if group_size > 0 else None
+        self.register_buffer(
+            "qweight", jnp.zeros((k, out_features), jnp.int8))
+        self.register_buffer(
+            "weight_scale",
+            jnp.zeros((srows, out_features) if srows else (out_features,),
+                      jnp.float32))
 
     @staticmethod
     def from_linear(lin: Linear, weight_dtype: str = "int8",

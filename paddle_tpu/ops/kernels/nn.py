@@ -722,3 +722,15 @@ def flash_attention(query, key, value, attn_mask=None, rng_key=None,
     return scaled_dot_product_attention(query, key, value, attn_mask=attn_mask,
                                         rng_key=rng_key, dropout_p=dropout_p,
                                         is_causal=is_causal, scale=scale)
+
+
+@register_kernel("flash_attn_unpadded")
+def flash_attn_unpadded_kernel(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q=0, max_seqlen_k=0, scale=0.0,
+                               causal=False):
+    """Packed varlen flash attention (reference flash_attn_kernel.cu:199).
+    Pallas fwd+bwd with segment-id masks + per-block skip
+    (pallas/flash_varlen.py); runs in interpret mode off-TPU."""
+    from .pallas.flash_varlen import flash_attn_unpadded as fa
+    return fa(q, k, v, cu_seqlens_q, cu_seqlens_k,
+              scale=None if scale in (0.0, None) else scale, causal=causal)

@@ -55,8 +55,8 @@ def supported(q_shape, k_shape, causal: bool) -> bool:
     sk, hk = k_shape[1], k_shape[2]
     if hq % hk != 0:
         return False
-    if causal and sq != sk:
-        return False  # decode path goes through the paged kernel instead
+    if causal and sq > sk:
+        return False  # more queries than keys has no right-aligned offset
     return (_block(sq, 512) is not None and _block(sk, 512) is not None
             and sq >= 128 and sk >= 128)
 
@@ -66,7 +66,8 @@ def supported(q_shape, k_shape, causal: bool) -> bool:
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk):
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk,
+                coff=0):
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -76,7 +77,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # causal: kv block is live iff its first column <= last q row
-    run = (ik * bk <= iq * bq + bq - 1) if causal else True
+    # (+ the right-alignment offset coff = sk - sq when sq != sk)
+    run = (ik * bk <= iq * bq + bq - 1 + coff) if causal else True
 
     @pl.when(run)
     def _():
@@ -87,7 +89,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
-            s = jnp.where(cols <= rows, s, _NEG_INF)
+            s = jnp.where(cols <= rows + coff, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]                      # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -121,7 +123,7 @@ def _fwd(q, k, v, causal, scale):
     grid = (bh, nq, nk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, coff=sk - sq),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -151,7 +153,7 @@ def _fwd(q, k, v, causal, scale):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
-               acc_scr, *, scale, causal, bq, bk, nk):
+               acc_scr, *, scale, causal, bq, bk, nk, coff=0):
     """Transposed orientation: scores live as s^T [bk, bq] so the per-q-row
     lse/delta [1, bq] broadcast along lanes with no relayouts."""
     iq, ik = pl.program_id(1), pl.program_id(2)
@@ -160,7 +162,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
     def _():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = (ik * bk <= iq * bq + bq - 1) if causal else True
+    run = (ik * bk <= iq * bq + bq - 1 + coff) if causal else True
 
     @pl.when(run)
     def _():
@@ -172,7 +174,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
         if causal:
             kpos = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + ik * bk
             qpos = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + iq * bq
-            st = jnp.where(kpos <= qpos, st, _NEG_INF)
+            st = jnp.where(kpos <= qpos + coff, st, _NEG_INF)
         pt = jnp.exp(st - lse_ref[0])                 # [bk, bq]
         v = v_ref[0].astype(jnp.float32)
         dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
@@ -189,7 +191,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, bq, bk, nq, nqg):
+                *, scale, causal, bq, bk, nq, nqg, coff=0):
     """Transposed orientation (see _dq_kernel): dk = ds^T q, dv = p^T do fall
     out directly from the [bk, bq] score layout."""
     ik, iqg = pl.program_id(1), pl.program_id(2)
@@ -200,7 +202,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (iq * bq + bq - 1 >= ik * bk) if causal else True
+    run = (iq * bq + bq - 1 + coff >= ik * bk) if causal else True
 
     @pl.when(run)
     def _():
@@ -212,7 +214,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         if causal:
             kpos = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + ik * bk
             qpos = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + iq * bq
-            st = jnp.where(kpos <= qpos, st, _NEG_INF)
+            st = jnp.where(kpos <= qpos + coff, st, _NEG_INF)
         pt = jnp.exp(st - lse_ref[0])                 # [bk, bq]
         v = v_ref[0].astype(jnp.float32)
         dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
@@ -250,7 +252,7 @@ def _bwd(causal, scale, res, dout, dlse=None):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, coff=sk - sq),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -269,7 +271,7 @@ def _bwd(causal, scale, res, dout, dlse=None):
     nqg = nq * g
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, nqg=nqg),
+                          bq=bq, bk=bk, nq=nq, nqg=nqg, coff=sk - sq),
         grid=(bh_kv, nk, nqg),
         in_specs=[
             pl.BlockSpec((1, bq, d),

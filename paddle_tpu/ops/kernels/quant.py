@@ -118,9 +118,13 @@ def llm_int8_linear_kernel(x, weight, bias=None, weight_scale=None,
                               preferred_element_type=jnp.int32)
     reg = acc.astype(jnp.float32) * row_scale[:, None] * sc[None, :]
     # outlier path in float against dequantized rows
-    # per-column scale commutes: (x_out @ wf) * sc avoids a k*n scaled
-    # weight temp at serving shapes
-    out = reg + (x_out @ wf) * sc[None, :]
+    # outlier term gated: the common no-outlier batch pays only the int8
+    # GEMM (per-column scale commutes, so no k*n scaled-weight temp either)
+    out = reg + jax.lax.cond(
+        jnp.any(outlier),
+        lambda xo: (xo @ wf) * sc[None, :],
+        lambda xo: jnp.zeros((xo.shape[0], wf.shape[1]), jnp.float32),
+        x_out)
     if bias is not None:
         out = out + bias.astype(jnp.float32)
     return out.astype(x.dtype).reshape(*lead, out.shape[-1])

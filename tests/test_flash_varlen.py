@@ -1,0 +1,118 @@
+"""Varlen flash attention (VERDICT r2 Missing#3 / Next#6) + causal sq!=sk."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.dispatcher import call_op
+
+
+def _ref_varlen(q, k, v, cu, h, hk, causal):
+    outs = []
+    d = q.shape[-1]
+    for i in range(len(cu) - 1):
+        s0, s1 = int(cu[i]), int(cu[i + 1])
+        qs, ks, vs = q[s0:s1], k[s0:s1], v[s0:s1]
+        kk = jnp.repeat(ks, h // hk, axis=1)
+        vv = jnp.repeat(vs, h // hk, axis=1)
+        logits = jnp.einsum("qhd,khd->hqk", qs, kk) * (d ** -0.5)
+        if causal:
+            n = qs.shape[0]
+            m = jnp.tril(jnp.ones((n, n), bool))
+            logits = jnp.where(m[None], logits, -jnp.inf)
+        p = jax.nn.softmax(logits, -1)
+        outs.append(jnp.einsum("hqk,khd->qhd", p, vv))
+    return jnp.concatenate(outs, 0)
+
+
+class TestFlashVarlen:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_parity_vs_per_sequence_reference(self, causal):
+        rng = np.random.RandomState(0)
+        lens = [37, 91, 128, 60]
+        T = sum(lens)
+        h, hk, d = 4, 2, 32
+        cu = np.cumsum([0] + lens).astype(np.int32)
+        q = rng.randn(T, h, d).astype(np.float32) * 0.3
+        k = rng.randn(T, hk, d).astype(np.float32) * 0.3
+        v = rng.randn(T, hk, d).astype(np.float32) * 0.3
+        out = call_op("flash_attn_unpadded", paddle.to_tensor(q),
+                      paddle.to_tensor(k), paddle.to_tensor(v),
+                      paddle.to_tensor(cu), paddle.to_tensor(cu),
+                      causal=causal)
+        ref = _ref_varlen(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          cu, h, hk, causal)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), atol=2e-5)
+
+    def test_gradients_parity(self):
+        from paddle_tpu.ops.kernels.pallas.flash_varlen import (
+            flash_attn_unpadded)
+        rng = np.random.RandomState(1)
+        lens = [50, 78]
+        T = sum(lens)
+        h, hk, d = 2, 2, 16
+        cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+        q = jnp.asarray(rng.randn(T, h, d) * 0.3, jnp.float32)
+        k = jnp.asarray(rng.randn(T, hk, d) * 0.3, jnp.float32)
+        v = jnp.asarray(rng.randn(T, hk, d) * 0.3, jnp.float32)
+        g = jax.grad(lambda a, b, c: (flash_attn_unpadded(
+            a, b, c, cu, cu, causal=True) ** 2).sum(), argnums=(0, 1, 2))(
+                q, k, v)
+        gr = jax.grad(lambda a, b, c: (_ref_varlen(
+            a, b, c, np.asarray(cu), h, hk, True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
+    def test_no_cross_sequence_leakage(self):
+        """Changing sequence 2's keys must not change sequence 1's output."""
+        from paddle_tpu.ops.kernels.pallas.flash_varlen import (
+            flash_attn_unpadded)
+        rng = np.random.RandomState(2)
+        lens = [64, 64]
+        cu = jnp.asarray([0, 64, 128], jnp.int32)
+        q = jnp.asarray(rng.randn(128, 2, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(128, 2, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(128, 2, 16), jnp.float32)
+        o1 = flash_attn_unpadded(q, k, v, cu, cu)
+        k2 = k.at[64:].set(999.0)
+        v2 = v.at[64:].set(-999.0)
+        o2 = flash_attn_unpadded(q, k2, v2, cu, cu)
+        np.testing.assert_allclose(np.asarray(o1[:64]), np.asarray(o2[:64]),
+                                   rtol=1e-6)
+        assert not np.allclose(np.asarray(o1[64:]), np.asarray(o2[64:]))
+
+
+class TestCausalCrossLength:
+    def test_padded_flash_causal_sq_ne_sk(self):
+        """supported() no longer rejects causal sq != sk (VERDICT Next#6):
+        right-aligned offset semantics vs the composite."""
+        from paddle_tpu.ops.kernels.pallas.flash_attention import (
+            flash_attention, supported)
+        from paddle_tpu.ops.kernels.nn import scaled_dot_product_attention
+        rng = np.random.RandomState(3)
+        b, sq, sk, h, d = 1, 128, 384, 4, 32
+        assert supported((b, sq, h, d), (b, sk, h, d), True)
+        q = jnp.asarray(rng.randn(b, sq, h, d) * 0.3, jnp.float32)
+        k = jnp.asarray(rng.randn(b, sk, h, d) * 0.3, jnp.float32)
+        v = jnp.asarray(rng.randn(b, sk, h, d) * 0.3, jnp.float32)
+        out = flash_attention(q, k, v, causal=True)
+        ref = scaled_dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        g = jax.grad(lambda a, b_, c: (flash_attention(
+            a, b_, c, causal=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b_, c: (scaled_dot_product_attention(
+            a, b_, c, is_causal=True) ** 2).sum(), argnums=(0, 1, 2))(
+                q, k, v)
+        for a, b_ in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=5e-5)
+
+    def test_more_queries_than_keys_still_falls_back(self):
+        from paddle_tpu.ops.kernels.pallas.flash_attention import supported
+        assert not supported((1, 384, 4, 32), (1, 128, 4, 32), True)
