@@ -77,6 +77,7 @@ from . import amp  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
 from . import models  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework import save, load  # noqa: E402,F401
 from . import metric  # noqa: E402,F401

@@ -1,0 +1,56 @@
+"""paddle.geometric — graph-learning API surface.
+
+Reference: `python/paddle/geometric/` (message_passing/, sampling/,
+reindex.py) over the send_u_recv/send_ue_recv/send_uv kernel family
+(paddle/phi/kernels/gpu/send_u_recv_kernel.cu et al.).
+"""
+
+from ..ops.dispatcher import call_op
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "sample_neighbors",
+           "weighted_sample_neighbors", "reindex_graph"]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    out, _ = call_op("send_u_recv", x, src_index, dst_index,
+                     reduce_op=reduce_op.upper(),
+                     out_size=0 if out_size is None else int(out_size))
+    return out
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    out, _ = call_op("send_ue_recv", x, y, src_index, dst_index,
+                     message_op=message_op.upper(),
+                     reduce_op=reduce_op.upper(),
+                     out_size=0 if out_size is None else int(out_size))
+    return out
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    return call_op("send_uv", x, y, src_index, dst_index,
+                   message_op=message_op.upper())
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    out, cnt, oe = call_op("graph_sample_neighbors", row, colptr,
+                           input_nodes, eids, perm_buffer,
+                           sample_size=sample_size, return_eids=return_eids)
+    return (out, cnt, oe) if return_eids else (out, cnt)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    out, cnt, oe = call_op("weighted_sample_neighbors", row, colptr,
+                           edge_weight, input_nodes, eids,
+                           sample_size=sample_size, return_eids=return_eids)
+    return (out, cnt, oe) if return_eids else (out, cnt)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    return call_op("reindex_graph", x, neighbors, count, value_buffer,
+                   index_buffer)

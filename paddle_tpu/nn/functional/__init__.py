@@ -132,3 +132,17 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     if reduction == "sum":
         return _call_op("sum", loss)
     return loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """reference python/paddle/nn/functional/loss.py:1968 (warp-transducer);
+    here the AD-differentiable lattice scan (ops/kernels/graph.py).
+    input: [B, Tmax, Umax, D] logits; label [B, Umax-1] int."""
+    loss = _call_op("rnnt_loss", input, label, input_lengths, label_lengths,
+                    blank=blank, fastemit_lambda=fastemit_lambda)
+    if reduction == "mean":
+        return _call_op("mean", loss)
+    if reduction == "sum":
+        return _call_op("sum", loss)
+    return loss

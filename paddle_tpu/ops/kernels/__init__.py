@@ -17,6 +17,7 @@ from . import rnn  # noqa: F401
 from . import serving  # noqa: F401
 from . import math_ext  # noqa: F401
 from . import detection  # noqa: F401
+from . import graph  # noqa: F401
 from . import moe  # noqa: F401
 from . import extra_math  # noqa: F401
 from . import extra_nn  # noqa: F401

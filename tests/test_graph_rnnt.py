@@ -1,0 +1,242 @@
+"""Graph-learning op family + RNN-T loss (VERDICT r2 Missing#5 / #8)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.dispatcher import call_op
+
+
+class TestMessagePassing:
+    def _graph(self):
+        # edges: 0->1, 0->2, 1->2, 2->0, 2->2
+        src = np.array([0, 0, 1, 2, 2], np.int32)
+        dst = np.array([1, 2, 2, 0, 2], np.int32)
+        x = np.arange(12, dtype=np.float32).reshape(3, 4) + 1
+        return x, src, dst
+
+    def test_send_u_recv_reduces(self):
+        x, src, dst = self._graph()
+        for op, ref in (
+            ("SUM", np.stack([x[2], x[0], x[0] + x[1] + x[2]])),
+            ("MEAN", np.stack([x[2], x[0], (x[0] + x[1] + x[2]) / 3])),
+            ("MAX", np.stack([x[2], x[0],
+                              np.maximum(np.maximum(x[0], x[1]), x[2])])),
+            ("MIN", np.stack([x[2], x[0],
+                              np.minimum(np.minimum(x[0], x[1]), x[2])])),
+        ):
+            out, cnt = call_op("send_u_recv", paddle.to_tensor(x),
+                               paddle.to_tensor(src), paddle.to_tensor(dst),
+                               reduce_op=op, out_size=3)
+            np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6,
+                                       err_msg=op)
+        np.testing.assert_array_equal(cnt.numpy(), [1, 1, 3])
+
+    def test_send_u_recv_grad(self):
+        x, src, dst = self._graph()
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        out, _ = call_op("send_u_recv", xt, paddle.to_tensor(src),
+                         paddle.to_tensor(dst), reduce_op="SUM", out_size=3)
+        out.sum().backward()
+        # grad[v] = out-degree of v
+        deg = np.array([2.0, 1.0, 2.0])[:, None] * np.ones((1, 4))
+        np.testing.assert_allclose(xt.grad.numpy(), deg)
+
+    def test_send_ue_recv_and_send_uv(self):
+        x, src, dst = self._graph()
+        ew = np.arange(1, 6, dtype=np.float32)
+        out, _ = call_op("send_ue_recv", paddle.to_tensor(x),
+                         paddle.to_tensor(ew), paddle.to_tensor(src),
+                         paddle.to_tensor(dst), message_op="MUL",
+                         reduce_op="SUM", out_size=3)
+        ref = np.stack([x[2] * 4, x[0] * 1, x[0] * 2 + x[1] * 3 + x[2] * 5])
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+        uv = call_op("send_uv", paddle.to_tensor(x), paddle.to_tensor(x * 2),
+                     paddle.to_tensor(src), paddle.to_tensor(dst),
+                     message_op="ADD")
+        np.testing.assert_allclose(uv.numpy(), x[src] + 2 * x[dst],
+                                   rtol=1e-6)
+
+    def test_geometric_api(self):
+        import paddle_tpu.geometric as G
+        x, src, dst = self._graph()
+        out = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                            paddle.to_tensor(dst), reduce_op="sum",
+                            out_size=3)
+        assert out.shape == [3, 4]
+
+
+class TestSampling:
+    def _csc(self):
+        # in-neighbors: node0 <- {1, 2}, node1 <- {0}, node2 <- {0, 1, 2}
+        row = np.array([1, 2, 0, 0, 1, 2], np.int32)
+        colptr = np.array([0, 2, 3, 6], np.int32)
+        return row, colptr
+
+    def test_sample_all_and_counts(self):
+        row, colptr = self._csc()
+        out, cnt, _ = call_op("graph_sample_neighbors",
+                              paddle.to_tensor(row),
+                              paddle.to_tensor(colptr),
+                              paddle.to_tensor(np.array([0, 2], np.int32)),
+                              sample_size=-1)
+        np.testing.assert_array_equal(cnt.numpy(), [2, 3])
+        assert sorted(out.numpy()[:2].tolist()) == [1, 2]
+        assert sorted(out.numpy()[2:].tolist()) == [0, 1, 2]
+
+    def test_sample_size_bounds(self):
+        row, colptr = self._csc()
+        out, cnt, _ = call_op("graph_sample_neighbors",
+                              paddle.to_tensor(row),
+                              paddle.to_tensor(colptr),
+                              paddle.to_tensor(np.array([2], np.int32)),
+                              sample_size=2)
+        assert cnt.numpy()[0] == 2
+        assert set(out.numpy().tolist()) <= {0, 1, 2}
+
+    def test_weighted_sampling_biases_heavy_edges(self):
+        row, colptr = self._csc()
+        w = np.array([1, 1, 1, 1000.0, 1, 1], np.float32)
+        hits = 0
+        for _ in range(20):
+            out, cnt, _ = call_op(
+                "weighted_sample_neighbors", paddle.to_tensor(row),
+                paddle.to_tensor(colptr), paddle.to_tensor(w),
+                paddle.to_tensor(np.array([2], np.int32)), sample_size=1)
+            hits += int(out.numpy()[0] == 0)   # edge 3 (weight 1000) -> row 0
+        assert hits >= 15
+
+    def test_reindex_graph(self):
+        x = np.array([10, 20], np.int32)
+        neighbors = np.array([30, 10, 20, 40], np.int32)
+        count = np.array([2, 2], np.int32)
+        src, dst, nodes = call_op("reindex_graph", paddle.to_tensor(x),
+                                  paddle.to_tensor(neighbors),
+                                  paddle.to_tensor(count))
+        assert nodes.numpy().tolist() == [10, 20, 30, 40]
+        np.testing.assert_array_equal(src.numpy(), [2, 0, 1, 3])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1])
+
+
+class TestRnntLoss:
+    @staticmethod
+    def _ref(logits, labels, T, U_lab, blank=0):
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        U = U_lab + 1
+        alpha = np.full((T, U), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(T):
+            for u in range(U):
+                if t == 0 and u == 0:
+                    continue
+                c = []
+                if t > 0:
+                    c.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+                if u > 0:
+                    c.append(alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]])
+                alpha[t, u] = np.logaddexp.reduce(c)
+        return -(alpha[T - 1, U - 1] + lp[T - 1, U - 1, blank])
+
+    def test_parity_vs_numpy_dp(self):
+        rng = np.random.RandomState(0)
+        B, Tm, Um, V = 3, 6, 4, 5
+        logits = rng.randn(B, Tm, Um, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, Um - 1)).astype(np.int32)
+        tl = np.array([6, 5, 4], np.int32)
+        ul = np.array([3, 2, 1], np.int32)
+        loss = call_op("rnnt_loss", paddle.to_tensor(logits),
+                       paddle.to_tensor(labels), paddle.to_tensor(tl),
+                       paddle.to_tensor(ul))
+        ref = [self._ref(logits[b], labels[b], tl[b], ul[b])
+               for b in range(B)]
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_gradients_finite_difference(self):
+        rng = np.random.RandomState(1)
+        B, Tm, Um, V = 1, 5, 3, 4
+        logits = rng.randn(B, Tm, Um, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, Um - 1)).astype(np.int32)
+        tl = np.array([5], np.int32)
+        ul = np.array([2], np.int32)
+        x = paddle.to_tensor(logits, stop_gradient=False)
+        loss = call_op("rnnt_loss", x, paddle.to_tensor(labels),
+                       paddle.to_tensor(tl), paddle.to_tensor(ul))
+        loss.sum().backward()
+        g = x.grad.numpy()
+        eps = 1e-3
+        for i in [(0, 2, 1, 3), (0, 0, 0, 0), (0, 4, 2, 0)]:
+            lp = logits.copy(); lp[i] += eps
+            lm = logits.copy(); lm[i] -= eps
+            fd = (self._ref(lp[0], labels[0], 5, 2)
+                  - self._ref(lm[0], labels[0], 5, 2)) / (2 * eps)
+            np.testing.assert_allclose(g[i], fd, atol=2e-3)
+
+    def test_functional_reduction_and_blank(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(2)
+        logits = rng.randn(2, 4, 3, 6).astype(np.float32)
+        labels = rng.randint(0, 5, (2, 2)).astype(np.int32)
+        tl = np.array([4, 3], np.int32)
+        ul = np.array([2, 1], np.int32)
+        ln = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         paddle.to_tensor(tl), paddle.to_tensor(ul),
+                         blank=5, fastemit_lambda=0.0, reduction="none")
+        ref = [self._ref(logits[b], labels[b], tl[b], ul[b], blank=5)
+               for b in range(2)]
+        np.testing.assert_allclose(ln.numpy(), ref, rtol=1e-5)
+        lm = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         paddle.to_tensor(tl), paddle.to_tensor(ul),
+                         blank=5, fastemit_lambda=0.0)
+        np.testing.assert_allclose(float(lm.numpy()), np.mean(ref),
+                                   rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_fastemit_value_unchanged_grads_scaled(self):
+        """warp-transducer semantics: lambda changes GRADIENTS of emit
+        arcs only; the loss value stays the plain NLL."""
+        rng = np.random.RandomState(3)
+        logits = rng.randn(1, 4, 3, 5).astype(np.float32)
+        labels = rng.randint(1, 5, (1, 2)).astype(np.int32)
+        tl = np.array([4], np.int32)
+        ul = np.array([2], np.int32)
+
+        def run(lam):
+            x = paddle.to_tensor(logits, stop_gradient=False)
+            loss = call_op("rnnt_loss", x, paddle.to_tensor(labels),
+                           paddle.to_tensor(tl), paddle.to_tensor(ul),
+                           fastemit_lambda=lam)
+            loss.sum().backward()
+            return float(loss.numpy()[0]), x.grad.numpy()
+
+        l0, g0 = run(0.0)
+        l1, g1 = run(0.5)
+        assert abs(l0 - l1) < 1e-6          # value identical
+        assert np.abs(g1 - g0).max() > 1e-5  # gradients differ
+
+    def test_sampler_eids_required(self):
+        row = paddle.to_tensor(np.array([1, 0], np.int32))
+        colptr = paddle.to_tensor(np.array([0, 1, 2], np.int32))
+        with pytest.raises(ValueError, match="eids"):
+            call_op("graph_sample_neighbors", row, colptr,
+                    paddle.to_tensor(np.array([0], np.int32)),
+                    return_eids=True)
+
+    def test_sampler_preserves_id_dtype(self):
+        row = paddle.to_tensor(np.array([1, 0], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        out, cnt, _ = call_op("graph_sample_neighbors", row, colptr,
+                              paddle.to_tensor(np.array([0], np.int64)))
+        # int64 ids survive (x64 may downcast to int32 in-process, but the
+        # kernel must not force int32 on its own)
+        assert out.numpy().dtype == row.numpy().dtype
+
+    def test_send_u_recv_int_features_exact(self):
+        x = paddle.to_tensor((np.arange(3, dtype=np.int32) + 2 ** 25
+                              ).reshape(3, 1))
+        src = paddle.to_tensor(np.array([0, 1, 2], np.int32))
+        dst = paddle.to_tensor(np.array([0, 0, 0], np.int32))
+        out, _ = call_op("send_u_recv", x, src, dst, reduce_op="SUM",
+                         out_size=1)
+        # 3 * 2^25 + 3 is not f32-representable; int accumulation must be
+        assert int(out.numpy()[0, 0]) == 3 * 2 ** 25 + 3
