@@ -79,22 +79,28 @@ def bench_llama(on_tpu: bool, dev):
 
     if on_tpu:
         # sized for one v5e chip (16G HBM): ~620M params, bf16 + fp32 master.
-        # Wide layers (hidden 3072) keep the MXU tiled efficiently — measured
-        # sweep on v5e: hidden 1024/12L -> 38.6% MFU, 2048/8L -> 43.6%,
-        # 2560/6L -> 46.6%, 3072/5L/b6 -> 49.1%, 3072/4L/b8 -> 50.4%
-        # (seq 2048, no remat; b10 regresses to 47.5%, larger configs OOM
-        # the 16G HBM). recompute off: activations fit once attention runs
-        # through the Pallas flash kernel (no [b,h,s,s] materialisation).
+        # Round-3 measured sweep on v5e (seq 2048, no remat, fused CE):
+        #   head_dim 64 (h/64 heads): b8 50.4% MFU
+        #   head_dim 128 (h/128 heads, the Llama-3 geometry): b6 61.4%,
+        #   b8 61.3%, b10 56.3%, b12 53.3%; 5L b6 58.8%, 6L b4 59.5%
+        # head_dim 128 fills the full MXU contraction depth in the flash
+        # kernels (d=64 ran them at ~10% efficiency - profiled); larger
+        # batches/layers lose to HBM pressure. recompute off: activations
+        # fit once attention runs through the Pallas flash kernel and the
+        # criterion uses the bf16-resident fused CE.
         hidden = int(os.environ.get("PTPU_BENCH_HIDDEN", 3072))
         layers = int(os.environ.get("PTPU_BENCH_LAYERS", 4))
-        heads = int(os.environ.get("PTPU_BENCH_HEADS", hidden // 64))
+        heads = int(os.environ.get("PTPU_BENCH_HEADS", hidden // 128))
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=hidden,
             intermediate_size=int(os.environ.get("PTPU_BENCH_FFN",
                                                  int(hidden * 2.75))),
             num_hidden_layers=layers, num_attention_heads=heads,
             num_key_value_heads=heads // 2, max_position_embeddings=2048,
-            dtype="bfloat16", recompute=False)
+            dtype="bfloat16",
+            recompute={"0": False, "1": True}.get(
+                os.environ.get("PTPU_RECOMPUTE", "0"),
+                os.environ.get("PTPU_RECOMPUTE")))
         batch = int(os.environ.get("PTPU_BENCH_BATCH", 8))
         seq = int(os.environ.get("PTPU_BENCH_SEQ", 2048))
         steps = int(os.environ.get("PTPU_BENCH_STEPS", 10))
@@ -388,6 +394,42 @@ def bench_moe(on_tpu: bool):
     }
 
 
+
+def _time_chained_once(fn, steps, args, feed, out=None):
+    if out is None:
+        out = fn(*args)
+        jax.block_until_ready(out)
+    a = feed(out, args)
+    out = fn(*a)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        a = feed(out, a)
+        out = fn(*a)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def _paired_ratio(fn_a, args_a, feed_a, fn_b, args_b, feed_b, steps=10,
+                  trials=7):
+    """(seconds_a, ratio b/a) with the two variants timed back-to-back in
+    every trial: tunnel launch latency drifts in waves, so unpaired trials
+    bias whichever variant hits the slow window. Median-of-paired-ratios
+    cancels the drift; value is min over trials. Feeds must create true
+    data dependencies XLA cannot fold (scale by 1e-30, not 0)."""
+    out_a = fn_a(*args_a)
+    out_b = fn_b(*args_b)
+    jax.block_until_ready((out_a, out_b))
+    ratios, best_a = [], None
+    for _ in range(trials):
+        ta = _time_chained_once(fn_a, steps, args_a, feed_a, out_a)
+        tb = _time_chained_once(fn_b, steps, args_b, feed_b, out_b)
+        ratios.append(tb / ta)
+        best_a = ta if best_a is None else min(best_a, ta)
+    ratios.sort()
+    return best_a, ratios[len(ratios) // 2]
+
+
 # --------------------------------------------------------------------------
 # kernel micro-benches: paged attention + grouped GEMM, Pallas vs composite
 # --------------------------------------------------------------------------
@@ -401,9 +443,10 @@ def bench_micro(on_tpu: bool):
     out = []
     rng = np.random.RandomState(0)
 
-    # paged attention: serving decode shapes
+    # paged attention: serving decode shapes (large enough that device
+    # time dominates the ~15us tunnel launch)
     if on_tpu:
-        B, H, KV, D, NB, BS, MB = 32, 32, 8, 128, 512, 64, 16
+        B, H, KV, D, NB, BS, MB = 64, 32, 8, 128, 1024, 64, 32
     else:
         B, H, KV, D, NB, BS, MB = 4, 8, 4, 64, 16, 16, 4
     q = jnp.asarray(rng.randn(B, 1, H, D), jnp.bfloat16)
@@ -412,22 +455,25 @@ def bench_micro(on_tpu: bool):
     tbl = jnp.asarray(rng.randint(0, NB, (B, MB)), jnp.int32)
     lens = jnp.asarray(rng.randint(BS, MB * BS, B), jnp.int32)
 
-    def run_paged(use_pallas):
-        paddle.set_flags({"FLAGS_use_pallas_kernels": use_pallas})
-        fn = jax.jit(lambda *a: paged_attention_kernel(*a))
-        return _time_steps(fn, 20, q, kp, vp, tbl, lens)
+    def paged_fn(use_pallas):
+        def f(*a):
+            paddle.set_flags({"FLAGS_use_pallas_kernels": use_pallas})
+            return paged_attention_kernel(*a)
+        return jax.jit(f)
 
-    comp = run_paged(False)
-    pall = run_paged(True)
+    feed_q = lambda o, a: (o.astype(a[0].dtype),) + a[1:]
+    pall, ratio = _paired_ratio(
+        paged_fn(True), (q, kp, vp, tbl, lens), feed_q,
+        paged_fn(False), (q, kp, vp, tbl, lens), feed_q)
     paddle.set_flags({"FLAGS_use_pallas_kernels": True})
     out.append({
         "metric": "paged_attention_us",
         "value": round(pall * 1e6, 1),
         "unit": "us/call",
-        "vs_baseline": round(comp / pall, 4),
+        "vs_baseline": round(ratio, 4),
         "detail": {"shape": f"B{B} H{H} KV{KV} D{D} blocks{NB}x{BS}",
-                   "xla_composite_us": round(comp * 1e6, 1),
-                   "baseline": "XLA gather+SDPA composite"},
+                   "baseline": "XLA gather+SDPA composite "
+                               "(median paired ratio)"},
     })
 
     # ring-attention block: flash_block vs the XLA composite block at SEP
@@ -461,17 +507,17 @@ def bench_micro(on_tpu: bool):
             return (o ** 2).sum() + (lse ** 2).sum()
         return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
 
-    pall = _time_steps(pallas_block_step, 10, qr, kr, vr)
-    comp = _time_steps(xla_block_step, 10, q4, k4, v4)
+    chain3 = lambda out, a: (out[0].astype(a[0].dtype), a[1], a[2])
+    pall, ratio = _paired_ratio(pallas_block_step, (qr, kr, vr), chain3,
+                                xla_block_step, (q4, k4, v4), chain3)
     out.append({
         "metric": "ring_block_attention_us",
         "value": round(pall * 1e6, 1),
         "unit": "us/fwd+bwd",
-        "vs_baseline": round(comp / pall, 4),
+        "vs_baseline": round(ratio, 4),
         "detail": {"shape": f"bh{rb * rh} sl{rsl} d{rd} causal",
-                   "xla_composite_us": round(comp * 1e6, 1),
                    "baseline": "XLA einsum+logsumexp ring block "
-                               "(fwd+bwd, same shard shape)"},
+                               "(fwd+bwd, median paired ratio)"},
     })
 
     # weight-only int8 GEMM at decode shapes: memory-bound, the int8
@@ -479,7 +525,7 @@ def bench_micro(on_tpu: bool):
     from paddle_tpu.ops.kernels.pallas import weight_only_gemm as wog
 
     if on_tpu:
-        m_, k_, n_ = 16, 4096, 11008
+        m_, k_, n_ = 32, 8192, 28672     # Llama-3-8B-ish decode FFN
     else:
         m_, k_, n_ = 8, 256, 512
     wq = jnp.asarray(rng.randn(k_, n_) * 0.02, jnp.bfloat16)
@@ -487,43 +533,48 @@ def bench_micro(on_tpu: bool):
     q8, s8 = wog.quantize(wq, "int8")
 
     bf = jax.jit(lambda a, b: jnp.dot(a, b))
-    int8 = jax.jit(lambda a, qw, s: wog.weight_only_matmul(a, qw, s, "int8"))
-    t_bf = _time_steps(bf, 30, xq, wq)
-    t_i8 = _time_steps(int8, 30, xq, q8, s8)
+    int8 = jax.jit(lambda a, qw, s: wog.weight_only_matmul(a, qw, s,
+                                                           "int8"))
+    chain_x = lambda out, a: ((a[0] + out[:, :k_].astype(a[0].dtype)
+                               * 1e-30),) + a[1:]
+    t_i8, ratio = _paired_ratio(int8, (xq, q8, s8), chain_x,
+                                bf, (xq, wq), chain_x, steps=15)
     out.append({
         "metric": "weight_only_int8_gemm_us",
         "value": round(t_i8 * 1e6, 1),
         "unit": "us/call",
-        "vs_baseline": round(t_bf / t_i8, 4),
+        "vs_baseline": round(ratio, 4),
         "detail": {"shape": f"m{m_} k{k_} n{n_} (decode)",
-                   "bf16_matmul_us": round(t_bf * 1e6, 1),
-                   "baseline": "bf16 weights matmul, same shapes"},
+                   "baseline": "bf16 weights matmul, same shapes "
+                               "(median paired ratio)"},
     })
 
     # grouped GEMM: MoE expert shapes [E, C, K] @ [E, K, N]
     if on_tpu:
-        E, C, K, N = 8, 2048, 1024, 2816
+        E, C, K, N = 8, 4096, 1024, 2816
     else:
         E, C, K, N = 4, 64, 32, 64
     xg = jnp.asarray(rng.randn(E, C, K), jnp.bfloat16)
     wg = jnp.asarray(rng.randn(E, K, N), jnp.bfloat16)
     counts = jnp.asarray(rng.randint(C // 2, C, E), jnp.int32)
 
-    def run_gmm(use_pallas):
-        fn = jax.jit(lambda x_, w_, c_: grouped_matmul(
+    def gmm_fn(use_pallas):
+        return jax.jit(lambda x_, w_, c_: grouped_matmul(
             x_, w_, c_, 1, use_pallas))
-        return _time_steps(fn, 20, xg, wg, counts)
 
-    comp = run_gmm(False)
-    pall = run_gmm(True)
+    feed_g = lambda out, a: ((a[0] + out[..., :K].astype(a[0].dtype)
+                              * 1e-30),) + a[1:]
+    pall, ratio = _paired_ratio(gmm_fn(True), (xg, wg, counts), feed_g,
+                                gmm_fn(False), (xg, wg, counts), feed_g,
+                                steps=15)
     out.append({
         "metric": "grouped_gemm_us",
         "value": round(pall * 1e6, 1),
         "unit": "us/call",
-        "vs_baseline": round(comp / pall, 4),
+        "vs_baseline": round(ratio, 4),
         "detail": {"shape": f"E{E} C{C} K{K} N{N} (ragged counts)",
-                   "xla_composite_us": round(comp * 1e6, 1),
-                   "baseline": "XLA composite grouped matmul"},
+                   "baseline": "XLA composite grouped matmul "
+                               "(median paired ratio)"},
     })
     return out
 
@@ -627,12 +678,54 @@ def bench_dispatch(on_tpu: bool):
     }
 
 
+def _run_isolated(names):
+    """Run each config in a FRESH subprocess and merge the JSON lines.
+
+    Back-to-back configs in one process contaminate each other's timings
+    (donated-buffer pressure + compile-cache interactions measured to
+    corrupt later configs by >10x on the tunneled chip); isolation costs
+    ~30s of imports but makes the recorded numbers reproducible."""
+    import subprocess
+    merged_cfgs, errors = [], {}
+    headline = None
+    for name in names:
+        env = dict(os.environ, PTPU_BENCH_CONFIGS=name,
+                   PTPU_BENCH_ISOLATED="0")
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, env=env)
+        try:
+            d = json.loads(r.stdout.strip().splitlines()[-1])
+        except Exception:
+            errors[name] = (r.stderr or r.stdout)[-300:]
+            continue
+        if name == "llama":
+            headline = d
+        merged_cfgs.extend(d["detail"].get("configs", []))
+        errors.update(d["detail"].get("errors", {}))
+    if headline is None:
+        headline = {"value": 0.0, "detail": {}}
+    detail = dict(headline.get("detail", {}))
+    detail["configs"] = merged_cfgs
+    if errors:
+        detail["errors"] = errors
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu_1chip",
+        "value": headline.get("value", 0.0),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(headline.get("value", 0.0) / 0.40, 4),
+        "detail": detail,
+    }))
+
+
 def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     which = os.environ.get(
         "PTPU_BENCH_CONFIGS", "llama,resnet,bert,ocr,moe,micro,dispatch")
     which = [w.strip() for w in which.split(",") if w.strip()]
+    if (on_tpu and len(which) > 1
+            and os.environ.get("PTPU_BENCH_ISOLATED", "1") != "0"):
+        return _run_isolated(which)
 
     configs = []
     errors = {}

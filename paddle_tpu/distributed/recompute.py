@@ -24,9 +24,13 @@ def _is_tracing(tree) -> bool:
                for l in leaves)
 
 
-def recompute(function, *args, use_reentrant: bool = True, **kwargs):
+def recompute(function, *args, use_reentrant: bool = True, policy=None,
+              **kwargs):
     """Run `function(*args, **kwargs)` so its activations are rematerialised
-    during backward when tracing under jit."""
+    during backward when tracing under jit. `policy` is a jax.checkpoint
+    rematerialisation policy (e.g. checkpoint_policies.dots_saveable:
+    matmul outputs stay, elementwise recomputes — the selective-remat
+    sweet spot on HBM-bound TPUs)."""
     if not _is_tracing(args):
         return function(*args, **kwargs)
 
@@ -45,7 +49,10 @@ def recompute(function, *args, use_reentrant: bool = True, **kwargs):
         return jax.tree_util.tree_map(
             lambda t: t._data if is_t(t) else t, out, is_leaf=is_t)
 
-    out_data = jax.checkpoint(inner)(*datas)
+    if policy is not None:
+        out_data = jax.checkpoint(inner, policy=policy)(*datas)
+    else:
+        out_data = jax.checkpoint(inner)(*datas)
     return jax.tree_util.tree_map(Tensor, out_data)
 
 

@@ -21,6 +21,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -272,7 +273,12 @@ class LlamaModel(Layer):
             for layer in self.layers:
                 if self.config.recompute and self.training:
                     from ..distributed.recompute import recompute
-                    x = recompute(layer, x, attn_mask, position_ids)
+                    pol = None
+                    if self.config.recompute == "selective":
+                        # keep matmul outputs, recompute elementwise only
+                        pol = jax.checkpoint_policies.dots_saveable
+                    x = recompute(layer, x, attn_mask, position_ids,
+                                  policy=pol)
                 else:
                     x = layer(x, attn_mask, position_ids)
         return self.norm(x)
@@ -307,7 +313,8 @@ class LlamaPretrainingCriterion(Layer):
         super().__init__()
 
     def forward(self, logits, labels):
-        logits = logits[:, :-1, :].astype("float32")
-        labels = labels[:, 1:]
-        loss = call_op("softmax_with_cross_entropy", logits, labels)
+        # fused CE keeps the [b, s, V] logits bf16-resident (no f32 copy,
+        # no saved probs) — the difference between fitting batch 8 and
+        # OOM on a 16G chip (kernels/nn.py fused_softmax_ce)
+        loss = call_op("fused_softmax_ce", logits[:, :-1, :], labels[:, 1:])
         return loss.mean()

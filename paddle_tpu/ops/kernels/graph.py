@@ -110,6 +110,44 @@ def send_uv_kernel(x, y, src_index, dst_index, message_op="ADD"):
 # sampling / reindex (host-side)
 # ---------------------------------------------------------------------------
 
+def _np_rng():
+    """Host-side RNG derived from the framework generator: advancing a
+    subkey per call keeps sampling reproducible under paddle.seed while
+    still distinct across calls (the reference draws from its Generator)."""
+    from ...core import generator
+    key = generator.next_key()
+    return np.random.default_rng(
+        int(np.asarray(jax.random.key_data(key)).ravel()[-1]))
+
+
+def _sample_common(row, colptr, nodes, eids, return_eids, op_name, select):
+    """Shared sampler scaffold: per-node `select(rng, lo, hi, deg)` returns
+    chosen absolute edge indices."""
+    if return_eids and eids is None:
+        raise ValueError(f"return_eids=True requires eids (reference "
+                         f"{op_name} contract)")
+    rowa = np.asarray(row).astype(np.int64)
+    cp = np.asarray(colptr).astype(np.int64)
+    nds = np.asarray(nodes).astype(np.int64).reshape(-1)
+    ea = np.asarray(eids).astype(np.int64) if return_eids else None
+    rng = _np_rng()
+    outs, cnts, oeids = [], [], []
+    for v in nds:
+        lo, hi = cp[v], cp[v + 1]
+        idx = select(rng, int(lo), int(hi), int(hi - lo))
+        outs.append(rowa[idx])
+        cnts.append(len(idx))
+        if ea is not None:
+            oeids.append(ea[idx])
+    id_dt = np.asarray(row).dtype
+    out = np.concatenate(outs) if outs else np.zeros((0,), np.int64)
+    cnt = np.asarray(cnts, np.int32)
+    oe = (np.concatenate(oeids) if oeids else np.zeros((0,), np.int64)) \
+        if ea is not None else np.zeros((0,), np.int64)
+    return (jnp.asarray(out.astype(id_dt)), jnp.asarray(cnt),
+            jnp.asarray(oe.astype(id_dt)))
+
+
 @register_kernel("graph_sample_neighbors")
 def graph_sample_neighbors_kernel(row, colptr, x, eids=None,
                                   perm_buffer=None, sample_size=-1,
@@ -118,33 +156,14 @@ def graph_sample_neighbors_kernel(row, colptr, x, eids=None,
     """CSC sampling: for each node in x, uniformly sample up to
     `sample_size` in-neighbors from row[colptr[v]:colptr[v+1]].
     Returns (neighbors concat, per-node counts[, edge ids])."""
-    if return_eids and eids is None:
-        raise ValueError("return_eids=True requires eids (reference "
-                         "graph_sample_neighbors contract)")
-    rowa = np.asarray(row).astype(np.int64)
-    cp = np.asarray(colptr).astype(np.int64)
-    nodes = np.asarray(x).astype(np.int64).reshape(-1)
-    ea = np.asarray(eids).astype(np.int64) if return_eids else None
-    rng = np.random.default_rng()
-    outs, cnts, oeids = [], [], []
-    for v in nodes:
-        lo, hi = cp[v], cp[v + 1]
-        deg = hi - lo
+
+    def select(rng, lo, hi, deg):
         if sample_size < 0 or deg <= sample_size:
-            idx = np.arange(lo, hi)
-        else:
-            idx = lo + rng.choice(deg, size=sample_size, replace=False)
-        outs.append(rowa[idx])
-        cnts.append(len(idx))
-        if ea is not None:
-            oeids.append(ea[idx])
-    id_dt = np.asarray(row).dtype
-    out = np.concatenate(outs) if outs else np.zeros((0,), np.int64)
-    cnt = np.asarray(cnts, np.int32)
-    oe = (np.concatenate(oeids) if oeids else np.zeros((0,), np.int64)) \
-        if ea is not None else np.zeros((0,), np.int64)
-    return (jnp.asarray(out.astype(id_dt)), jnp.asarray(cnt),
-            jnp.asarray(oe.astype(id_dt)))
+            return np.arange(lo, hi)
+        return lo + rng.choice(deg, size=sample_size, replace=False)
+
+    return _sample_common(row, colptr, x, eids, return_eids,
+                          "graph_sample_neighbors", select)
 
 
 @register_kernel("weighted_sample_neighbors")
@@ -153,34 +172,17 @@ def weighted_sample_neighbors_kernel(row, colptr, edge_weight, input_nodes,
                                      return_eids=False):
     """Weighted sampling without replacement (A-Res: keys u^(1/w), take
     top-k — matches the reference's weighted reservoir strategy)."""
-    if return_eids and eids is None:
-        raise ValueError("return_eids=True requires eids (reference "
-                         "weighted_sample_neighbors contract)")
-    rowa = np.asarray(row).astype(np.int64)
-    cp = np.asarray(colptr).astype(np.int64)
     w = np.asarray(edge_weight).astype(np.float64).reshape(-1)
-    nodes = np.asarray(input_nodes).astype(np.int64).reshape(-1)
-    ea = np.asarray(eids).astype(np.int64) if return_eids else None
-    rng = np.random.default_rng()
-    outs, cnts, oeids = [], [], []
-    for v in nodes:
-        lo, hi = cp[v], cp[v + 1]
-        deg = hi - lo
+
+    def select(rng, lo, hi, deg):
         idx = np.arange(lo, hi)
         if 0 <= sample_size < deg:
             keys = rng.random(deg) ** (1.0 / np.maximum(w[lo:hi], 1e-12))
             idx = idx[np.argsort(-keys)[:sample_size]]
-        outs.append(rowa[idx])
-        cnts.append(len(idx))
-        if ea is not None:
-            oeids.append(ea[idx])
-    id_dt = np.asarray(row).dtype
-    out = np.concatenate(outs) if outs else np.zeros((0,), np.int64)
-    cnt = np.asarray(cnts, np.int32)
-    oe = (np.concatenate(oeids) if oeids else np.zeros((0,), np.int64)) \
-        if ea is not None else np.zeros((0,), np.int64)
-    return (jnp.asarray(out.astype(id_dt)), jnp.asarray(cnt),
-            jnp.asarray(oe.astype(id_dt)))
+        return idx
+
+    return _sample_common(row, colptr, input_nodes, eids, return_eids,
+                          "weighted_sample_neighbors", select)
 
 
 @register_kernel("reindex_graph")

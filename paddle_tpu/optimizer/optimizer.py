@@ -205,6 +205,22 @@ class Optimizer:
         self.clear_grad()
 
 
+def _bias_corrections(b1, b2, step):
+    """(1/(1-b1^t), 1/(1-b2^t)) materialised ONCE per step.
+
+    `step` is a TRACED device scalar (TrainStep chains it on device);
+    without the optimization_barrier XLA fuses the transcendental pow into
+    every per-element update fusion and recomputes it per element —
+    measured 30ms per 26M-param weight on v5e, ~2/3 of the whole Llama
+    train step. The barrier forces a scalar materialisation; the fusions
+    then see a broadcast operand."""
+    step = step.astype(jnp.float32) if hasattr(step, "astype") \
+        else jnp.asarray(step, jnp.float32)
+    pair = jax.lax.optimization_barrier(
+        (1.0 / (1.0 - b1 ** step), 1.0 / (1.0 - b2 ** step)))
+    return pair
+
+
 _JIT_CACHE: Dict = {}
 
 
@@ -316,11 +332,8 @@ class Adam(Optimizer):
             g = g + wd * p
         m = b1 * state["m"] + (1 - b1) * g
         v = b2 * state["v"] + (1 - b2) * jnp.square(g)
-        bc1 = 1 - b1 ** step
-        bc2 = 1 - b2 ** step
-        m_hat = m / bc1
-        v_hat = v / bc2
-        upd = m_hat / (jnp.sqrt(v_hat) + eps)
+        inv_bc1, inv_bc2 = _bias_corrections(b1, b2, step)
+        upd = (m * inv_bc1) / (jnp.sqrt(v * inv_bc2) + eps)
         if self._decoupled():
             upd = upd + wd * p
         return p - lr * upd, {"m": m, "v": v}
@@ -377,9 +390,8 @@ class Lamb(Optimizer):
         wd = wd.astype(p.dtype)
         m = b1 * state["m"] + (1 - b1) * g
         v = b2 * state["v"] + (1 - b2) * jnp.square(g)
-        m_hat = m / (1 - b1 ** step)
-        v_hat = v / (1 - b2 ** step)
-        tr_div = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+        inv_bc1, inv_bc2 = _bias_corrections(b1, b2, step)
+        tr_div = (m * inv_bc1) / (jnp.sqrt(v * inv_bc2) + eps) + wd * p
         pn = jnp.sqrt(jnp.sum(jnp.square(p)))
         tn = jnp.sqrt(jnp.sum(jnp.square(tr_div)))
         r = jnp.where((pn > 0) & (tn > 0), pn / jnp.where(tn > 0, tn, 1.0), 1.0)
@@ -412,7 +424,8 @@ class Adamax(Optimizer):
         g = g + wd.astype(p.dtype) * p
         m = b1 * state["m"] + (1 - b1) * g
         inf = jnp.maximum(jnp.abs(g), b2 * state["inf"] + eps)
-        lr_t = lr / (1 - b1 ** step)
+        inv_bc1, _ = _bias_corrections(b1, b2, step)
+        lr_t = lr * inv_bc1.astype(lr.dtype)
         return p - lr_t * m / inf, {"m": m, "inf": inf}
 
 

@@ -233,3 +233,74 @@ class TestReviewRegressions:
         out = paddle.take(paddle.to_tensor(x),
                           paddle.to_tensor(np.array([-1, 2], np.int32)))
         np.testing.assert_allclose(out.numpy(), [5.0, 2.0])
+
+
+class TestFusedSoftmaxCE:
+    """Round-3 MFU work: bf16-resident fused CE (kernels/nn.py _fused_ce)."""
+
+    def test_parity_and_grads(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.ops.dispatcher import call_op
+        rng = np.random.RandomState(0)
+        lg = paddle.to_tensor(rng.randn(2, 8, 50).astype(np.float32),
+                              stop_gradient=False)
+        lb = paddle.to_tensor(rng.randint(0, 50, (2, 8)).astype(np.int32))
+        out = call_op("fused_softmax_ce", lg, lb)
+        ref = call_op("softmax_with_cross_entropy", lg, lb)
+        np.testing.assert_allclose(out.numpy(), ref.numpy()[..., 0],
+                                   rtol=1e-5)
+        out.sum().backward()
+        g1 = lg.grad.numpy().copy()
+        lg2 = paddle.to_tensor(lg.numpy(), stop_gradient=False)
+        call_op("softmax_with_cross_entropy", lg2, lb).sum().backward()
+        np.testing.assert_allclose(g1, lg2.grad.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_bf16_logits_stay_bf16(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.ops.dispatcher import call_op
+        lg = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 4, 32).astype(np.float32)
+        ).astype("bfloat16")
+        lg.stop_gradient = False
+        lb = paddle.to_tensor(np.array([[1, 2, 3, 4], [5, 6, 7, 8]],
+                                       np.int32))
+        out = call_op("fused_softmax_ce", lg, lb)
+        assert str(out.dtype) in ("float32",)  # loss in f32
+        out.sum().backward()
+        assert str(lg.grad.numpy().dtype) == "bfloat16"
+
+    def test_ignore_index_masked(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.ops.dispatcher import call_op
+        rng = np.random.RandomState(2)
+        lg = paddle.to_tensor(rng.randn(1, 4, 10).astype(np.float32),
+                              stop_gradient=False)
+        lb = paddle.to_tensor(np.array([[1, -100, 3, -100]], np.int32))
+        out = call_op("fused_softmax_ce", lg, lb)
+        assert out.numpy()[0, 1] == 0.0 and out.numpy()[0, 3] == 0.0
+        out.sum().backward()
+        g = lg.grad.numpy()
+        assert np.abs(g[0, 1]).sum() == 0.0 and np.abs(g[0, 3]).sum() == 0.0
+        assert np.abs(g[0, 0]).sum() > 0
+
+def test_sampler_reproducible_under_seed():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.dispatcher import call_op
+    row = paddle.to_tensor(np.arange(50, dtype=np.int32))
+    colptr = paddle.to_tensor(np.array([0, 50], np.int32))
+    nodes = paddle.to_tensor(np.array([0], np.int32))
+    paddle.seed(123)
+    a, _, _ = call_op("graph_sample_neighbors", row, colptr, nodes,
+                      sample_size=5)
+    b, _, _ = call_op("graph_sample_neighbors", row, colptr, nodes,
+                      sample_size=5)
+    paddle.seed(123)
+    a2, _, _ = call_op("graph_sample_neighbors", row, colptr, nodes,
+                       sample_size=5)
+    np.testing.assert_array_equal(a.numpy(), a2.numpy())   # reproducible
+    assert not np.array_equal(a.numpy(), b.numpy())        # distinct calls

@@ -70,8 +70,10 @@ class TestPallasFlashAttention:
         from paddle_tpu.ops.kernels.pallas import flash_attention as fa
         # ragged seq not divisible by 128
         assert not fa.supported((1, 100, 2, 64), (1, 100, 2, 64), False)
-        # causal cross-attention (decode) is not the kernel's job
-        assert not fa.supported((1, 128, 2, 64), (1, 256, 2, 64), True)
+        # causal sq < sk is SUPPORTED since round 3 (right-aligned offset)
+        assert fa.supported((1, 128, 2, 64), (1, 256, 2, 64), True)
+        # ...but more queries than keys has no offset semantics
+        assert not fa.supported((1, 256, 2, 64), (1, 128, 2, 64), True)
 
 
 class TestLayerStack:

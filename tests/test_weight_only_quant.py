@@ -102,6 +102,9 @@ class TestQuantizedServing:
         below the op-level 1e-2 (test above)."""
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         from paddle_tpu.nn.quant import WeightOnlyLinear
+        prev_dt = paddle.get_default_dtype()
+        paddle.set_default_dtype("float32")  # xdist neighbor may leak bf16
+        self._restore = prev_dt
         paddle.seed(0)
         cfg = LlamaConfig.tiny()
         m = LlamaForCausalLM(cfg)
@@ -131,6 +134,7 @@ class TestQuantizedServing:
         gen = m.generate(paddle.to_tensor(np.array([[1, 2, 3]], np.int32)),
                          max_new_tokens=4)
         assert gen.shape[1] == 7
+        paddle.set_default_dtype(self._restore)
 
     def test_state_dict_roundtrip(self):
         lin = nn.Linear(16, 8)
