@@ -18,6 +18,7 @@ from . import serving  # noqa: F401
 from . import math_ext  # noqa: F401
 from . import detection  # noqa: F401
 from . import graph  # noqa: F401
+from . import compat_tranche  # noqa: F401
 from . import moe  # noqa: F401
 from . import extra_math  # noqa: F401
 from . import extra_nn  # noqa: F401

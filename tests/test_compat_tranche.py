@@ -1,0 +1,153 @@
+"""Round-3 compat tranche ops (kernels/compat_tranche.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.dispatcher import call_op
+
+
+def t(a):
+    return paddle.to_tensor(a)
+
+
+rng = np.random.RandomState(0)
+
+
+class TestCompatTranche:
+    def test_lrn_numpy_golden(self):
+        x = rng.randn(2, 8, 4, 4).astype(np.float32)
+        out = call_op("lrn", t(x), n=3, k=2.0, alpha=1e-3, beta=0.5)
+        sq = x ** 2
+        den = np.zeros_like(x)
+        for c in range(8):
+            lo, hi = max(0, c - 1), min(8, c + 2)
+            den[:, c] = 2.0 + 1e-3 * sq[:, lo:hi].sum(1)
+        np.testing.assert_allclose(out.numpy(), x / np.sqrt(den), rtol=1e-5)
+
+    def test_multiplex(self):
+        a = rng.randn(4, 3).astype(np.float32)
+        b = rng.randn(4, 3).astype(np.float32)
+        idx = np.array([0, 1, 1, 0], np.int32)
+        out = call_op("multiplex", [t(a), t(b)], t(idx))
+        np.testing.assert_allclose(out.numpy(),
+                                   np.where(idx[:, None] == 0, a, b))
+
+    def test_fill_diagonal_tensor_offsets(self):
+        x = np.zeros((3, 4), np.float32)
+        out = call_op("fill_diagonal_tensor", t(x),
+                      t(np.array([1., 2., 3.], np.float32)))
+        assert [out.numpy()[i, i] for i in range(3)] == [1, 2, 3]
+        o2 = call_op("fill_diagonal_tensor", t(x),
+                     t(np.array([5., 6., 7.], np.float32)), offset=1)
+        assert o2.numpy()[0, 1] == 5 and o2.numpy()[2, 3] == 7
+
+    def test_fc_flatten_and_activation(self):
+        inp = rng.randn(2, 3, 4).astype(np.float32)
+        w = rng.randn(12, 5).astype(np.float32)
+        out = call_op("fc", t(inp), t(w), None, in_num_col_dims=1)
+        np.testing.assert_allclose(out.numpy(), inp.reshape(2, 12) @ w,
+                                   rtol=1e-5)
+        o2 = call_op("fc", t(inp), t(w), None, in_num_col_dims=1,
+                     activation_type="relu")
+        assert (o2.numpy() >= 0).all()
+
+    def test_margin_ce_zero_margin_is_scaled_softmax(self):
+        lg = np.clip(rng.randn(4, 6).astype(np.float32) * 0.3, -1, 1)
+        lb = np.array([1, 2, 3, 0], np.int32)
+        sm, loss = call_op("margin_cross_entropy", t(lg), t(lb),
+                           margin1=1.0, margin2=0.0, margin3=0.0,
+                           scale=10.0)
+        z = lg * 10.0
+        ref = -np.log(np.exp(z)[np.arange(4), lb] / np.exp(z).sum(1))
+        np.testing.assert_allclose(loss.numpy()[:, 0], ref, rtol=2e-4)
+        np.testing.assert_allclose(sm.numpy().sum(1), 1.0, rtol=1e-5)
+
+    def test_margin_ce_margin_lowers_target_logit(self):
+        lg = np.clip(rng.randn(4, 6).astype(np.float32) * 0.3, -1, 1)
+        lb = np.array([1, 2, 3, 0], np.int32)
+        _, l0 = call_op("margin_cross_entropy", t(lg), t(lb), margin2=0.0)
+        _, lm = call_op("margin_cross_entropy", t(lg), t(lb), margin2=0.5)
+        assert (lm.numpy() > l0.numpy()).all()   # margin makes it harder
+
+    def test_hsigmoid_default_tree_and_grads(self):
+        xx = paddle.to_tensor(rng.randn(4, 8).astype(np.float32),
+                              stop_gradient=False)
+        lbl = t(np.array([0, 3, 5, 6], np.int32))
+        w = paddle.to_tensor(rng.randn(7, 8).astype(np.float32),
+                             stop_gradient=False)
+        loss, pre, _ = call_op("hsigmoid_loss", xx, lbl, w, num_classes=7)
+        loss.sum().backward()
+        assert np.isfinite(loss.numpy()).all()
+        assert xx.grad is not None and w.grad is not None
+        # distinct labels get distinct losses (tree paths differ)
+        assert len(set(np.round(loss.numpy()[:, 0], 5))) > 1
+
+    def test_row_conv_lookahead(self):
+        x = rng.randn(2, 5, 3).astype(np.float32)
+        f = rng.randn(2, 3).astype(np.float32)
+        out = call_op("row_conv", t(x), t(f))
+        ref = np.zeros_like(x)
+        for ti in range(5):
+            ref[:, ti] = x[:, ti] * f[0]
+            if ti + 1 < 5:
+                ref[:, ti] += x[:, ti + 1] * f[1]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_small_ops(self):
+        assert call_op("identity_loss", t(np.array([2., 4.])),
+                       reduction=1).numpy() == 3.0
+        assert call_op("grad_add", t(np.ones(3, np.float32)),
+                       t(np.ones(3, np.float32))).numpy().sum() == 6.0
+        sc = call_op("shuffle_channel",
+                     t(np.arange(8, dtype=np.float32).reshape(1, 4, 1, 2)),
+                     group=2).numpy()
+        assert sc[0, 1, 0, 0] == 4.0    # channel 2 -> position 1
+        ps = call_op("partial_sum",
+                     [t(np.ones((2, 6), np.float32)),
+                      t(np.full((2, 6), 2.0, np.float32))],
+                     start_index=1, length=3)
+        assert ps.shape == [2, 3] and ps.numpy()[0, 0] == 3.0
+        nc = call_op("number_count", t(np.array([0, 1, 1, 3], np.int32)),
+                     upper_range=5)
+        assert nc.numpy().tolist() == [1, 2, 0, 1, 0]
+        bl = call_op("bilinear", t(np.ones((2, 3), np.float32)),
+                     t(np.ones((2, 4), np.float32)),
+                     t(np.ones((5, 3, 4), np.float32)))
+        np.testing.assert_allclose(bl.numpy(), 12.0)
+        sm = call_op("sequence_mask_op", t(np.array([2, 4], np.int32)),
+                     max_len=5)
+        assert sm.numpy().sum() == 6
+        fb = call_op("full_batch_size_like", t(np.zeros((3, 2), np.float32)),
+                     shape=[-1, 7], value=1.5)
+        assert fb.shape == [3, 7] and fb.numpy()[0, 0] == 1.5
+
+    def test_shuffle_batch_reproducible(self):
+        x = t(np.arange(6, dtype=np.float32).reshape(6, 1))
+        paddle.seed(7)
+        a, ai = call_op("shuffle_batch", x)
+        paddle.seed(7)
+        b, bi = call_op("shuffle_batch", x)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        assert sorted(a.numpy()[:, 0].tolist()) == [0, 1, 2, 3, 4, 5]
+
+    def test_khop_and_lars(self):
+        row = t(np.array([1, 2, 0, 0, 1, 2], np.int32))
+        colptr = t(np.array([0, 2, 3, 6], np.int32))
+        src, dst, nodes, _, _ = call_op(
+            "graph_khop_sampler", row, colptr,
+            t(np.array([0], np.int32)), sample_sizes=[2, 2])
+        assert nodes.shape[0] >= 1 and src.shape == dst.shape
+        p = t(np.ones(4, np.float32))
+        g = t(np.full(4, 0.1, np.float32))
+        v = t(np.zeros(4, np.float32))
+        np_, nv = call_op("lars_momentum_op", p, g, v,
+                          t(np.float32(0.1)))
+        # local_lr = 0.1*0.001*2/(0.2 + 0.0005*2 + 0) ~ 1e-3
+        assert (np_.numpy() < 1.0).all() and np.isfinite(nv.numpy()).all()
+
+    def test_compat_targets_live(self):
+        from paddle_tpu.ops.op_compat import resolve
+        assert resolve("hierarchical_sigmoid") == "hsigmoid_loss"
+        assert resolve("sequence_mask") == "sequence_mask_op"
+        assert resolve("lars_momentum") == "lars_momentum_op"
