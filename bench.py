@@ -59,11 +59,17 @@ def _time_steps(fn, steps: int, *args, final=None):
     jax.block_until_ready(out)
     out = fn(*args)
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(final() if final is not None else out)
-    return (time.perf_counter() - t0) / steps
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        jax.block_until_ready(final() if final is not None else out)
+        times.append((time.perf_counter() - t0) / steps)
+    # max of two windows: guards against spurious UNDER-measurement seen
+    # on the tunneled chip right after a previous process released the
+    # device (honest runs have the two windows within a few percent)
+    return max(times)
 
 
 # --------------------------------------------------------------------------
@@ -689,6 +695,7 @@ def _run_isolated(names):
     merged_cfgs, errors = [], {}
     headline = None
     for name in names:
+        time.sleep(3.0)   # let the previous process release the device
         env = dict(os.environ, PTPU_BENCH_CONFIGS=name,
                    PTPU_BENCH_ISOLATED="0")
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],

@@ -40,7 +40,7 @@ def lrn_kernel(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
     if data_format != "NCHW":
         x = jnp.moveaxis(x, -1, 1)
     sq = jnp.square(x.astype(jnp.float32))
-    half = n // 2
+    half = (n - 1) // 2        # reference window start: c - (n-1)/2
     pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
     den = k + alpha * jax.lax.reduce_window(
         pad, 0.0, jax.lax.add, (1, n, 1, 1), (1, 1, 1, 1), "VALID")
@@ -196,18 +196,25 @@ def row_conv_kernel(x, filter):
 
 @register_kernel("fused_elemwise_add_activation")
 def fused_elemwise_add_activation_kernel(x, y, functor_list=("relu",)):
-    out = x + y
-    # paddle's canonical attribute lists the binary functor first
-    # (['elementwise_add', 'relu']); scan for the unary activation
-    acts = [f for f in (functor_list or ()) if "elementwise" not in f]
+    fl = list(functor_list or ())
+    acts = [f for f in fl if "elementwise" not in f]
     act = acts[0] if acts else ""
-    if "relu" in act:
-        return jnp.maximum(out, 0)
-    if "sigmoid" in act:
-        return jax.nn.sigmoid(out)
-    if "tanh" in act:
-        return jnp.tanh(out)
-    return out
+
+    def apply(v):
+        if "relu" in act:
+            return jnp.maximum(v, 0)
+        if "sigmoid" in act:
+            return jax.nn.sigmoid(v)
+        if "tanh" in act:
+            return jnp.tanh(v)
+        return v
+
+    # reference composition follows functor order: unary-first means
+    # Unary(Binary(x, y)) = act(x + y); binary-first means
+    # Binary(x, Unary(y)) = x + act(y)
+    if fl and "elementwise" in fl[0]:
+        return x + apply(y)
+    return apply(x + y)
 
 
 @register_kernel("margin_cross_entropy")
@@ -302,13 +309,16 @@ def graph_khop_sampler_kernel(row, colptr, x, eids=None, sample_sizes=(),
         if v not in mapping:
             mapping[v] = len(order)
             order.append(v)
+    # note: reindex_graph_kernel cannot be reused here — its dst derives
+    # from per-SEED counts, but hop>=2 edges have non-seed centers
     src = np.asarray([mapping[int(v)] for v in nbs], np.int64)
     dst = np.asarray([mapping[int(v)] for v in cen], np.int64)
+    reindex_x = np.asarray([mapping[int(v)] for v in xs], np.int64)
     id_dt = np.asarray(x).dtype
     oe = (np.concatenate(eids_g) if eids_g else np.zeros((0,), np.int64))
     return (jnp.asarray(src.astype(id_dt)), jnp.asarray(dst.astype(id_dt)),
             jnp.asarray(np.asarray(order, np.int64).astype(id_dt)),
-            jnp.asarray(np.arange(len(xs)).astype(id_dt)),
+            jnp.asarray(reindex_x.astype(id_dt)),
             jnp.asarray(oe.astype(id_dt)))
 
 
