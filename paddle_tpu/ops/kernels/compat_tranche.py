@@ -342,3 +342,44 @@ def lars_momentum_kernel(param, grad, velocity, learning_rate, mu=0.9,
     v = mu * velocity.astype(jnp.float32) \
         + local * (g + lars_weight_decay * p)
     return (p - v).astype(param.dtype), v
+
+
+@register_kernel("share_data")
+def share_data_kernel(x):
+    """Alias ops (memcpy/share_data/share_buffer): functional arrays make
+    these identities — XLA owns placement, donation owns aliasing."""
+    return x
+
+
+@register_kernel("uniform_random_batch_size_like")
+def uniform_random_batch_size_like_kernel(input, key=None, shape=(),
+                                          min=-1.0, max=1.0, dtype=None,
+                                          input_dim_idx=0,
+                                          output_dim_idx=0):
+    from ...core import dtype as dtype_mod
+    shape = list(shape)
+    if not shape or output_dim_idx >= len(shape):
+        raise ValueError(
+            "uniform_random_batch_size_like: `shape` is required and must "
+            f"cover output_dim_idx={output_dim_idx} (got {shape})")
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    dt = dtype_mod.convert_dtype(dtype) or jnp.float32
+    return jax.random.uniform(key, tuple(shape), dt, float(min), float(max))
+
+
+@register_kernel("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose_kernel(x, weight, bias=None, stride=(1, 1),
+                                      padding=(0, 0),
+                                      output_padding=(0, 0),
+                                      dilation=(1, 1), groups=1,
+                                      data_format="NCHW"):
+    if data_format != "NCHW":
+        raise NotImplementedError(
+            "depthwise_conv2d_transpose: only NCHW is implemented (the "
+            "underlying conv2d_transpose kernel is NCHW-fixed)")
+    from ..dispatcher import KERNELS
+    return KERNELS["conv2d_transpose"](
+        x, weight, bias, stride=stride, padding=padding,
+        output_padding=output_padding, dilation=dilation,
+        groups=x.shape[1] if groups in (1, None) else groups,
+        data_format=data_format)
