@@ -55,7 +55,21 @@ class WeightOnlyLinear(Layer):
         self.out_features = out_features
         self.weight_dtype = weight_dtype
         self.group_size = group_size
-        self.bias = bias
+        # bias rides state_dict as a BUFFER (inference-only layer: it must
+        # not appear in parameters() nor alias the source Linear's trainable
+        # Parameter). `bias=True` pre-registers zeros so a skeleton can load
+        # a checkpoint saved from a from_linear-built layer.
+        if bias is True:
+            self.register_buffer("bias", jnp.zeros((out_features,),
+                                                   jnp.float32))
+        elif bias is None or bias is False:
+            self.bias = None
+        else:
+            # copy into a fresh buffer so it never aliases a trainable
+            # Parameter of the source layer (which a donating TrainStep
+            # could delete out from under us)
+            self.register_buffer(
+                "bias", jnp.array(getattr(bias, "_data", bias), copy=True))
         # zero-initialised buffers with the derived shapes so a freshly
         # constructed skeleton can LOAD a saved quantized checkpoint
         # (set_state_dict copies into registered buffers only)

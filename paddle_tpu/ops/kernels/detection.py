@@ -351,29 +351,33 @@ def psroi_pool_kernel(x, boxes, boxes_num=None, pooled_height=1,
 # NMS family + proposals — host-side (data-dependent output sizes)
 # ---------------------------------------------------------------------------
 
-def _np_iou_matrix(b):
-    """b [M, 4] xyxy -> [M, M] IoU (normalized=True convention)."""
-    area = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+def _np_iou_matrix(b, norm=0.0):
+    """b [M, 4] xyxy -> [M, M] IoU. norm = 0 for normalized boxes, 1 for
+    pixel coordinates (reference JaccardOverlap adds +1 to w/h when
+    normalized=false)."""
+    area = (np.maximum(b[:, 2] - b[:, 0] + norm, 0)
+            * np.maximum(b[:, 3] - b[:, 1] + norm, 0))
     lo = np.maximum(b[:, None, :2], b[None, :, :2])
     hi = np.minimum(b[:, None, 2:], b[None, :, 2:])
-    wh = np.maximum(hi - lo, 0)
+    wh = np.maximum(hi - lo + norm, 0)
     inter = wh[..., 0] * wh[..., 1]
     return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
 
 
-def _np_iou_row(box, boxes):
+def _np_iou_row(box, boxes, norm=0.0):
     """IoU of one box [4] against boxes [M, 4] (O(M), not O(M^2))."""
-    area = np.maximum(box[2] - box[0], 0) * np.maximum(box[3] - box[1], 0)
-    areas = (np.maximum(boxes[:, 2] - boxes[:, 0], 0)
-             * np.maximum(boxes[:, 3] - boxes[:, 1], 0))
+    area = (np.maximum(box[2] - box[0] + norm, 0)
+            * np.maximum(box[3] - box[1] + norm, 0))
+    areas = (np.maximum(boxes[:, 2] - boxes[:, 0] + norm, 0)
+             * np.maximum(boxes[:, 3] - boxes[:, 1] + norm, 0))
     lo = np.maximum(box[None, :2], boxes[:, :2])
     hi = np.minimum(box[None, 2:], boxes[:, 2:])
-    wh = np.maximum(hi - lo, 0)
+    wh = np.maximum(hi - lo + norm, 0)
     inter = wh[:, 0] * wh[:, 1]
     return inter / np.maximum(area + areas - inter, 1e-10)
 
 
-def _np_greedy_nms(boxes, scores, thresh, eta=1.0):
+def _np_greedy_nms(boxes, scores, thresh, eta=1.0, norm=0.0):
     order = np.argsort(-scores, kind="stable")
     keep = []
     adaptive = float(thresh)
@@ -382,7 +386,7 @@ def _np_greedy_nms(boxes, scores, thresh, eta=1.0):
         keep.append(i)
         if order.size == 1:
             break
-        iou = _np_iou_row(boxes[i], boxes[order[1:]])
+        iou = _np_iou_row(boxes[i], boxes[order[1:]], norm)
         order = order[1:][iou <= adaptive]
         if eta < 1.0 and adaptive > 0.5:
             adaptive *= eta
@@ -410,7 +414,8 @@ def multiclass_nms3_kernel(bboxes, scores, rois_num=None, score_threshold=0.0,
                 continue
             if nms_top_k > -1 and sel.size > nms_top_k:
                 sel = sel[np.argsort(-s[sel], kind="stable")[:nms_top_k]]
-            keep = _np_greedy_nms(bb[n, sel], s[sel], nms_threshold, nms_eta)
+            keep = _np_greedy_nms(bb[n, sel], s[sel], nms_threshold, nms_eta,
+                                  norm=0.0 if normalized else 1.0)
             for k in sel[keep]:
                 dets.append([c, s[k], *bb[n, k]])
                 det_idx.append(n * M + k)
@@ -454,7 +459,8 @@ def matrix_nms_kernel(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
                 order = order[:nms_top_k]
             sel = sel[order]
             ss = s[sel]
-            iou = np.triu(_np_iou_matrix(bb[n, sel]), 1)   # iou[i,j], i<j
+            iou = np.triu(_np_iou_matrix(
+                bb[n, sel], norm=0.0 if normalized else 1.0), 1)  # i<j
             # max_iou[k]: box k's own max IoU with its higher-scored
             # predecessors; the decay of target j by suppressor i is
             # compensated by the SUPPRESSOR's max_iou (matrix_nms_kernel.cc
@@ -530,7 +536,7 @@ def generate_proposals_kernel(scores, bbox_deltas, im_shape, anchors,
         hs = box[:, 3] - box[:, 1] + off
         ok = (ws >= min_size) & (hs >= min_size)
         box, s = box[ok], s[ok]
-        keep = _np_greedy_nms(box, s, nms_thresh, eta)
+        keep = _np_greedy_nms(box, s, nms_thresh, eta, norm=off)
         if post_nms_top_n > 0:
             keep = keep[:post_nms_top_n]
         rois.append(box[keep])

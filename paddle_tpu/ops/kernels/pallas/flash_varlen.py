@@ -22,6 +22,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -391,12 +392,18 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
     if scale is None:
         scale = d ** -0.5
     # token-space causal block skip is valid only for self-attention
-    # packing (same cu layout); detected by array identity, which survives
-    # tracing — otherwise the mask alone enforces causality (correct,
-    # fewer skipped blocks)
-    tok_skip = bool(causal) and (cu_seqlens_q is cu_seqlens_k
-                                 or cu_seqlens_q.shape == cu_seqlens_k.shape
-                                 and q.shape[0] == k.shape[0])
+    # packing (identical cu layouts). Same batch + same total token count
+    # does NOT imply identical packing (q lens [1,199] vs k lens [199,1]),
+    # so only array identity — which survives tracing — or an equal
+    # concrete host-side comparison may enable it; otherwise the mask
+    # alone enforces causality (correct, fewer skipped blocks).
+    same_cu = cu_seqlens_q is cu_seqlens_k
+    if not same_cu and not (isinstance(cu_seqlens_q, jax.core.Tracer)
+                            or isinstance(cu_seqlens_k, jax.core.Tracer)):
+        same_cu = (cu_seqlens_q.shape == cu_seqlens_k.shape
+                   and bool((np.asarray(cu_seqlens_q)
+                             == np.asarray(cu_seqlens_k)).all()))
+    tok_skip = bool(causal) and same_cu
     return _varlen(q, k, v, cu_seqlens_q.astype(jnp.int32),
                    cu_seqlens_k.astype(jnp.int32), bool(causal),
                    float(scale), tok_skip)

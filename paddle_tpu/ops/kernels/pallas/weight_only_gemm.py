@@ -79,6 +79,10 @@ def quantize(w, weight_dtype: str = "int8", group_size: int = -1):
     docstring). Symmetric per-channel (group_size=-1) or per-group."""
     int4 = weight_dtype == "int4"
     k, n = w.shape
+    if int4 and k % 2:
+        raise ValueError(
+            f"weight_only_int4 packs two rows per byte and requires an even "
+            f"k (got k={k}); pad the weight's in_features to a multiple of 2")
     bound = 7.0 if int4 else 127.0
     wf = w.astype(jnp.float32)
     if group_size > 0:

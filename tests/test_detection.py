@@ -216,6 +216,22 @@ class TestNmsFamily:
         np.testing.assert_allclose(sorted(out.numpy()[:, 1])[::-1],
                                    [0.9, 0.8, 0.7], rtol=1e-6)
 
+    def test_multiclass_nms3_pixel_coordinates(self):
+        """ADVICE r3: normalized=False adds +1 to w/h in IoU (reference
+        JaccardOverlap), raising IoU for pixel boxes. A=[0,0,10,10],
+        B=[5,5,15,15]: IoU = 0.1429 normalized, 0.1748 pixel — threshold
+        0.16 separates the two conventions."""
+        bb = np.array([[[0, 0, 10, 10], [5, 5, 15, 15]]], np.float32)
+        sc = np.zeros((1, 2, 2), np.float32)
+        sc[0, 1] = [0.9, 0.8]
+        kw = dict(score_threshold=0.1, nms_threshold=0.16)
+        _, _, num_norm = call_op("multiclass_nms3", paddle.to_tensor(bb),
+                                 paddle.to_tensor(sc), normalized=True, **kw)
+        _, _, num_pix = call_op("multiclass_nms3", paddle.to_tensor(bb),
+                                paddle.to_tensor(sc), normalized=False, **kw)
+        assert num_norm.numpy()[0] == 2   # 0.1429 <= 0.16: both kept
+        assert num_pix.numpy()[0] == 1    # 0.1748 > 0.16: B suppressed
+
     def test_matrix_nms_decays_overlaps(self):
         bb = np.array([[[0, 0, 10, 10], [0, 0, 9, 9], [50, 50, 60, 60]]],
                       np.float32)

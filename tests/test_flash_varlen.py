@@ -68,6 +68,39 @@ class TestFlashVarlen:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5)
 
+    def test_causal_mismatched_packing_no_token_skip(self):
+        """ADVICE r3 (medium): same batch + same total token count does NOT
+        imply identical packing. q lens [1,199] vs k lens [199,1] with causal
+        must not enable the token-space block skip (which would drop valid
+        same-segment pos_k<=pos_q pairs); parity vs a dense per-segment
+        reference with in-sequence-position causal masking."""
+        from paddle_tpu.ops.kernels.pallas.flash_varlen import (
+            flash_attn_unpadded)
+        rng = np.random.RandomState(7)
+        h, hk, d = 2, 2, 32
+        cuq = jnp.asarray([0, 1, 200], jnp.int32)
+        cuk = jnp.asarray([0, 199, 200], jnp.int32)
+        q = jnp.asarray(rng.randn(200, h, d) * 0.3, jnp.float32)
+        k = jnp.asarray(rng.randn(200, hk, d) * 0.3, jnp.float32)
+        v = jnp.asarray(rng.randn(200, hk, d) * 0.3, jnp.float32)
+        out = flash_attn_unpadded(q, k, v, cuq, cuk, causal=True)
+
+        outs = []
+        for i in range(2):
+            q0, q1 = int(cuq[i]), int(cuq[i + 1])
+            k0, k1 = int(cuk[i]), int(cuk[i + 1])
+            qs, ks, vs = q[q0:q1], k[k0:k1], v[k0:k1]
+            logits = jnp.einsum("qhd,khd->hqk", qs, ks) * (d ** -0.5)
+            m = (jnp.arange(k1 - k0)[None, :]
+                 <= jnp.arange(q1 - q0)[:, None])
+            logits = jnp.where(m[None], logits, -jnp.inf)
+            p = jax.nn.softmax(logits, -1)
+            # rows with no live keys (pos_q < 0 impossible here) are fine
+            outs.append(jnp.einsum("hqk,khd->qhd", p, vs))
+        ref = jnp.concatenate(outs, 0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
     def test_no_cross_sequence_leakage(self):
         """Changing sequence 2's keys must not change sequence 1's output."""
         from paddle_tpu.ops.kernels.pallas.flash_varlen import (
