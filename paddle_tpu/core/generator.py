@@ -2,9 +2,16 @@
 
 Analog of the reference Generator (paddle/phi/core/generator.h — per-device
 Philox state with seed control). TPU-native design: a single global
-`Generator` holds a threefry key; every random op *consumes* a fresh subkey
+`Generator` holds a PRNG key; every random op *consumes* a fresh subkey
 via `next_key()` and receives it as an explicit argument, so recomputation
 in cached VJPs (and under `jax.checkpoint`) is deterministic.
+
+Key implementation (`FLAGS_rng_impl`): default "rbg" — XLA's native
+RngBitGenerator, the TPU analog of the reference's cuRAND Philox
+(`dropout_impl.cu.h` uses curand Philox4x32) and ~2x faster than
+threefry at dropout-mask shapes (measured v5e: 109us vs 211us per
+[8,384,3072] bernoulli mask; dropout RNG was 24ms of a 52ms BERT step).
+Set FLAGS_rng_impl=threefry2x32 for jax-default bit streams.
 """
 
 from __future__ import annotations
@@ -15,15 +22,24 @@ import jax
 import numpy as np
 
 
+def _make_key(seed: int) -> jax.Array:
+    from .. import flags
+    try:
+        impl = flags.get_flag("rng_impl")
+    except Exception:
+        impl = "rbg"
+    return jax.random.key(seed, impl=impl)
+
+
 class Generator:
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.key(seed)
+        self._key = _make_key(seed)
         self._offset = 0
 
     def manual_seed(self, seed: int) -> "Generator":
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = _make_key(self._seed)
         self._offset = 0
         return self
 

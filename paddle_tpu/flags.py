@@ -107,6 +107,10 @@ define_flag("comm_timeout_s", 600.0,
 define_flag("low_precision_op_list", 0, "log ops run in low precision under AMP")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")
+define_flag("rng_impl", "rbg",
+            "PRNG key implementation for the global Generator: 'rbg' (XLA "
+            "RngBitGenerator — the cuRAND-Philox analog, ~2x faster on TPU "
+            "at dropout shapes) or 'threefry2x32' (jax default streams)")
 
 
 # Mirror into the native C++ registry (csrc/flags.cc) once it loads; until

@@ -24,6 +24,10 @@ class _PidDataset(paddle.io.Dataset):
         return np.float32(i), np.int64(os.getpid())
 
 
+def _write_worker_marker(marker, worker_id):
+    open(f"{marker}{worker_id}", "w").write(str(os.getpid()))
+
+
 class _BoomDataset(paddle.io.Dataset):
     def __len__(self):
         return 8
@@ -131,13 +135,14 @@ class TestDataLoader:
             list(loader)
 
     def test_worker_init_fn_runs_in_workers(self):
+        import functools
         import tempfile
         with tempfile.TemporaryDirectory() as d:
             marker = os.path.join(d, "w")
-
-            def init(worker_id, _m=marker):
-                open(f"{_m}{worker_id}", "w").write(str(os.getpid()))
-
+            # functools.partial over a module-level fn stays picklable, so
+            # the safe forkserver start method is used (not the fork
+            # fallback for closures)
+            init = functools.partial(_write_worker_marker, marker)
             loader = paddle.io.DataLoader(_PidDataset(), batch_size=4,
                                           num_workers=2,
                                           worker_init_fn=init)
@@ -304,11 +309,13 @@ class TestReviewRegressions2:
         paddle.seed(0)
         m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
         sm = paddle.jit.to_static(m)
-        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=m.parameters())
+        # lr 0.2: lr 0.5 oscillates on some init draws (rbg seed 0) —
+        # this test checks to_static trainability, not tuning luck
+        opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=m.parameters())
         x = paddle.to_tensor(f32(16, 4))
         y = paddle.to_tensor(f32(16, 2))
         first = last = None
-        for _ in range(30):
+        for _ in range(60):
             loss = nn.MSELoss()(sm(x), y)
             loss.backward()
             opt.step(); opt.clear_grad()
