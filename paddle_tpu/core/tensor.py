@@ -51,6 +51,21 @@ class Tensor:
         self.name = name
         self.persistable = False
 
+    @classmethod
+    def _wrap(cls, data: jax.Array) -> "Tensor":
+        """Hot-loop constructor: wrap a known-jax.Array without the
+        __init__ type dispatch (dispatcher fast path; ~1-2us/op saved)."""
+        t = object.__new__(cls)
+        t._data = data
+        t._stop_gradient = True
+        t._grad = None
+        t._node = None
+        t._out_idx = 0
+        t._version = 0
+        t.name = None
+        t.persistable = False
+        return t
+
     # -- basic properties ----------------------------------------------------
     @property
     def data(self) -> jax.Array:
