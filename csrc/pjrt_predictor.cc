@@ -1,0 +1,501 @@
+// Python-free C++ predictor over the PJRT C API.
+//
+// Reference counterpart: paddle/fluid/inference/api/analysis_predictor.h:100
+// (AnalysisPredictor — a native library loading a saved program and running
+// it with zero Python in the process; ZeroCopyRun at
+// analysis_predictor.cc:2322) and its C ABI capi_exp/pd_inference_api.h.
+//
+// TPU-first shape: the "inference engine" is the XLA executable, so the
+// native predictor is a thin, dependency-free driver of the PJRT C API:
+//
+//   dlopen(<pjrt plugin .so>) -> GetPjrtApi()
+//     -> PJRT_Client_Create -> PJRT_Client_Compile(StableHLO bundle)
+//     -> BufferFromHostBuffer* -> LoadedExecutable_Execute
+//     -> Buffer_ToHostBuffer*
+//
+// The bundle is a directory written by
+// paddle_tpu.inference.Predictor.export_pjrt_bundle():
+//   module.stablehlo    portable StableHLO bytecode (weights embedded as
+//                       constants; jax.export serialization)
+//   compile_options.pb  serialized xla.CompileOptionsProto (1 replica)
+//   meta.txt            line format (version/ninputs/in/noutputs/out), e.g.
+//                         version 1
+//                         ninputs 1
+//                         in x f32 2 4 8
+//                         noutputs 1
+//                         out out0 f32 2 4 4
+//
+// This file links NO libpython (asserted by tests/test_pjrt_predictor.py via
+// ldd) and only needs libdl/libpthread; the PJRT C API header comes from the
+// XLA copy shipped in the tensorflow wheel at build time (runtime-free).
+//
+// Build: make pjrt_predictor   (csrc/Makefile)
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// small helpers
+// ---------------------------------------------------------------------------
+
+std::string read_file(const std::string& path, bool* ok) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    *ok = false;
+    return "";
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  *ok = true;
+  return ss.str();
+}
+
+struct DtypeInfo {
+  PJRT_Buffer_Type type;
+  size_t itemsize;
+};
+
+bool dtype_from_string(const std::string& s, DtypeInfo* out) {
+  if (s == "f32") *out = {PJRT_Buffer_Type_F32, 4};
+  else if (s == "f64") *out = {PJRT_Buffer_Type_F64, 8};
+  else if (s == "f16") *out = {PJRT_Buffer_Type_F16, 2};
+  else if (s == "bf16") *out = {PJRT_Buffer_Type_BF16, 2};
+  else if (s == "s8") *out = {PJRT_Buffer_Type_S8, 1};
+  else if (s == "s16") *out = {PJRT_Buffer_Type_S16, 2};
+  else if (s == "s32") *out = {PJRT_Buffer_Type_S32, 4};
+  else if (s == "s64") *out = {PJRT_Buffer_Type_S64, 8};
+  else if (s == "u8") *out = {PJRT_Buffer_Type_U8, 1};
+  else if (s == "u16") *out = {PJRT_Buffer_Type_U16, 2};
+  else if (s == "u32") *out = {PJRT_Buffer_Type_U32, 4};
+  else if (s == "u64") *out = {PJRT_Buffer_Type_U64, 8};
+  else if (s == "pred") *out = {PJRT_Buffer_Type_PRED, 1};
+  else return false;
+  return true;
+}
+
+struct TensorSpec {
+  std::string name;
+  std::string dtype;
+  DtypeInfo info;
+  std::vector<int64_t> dims;
+  size_t byte_size() const {
+    size_t n = info.itemsize;
+    for (int64_t d : dims) n *= static_cast<size_t>(d);
+    return n;
+  }
+};
+
+struct Meta {
+  std::vector<TensorSpec> inputs;
+  std::vector<TensorSpec> outputs;
+};
+
+bool parse_meta(const std::string& text, Meta* meta, std::string* err) {
+  std::istringstream in(text);
+  std::string tok;
+  auto parse_spec = [&](TensorSpec* t) -> bool {
+    size_t rank;
+    if (!(in >> t->name >> t->dtype >> rank)) return false;
+    if (!dtype_from_string(t->dtype, &t->info)) {
+      *err = "unknown dtype '" + t->dtype + "' in meta.txt";
+      return false;
+    }
+    t->dims.resize(rank);
+    for (size_t i = 0; i < rank; ++i)
+      if (!(in >> t->dims[i])) return false;
+    return true;
+  };
+  int version = 0;
+  size_t n = 0;
+  if (!(in >> tok >> version) || tok != "version" || version != 1) {
+    *err = "meta.txt: bad or missing 'version 1' header";
+    return false;
+  }
+  if (!(in >> tok >> n) || tok != "ninputs") {
+    *err = "meta.txt: missing ninputs";
+    return false;
+  }
+  for (size_t i = 0; i < n; ++i) {
+    TensorSpec t;
+    if (!(in >> tok) || tok != "in" || !parse_spec(&t)) {
+      if (err->empty()) *err = "meta.txt: bad input spec";
+      return false;
+    }
+    meta->inputs.push_back(std::move(t));
+  }
+  if (!(in >> tok >> n) || tok != "noutputs") {
+    *err = "meta.txt: missing noutputs";
+    return false;
+  }
+  for (size_t i = 0; i < n; ++i) {
+    TensorSpec t;
+    if (!(in >> tok) || tok != "out" || !parse_spec(&t)) {
+      if (err->empty()) *err = "meta.txt: bad output spec";
+      return false;
+    }
+    meta->outputs.push_back(std::move(t));
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// PJRT driver
+// ---------------------------------------------------------------------------
+
+struct Predictor {
+  void* dl = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;
+  PJRT_LoadedExecutable* exec = nullptr;
+  Meta meta;
+  std::vector<std::vector<char>> outputs;  // host copies after Run
+  std::string last_error;
+
+  ~Predictor() {
+    if (api != nullptr && exec != nullptr) {
+      PJRT_LoadedExecutable_Destroy_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+      a.executable = exec;
+      PJRT_Error* e = api->PJRT_LoadedExecutable_Destroy(&a);
+      if (e != nullptr) {
+        PJRT_Error_Destroy_Args d;
+        std::memset(&d, 0, sizeof(d));
+        d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+        d.error = e;
+        api->PJRT_Error_Destroy(&d);
+      }
+    }
+    if (api != nullptr && client != nullptr) {
+      PJRT_Client_Destroy_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+      a.client = client;
+      PJRT_Error* e = api->PJRT_Client_Destroy(&a);
+      if (e != nullptr) {
+        PJRT_Error_Destroy_Args d;
+        std::memset(&d, 0, sizeof(d));
+        d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+        d.error = e;
+        api->PJRT_Error_Destroy(&d);
+      }
+    }
+    if (dl != nullptr) dlclose(dl);
+  }
+
+  bool check(PJRT_Error* e, const char* where) {
+    if (e == nullptr) return true;
+    PJRT_Error_Message_Args m;
+    std::memset(&m, 0, sizeof(m));
+    m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    m.error = e;
+    api->PJRT_Error_Message(&m);
+    last_error = std::string(where) + ": " +
+                 std::string(m.message, m.message_size);
+    PJRT_Error_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = e;
+    api->PJRT_Error_Destroy(&d);
+    return false;
+  }
+
+  bool await_event(PJRT_Event* ev, const char* where) {
+    if (ev == nullptr) return true;
+    PJRT_Event_Await_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    a.event = ev;
+    PJRT_Error* e = api->PJRT_Event_Await(&a);
+    bool ok = check(e, where);
+    PJRT_Event_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    d.event = ev;
+    api->PJRT_Event_Destroy(&d);
+    return ok;
+  }
+
+  bool init(const std::string& bundle_dir, const std::string& plugin_path) {
+    bool ok = false;
+    std::string module = read_file(bundle_dir + "/module.stablehlo", &ok);
+    if (!ok) {
+      last_error = "cannot read " + bundle_dir + "/module.stablehlo";
+      return false;
+    }
+    std::string copts = read_file(bundle_dir + "/compile_options.pb", &ok);
+    if (!ok) {
+      last_error = "cannot read " + bundle_dir + "/compile_options.pb";
+      return false;
+    }
+    std::string meta_text = read_file(bundle_dir + "/meta.txt", &ok);
+    if (!ok) {
+      last_error = "cannot read " + bundle_dir + "/meta.txt";
+      return false;
+    }
+    std::string meta_err;
+    if (!parse_meta(meta_text, &meta, &meta_err)) {
+      last_error = meta_err;
+      return false;
+    }
+
+    dl = dlopen(plugin_path.c_str(), RTLD_NOW | RTLD_LOCAL);
+    if (dl == nullptr) {
+      last_error = std::string("dlopen failed: ") + dlerror();
+      return false;
+    }
+    using GetPjrtApiFn = const PJRT_Api* (*)();
+    auto get_api =
+        reinterpret_cast<GetPjrtApiFn>(dlsym(dl, "GetPjrtApi"));
+    if (get_api == nullptr) {
+      last_error = "plugin has no GetPjrtApi symbol";
+      return false;
+    }
+    api = get_api();
+    if (api == nullptr) {
+      last_error = "GetPjrtApi returned null";
+      return false;
+    }
+
+    {
+      PJRT_Plugin_Initialize_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+      if (!check(api->PJRT_Plugin_Initialize(&a), "Plugin_Initialize"))
+        return false;
+    }
+    {
+      PJRT_Client_Create_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+      if (!check(api->PJRT_Client_Create(&a), "Client_Create")) return false;
+      client = a.client;
+    }
+    {
+      PJRT_Client_AddressableDevices_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+      a.client = client;
+      if (!check(api->PJRT_Client_AddressableDevices(&a),
+                 "AddressableDevices"))
+        return false;
+      if (a.num_addressable_devices == 0) {
+        last_error = "no addressable devices";
+        return false;
+      }
+      device = a.addressable_devices[0];
+    }
+    {
+      PJRT_Program program;
+      std::memset(&program, 0, sizeof(program));
+      program.struct_size = PJRT_Program_STRUCT_SIZE;
+      program.code = module.data();
+      program.code_size = module.size();
+      program.format = "mlir";
+      program.format_size = 4;
+      PJRT_Client_Compile_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+      a.client = client;
+      a.program = &program;
+      a.compile_options = copts.data();
+      a.compile_options_size = copts.size();
+      if (!check(api->PJRT_Client_Compile(&a), "Client_Compile"))
+        return false;
+      exec = a.executable;
+    }
+    outputs.resize(meta.outputs.size());
+    return true;
+  }
+
+  // inputs: host pointers in meta.inputs order (dense, C-contiguous)
+  bool run(const void* const* input_data) {
+    const size_t nin = meta.inputs.size();
+    const size_t nout = meta.outputs.size();
+    std::vector<PJRT_Buffer*> in_bufs(nin, nullptr);
+    bool ok = true;
+
+    for (size_t i = 0; i < nin && ok; ++i) {
+      PJRT_Client_BufferFromHostBuffer_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+      a.client = client;
+      a.data = input_data[i];
+      a.type = meta.inputs[i].info.type;
+      a.dims = meta.inputs[i].dims.data();
+      a.num_dims = meta.inputs[i].dims.size();
+      a.host_buffer_semantics =
+          PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+      a.device = device;
+      ok = check(api->PJRT_Client_BufferFromHostBuffer(&a),
+                 "BufferFromHostBuffer");
+      if (ok) {
+        in_bufs[i] = a.buffer;
+        ok = await_event(a.done_with_host_buffer, "host buffer transfer");
+      }
+    }
+
+    std::vector<PJRT_Buffer*> out_bufs(nout, nullptr);
+    if (ok) {
+      PJRT_ExecuteOptions opts;
+      std::memset(&opts, 0, sizeof(opts));
+      opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+      PJRT_Buffer* const* arg_list = in_bufs.data();
+      PJRT_Buffer** out_list = out_bufs.data();
+      PJRT_Event* done = nullptr;
+      PJRT_LoadedExecutable_Execute_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+      a.executable = exec;
+      a.options = &opts;
+      a.argument_lists = &arg_list;
+      a.num_devices = 1;
+      a.num_args = nin;
+      a.output_lists = &out_list;
+      a.device_complete_events = &done;
+      ok = check(api->PJRT_LoadedExecutable_Execute(&a), "Execute");
+      if (ok) ok = await_event(done, "execute");
+    }
+
+    for (size_t i = 0; i < nout && ok; ++i) {
+      PJRT_Buffer_ToHostBuffer_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      a.src = out_bufs[i];
+      ok = check(api->PJRT_Buffer_ToHostBuffer(&a), "ToHostBuffer(size)");
+      if (!ok) break;
+      outputs[i].resize(a.dst_size);
+      a.dst = outputs[i].data();
+      ok = check(api->PJRT_Buffer_ToHostBuffer(&a), "ToHostBuffer") &&
+           await_event(a.event, "device-to-host copy");
+    }
+
+    for (PJRT_Buffer* b : in_bufs) {
+      if (b == nullptr) continue;
+      PJRT_Buffer_Destroy_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      a.buffer = b;
+      check(api->PJRT_Buffer_Destroy(&a), "Buffer_Destroy(in)");
+    }
+    for (PJRT_Buffer* b : out_bufs) {
+      if (b == nullptr) continue;
+      PJRT_Buffer_Destroy_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      a.buffer = b;
+      check(api->PJRT_Buffer_Destroy(&a), "Buffer_Destroy(out)");
+    }
+    return ok;
+  }
+};
+
+void set_err(char* err, size_t err_cap, const std::string& msg) {
+  if (err != nullptr && err_cap > 0) {
+    std::snprintf(err, err_cap, "%s", msg.c_str());
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// exported C ABI (pd_inference_api.h analog, PTPU_ prefix)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* PTPU_PredictorCreate(const char* bundle_dir, const char* plugin_path,
+                           char* err, size_t err_cap) {
+  auto* p = new Predictor();
+  if (!p->init(bundle_dir ? bundle_dir : "",
+               plugin_path ? plugin_path : "")) {
+    set_err(err, err_cap, p->last_error);
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+void PTPU_PredictorDestroy(void* h) { delete static_cast<Predictor*>(h); }
+
+size_t PTPU_PredictorNumInputs(void* h) {
+  return static_cast<Predictor*>(h)->meta.inputs.size();
+}
+
+size_t PTPU_PredictorNumOutputs(void* h) {
+  return static_cast<Predictor*>(h)->meta.outputs.size();
+}
+
+const char* PTPU_PredictorInputName(void* h, size_t i) {
+  auto* p = static_cast<Predictor*>(h);
+  return i < p->meta.inputs.size() ? p->meta.inputs[i].name.c_str() : "";
+}
+
+const char* PTPU_PredictorOutputName(void* h, size_t i) {
+  auto* p = static_cast<Predictor*>(h);
+  return i < p->meta.outputs.size() ? p->meta.outputs[i].name.c_str() : "";
+}
+
+const char* PTPU_PredictorInputDtype(void* h, size_t i) {
+  auto* p = static_cast<Predictor*>(h);
+  return i < p->meta.inputs.size() ? p->meta.inputs[i].dtype.c_str() : "";
+}
+
+// dims_out must hold PTPU_PredictorInputRank entries
+size_t PTPU_PredictorInputRank(void* h, size_t i) {
+  auto* p = static_cast<Predictor*>(h);
+  return i < p->meta.inputs.size() ? p->meta.inputs[i].dims.size() : 0;
+}
+
+void PTPU_PredictorInputDims(void* h, size_t i, int64_t* dims_out) {
+  auto* p = static_cast<Predictor*>(h);
+  if (i >= p->meta.inputs.size()) return;
+  const auto& d = p->meta.inputs[i].dims;
+  std::memcpy(dims_out, d.data(), d.size() * sizeof(int64_t));
+}
+
+size_t PTPU_PredictorInputByteSize(void* h, size_t i) {
+  auto* p = static_cast<Predictor*>(h);
+  return i < p->meta.inputs.size() ? p->meta.inputs[i].byte_size() : 0;
+}
+
+// ZeroCopyRun analog: inputs are host pointers in input order
+int PTPU_PredictorRun(void* h, const void* const* input_data,
+                      char* err, size_t err_cap) {
+  auto* p = static_cast<Predictor*>(h);
+  if (!p->run(input_data)) {
+    set_err(err, err_cap, p->last_error);
+    return -1;
+  }
+  return 0;
+}
+
+size_t PTPU_PredictorOutputByteSize(void* h, size_t i) {
+  auto* p = static_cast<Predictor*>(h);
+  return i < p->outputs.size() ? p->outputs[i].size() : 0;
+}
+
+int PTPU_PredictorOutputCopy(void* h, size_t i, void* dst, size_t cap) {
+  auto* p = static_cast<Predictor*>(h);
+  if (i >= p->outputs.size() || cap < p->outputs[i].size()) return -1;
+  std::memcpy(dst, p->outputs[i].data(), p->outputs[i].size());
+  return 0;
+}
+
+const char* PTPU_PredictorLastError(void* h) {
+  return static_cast<Predictor*>(h)->last_error.c_str();
+}
+
+}  // extern "C"
