@@ -54,6 +54,9 @@ from .sharding import (  # noqa: E402,F401
     shard_optimizer_states)
 from . import watchdog  # noqa: E402,F401
 from .watchdog import comm_watchdog  # noqa: E402,F401
+from . import pp_schedules  # noqa: E402,F401
+from .pp_schedules import (  # noqa: E402,F401
+    build_fb_schedule, pipeline_train_tables, schedule_report)
 from . import spmd_rules  # noqa: E402,F401
 from .spmd_rules import get_spmd_rule, DistTensorSpec  # noqa: E402,F401
 from . import auto_parallel  # noqa: E402,F401

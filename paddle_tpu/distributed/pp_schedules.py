@@ -1,0 +1,322 @@
+"""Pipeline F/B schedule tables: FThenB, 1F1B, Eager1F1B (+ bubble and
+peak-residency accounting) and a table-driven SPMD train engine.
+
+Reference counterparts: the dygraph runtime schedules
+(`fleet/meta_parallel/pipeline_parallel.py:1545` FThenB/Eager1F1B entry,
+`:150,440` 1F1B) and the static scheduler pass family
+(`passes/pipeline_scheduler_pass.py:47-465` — FThenB, 1F1B, Eager1F1B as
+job lists per stage).
+
+TPU-first reformulation: the reference executes these schedules as
+per-stage processes exchanging isend/irecv; here a schedule is an
+ahead-of-time table [T, S] of (phase, microbatch) driving ONE
+`lax.scan` inside `shard_map` over the `pp` axis. Forward ticks run the
+stage and stash VJP residuals in a slot buffer; backward ticks pop the
+slot, apply the VJP, accumulate parameter gradients, and rotate the
+cotangent backwards — so F and B interleave exactly as the table says,
+and the table's peak slot count IS the schedule's activation residency
+(the thing that distinguishes 1F1B from FThenB).
+
+The default training path (`pipeline.py` AD-through-scan) remains the
+fastest compiled engine; this module is the schedule-faithful engine the
+reference exposes as `pipeline_scheduler` choices, with grad parity
+tests against the AD engine (tests/test_pp_schedules.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+IDLE, FWD, BWD = 0, 1, 2
+SCHEDULES = ("FThenB", "1F1B", "Eager1F1B")
+
+
+def build_fb_schedule(S: int, M: int, kind: str = "1F1B"):
+    """Greedy event simulation of the classic schedules.
+
+    Dependencies: F(m) on stage d needs F(m) on d-1 finished (d>0);
+    B(m) on stage d needs F(m) locally + B(m) on d+1 finished (d<S-1).
+    Policies (reference pipeline_scheduler_pass.py semantics):
+      FThenB     — a stage never starts B before all its F are issued.
+      1F1B       — warmup S-d forwards, then strictly alternate 1F/1B;
+                   peak in-flight activations = min(M, S-d).
+      Eager1F1B  — warmup runs one extra forward deep (recv-ahead overlap,
+                   pipeline_parallel.py _forward_backward_pipeline eager
+                   mode), then alternates.
+
+    Returns dict: phase [T, S] (0/1/2), mb [T, S] (-1 or microbatch),
+    T, peak_live [S] (max residual slots alive per stage), bubble
+    (idle fraction over T*S*2-unit F+B work: 1 - 2M/ (T*S) since every
+    stage must run M F's and M B's).
+    """
+    if kind not in SCHEDULES:
+        raise ValueError(f"unknown schedule '{kind}' (have {SCHEDULES})")
+    f_done = np.full((M, S), -1, np.int64)    # finish tick of F(m, d)
+    b_done = np.full((M, S), -1, np.int64)
+    nf = [0] * S                               # forwards issued per stage
+    nb = [0] * S
+    phase_rows, mb_rows = [], []
+    t = 0
+    while min(nb) < M:
+        prow, mrow = [], []
+        for d in range(S):
+            # candidate F: next unissued microbatch whose upstream is done
+            can_f = (nf[d] < M
+                     and (d == 0 or f_done[nf[d], d - 1] >= 0
+                          and f_done[nf[d], d - 1] < t))
+            can_b = (nb[d] < M and nb[d] < nf[d]
+                     and (d == S - 1 or (b_done[nb[d], d + 1] >= 0
+                                         and b_done[nb[d], d + 1] < t)))
+            if kind == "FThenB":
+                run_f = can_f
+            elif kind == "1F1B":
+                warm = min(M, S - d)
+                run_f = can_f and (nf[d] < warm
+                                   or (nf[d] - nb[d] < warm and not can_b))
+            else:  # Eager1F1B: one deeper warmup
+                warm = min(M, S - d + 1)
+                run_f = can_f and (nf[d] < warm
+                                   or (nf[d] - nb[d] < warm and not can_b))
+            if run_f:
+                prow.append(FWD)
+                mrow.append(nf[d])
+                f_done[nf[d], d] = t
+                nf[d] += 1
+            elif can_b:
+                prow.append(BWD)
+                mrow.append(nb[d])
+                b_done[nb[d], d] = t
+                nb[d] += 1
+            else:
+                prow.append(IDLE)
+                mrow.append(-1)
+        phase_rows.append(prow)
+        mb_rows.append(mrow)
+        t += 1
+        if t > 8 * (M + S) * 2:
+            raise RuntimeError(f"{kind} schedule did not converge")
+    phase = np.asarray(phase_rows, np.int32)
+    mb = np.asarray(mb_rows, np.int32)
+    T = t
+
+    # residual-slot residency: F(m,d) allocates at its tick, B(m,d) frees
+    peak_live = []
+    for d in range(S):
+        live = peak = 0
+        for tt in range(T):
+            if phase[tt, d] == FWD:
+                live += 1
+                peak = max(peak, live)
+            elif phase[tt, d] == BWD:
+                live -= 1
+        peak_live.append(peak)
+    bubble = 1.0 - (2.0 * M * S) / (T * S)
+    return {"phase": phase, "mb": mb, "T": T,
+            "peak_live": peak_live, "bubble": bubble, "kind": kind}
+
+
+def schedule_report(S: int, M: int):
+    """Bubble fraction + peak activation residency for every schedule
+    (the numbers VERDICT r3 Next#9 asks to record)."""
+    out = {}
+    for kind in SCHEDULES:
+        s = build_fb_schedule(S, M, kind)
+        out[kind] = {"T": s["T"], "bubble": round(s["bubble"], 4),
+                     "peak_live": s["peak_live"]}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# table-driven train engine
+# ---------------------------------------------------------------------------
+
+def _stage_fn_builder(block_apply, remat):
+    def stage_fn(my_leaves, x, shared, key):
+        def body(carry, leaves):
+            xx, k = carry
+            k, sub = jax.random.split(k)
+            return (block_apply(leaves, xx, shared, sub), k), None
+        if remat:
+            body = jax.checkpoint(body)
+        (y, _), _ = jax.lax.scan(body, (x, key), my_leaves)
+        return y
+    return stage_fn
+
+
+def pipeline_train_tables(block_apply: Callable,
+                          stacked: Sequence[jax.Array],
+                          x_mb: jax.Array,
+                          shared: tuple,
+                          loss_fn: Callable[[jax.Array, int], jax.Array],
+                          mesh: Mesh,
+                          num_stages: int,
+                          num_micro: int,
+                          schedule: str = "1F1B",
+                          remat: bool = False,
+                          rng_key=None):
+    """Run one interleaved F/B pipeline step under `schedule`.
+
+    block_apply(leaves, x, shared, key) -> y   (one block, pure)
+    loss_fn(y, m) -> scalar  — per-microbatch criterion applied to the
+    LAST stage's output (the reference computes loss on the last stage
+    inside train_batch; the cotangent seeds B(m) immediately, which is
+    what makes 1F1B/Eager1F1B interleaving possible at all).
+
+    Returns (mean_loss, grads) where grads matches `stacked` in
+    structure ([L, ...] leaves, summed over microbatches).
+    """
+    S, M = num_stages, num_micro
+    sched = build_fb_schedule(S, M, schedule)
+    T = sched["T"]
+    B = max(sched["peak_live"])
+    phase_tbl = jnp.asarray(sched["phase"])
+    mb_tbl = jnp.asarray(sched["mb"])
+    U = P.UNCONSTRAINED
+    if rng_key is None:
+        rng_key = jax.random.key(0)
+    stage_fn = _stage_fn_builder(block_apply, remat)
+
+    def pipelined(leaves, x_mb, shared, rng_key):
+        my = tuple(l[0] for l in leaves)           # [nl, ...]
+        stage = jax.lax.axis_index("pp")
+        mb_shape = x_mb.shape[1:]
+        dt = x_mb.dtype
+        key0 = jax.random.fold_in(rng_key, stage)
+
+        # probe one vjp to learn the residual pytree structure (vjp
+        # closures are registered pytrees: flatten -> residual arrays)
+        def fwd_local(lv, x, key):
+            return stage_fn(lv, x, shared, key)
+
+        probe_key = jax.random.fold_in(key0, 0)
+        _, probe_vjp = jax.vjp(fwd_local, my, jnp.zeros(mb_shape, dt),
+                               probe_key)
+        res_leaves, res_tree = jax.tree_util.tree_flatten(probe_vjp)
+
+        slots0 = tuple(jnp.zeros((B,) + r.shape, r.dtype)
+                       for r in res_leaves)
+        slot_mb0 = jnp.full((B,), -1, jnp.int32)  # mb occupying each slot
+        grads0 = tuple(jnp.zeros_like(l) for l in my)
+        loss0 = jnp.zeros((), jnp.float32)
+        # parked ring arrivals, indexed by microbatch
+        f_park0 = jnp.zeros((M,) + mb_shape, dt)
+        b_park0 = jnp.zeros((M,) + mb_shape, dt)
+
+        def seed_grad(y, m_ix):
+            return jax.grad(
+                lambda yy: loss_fn(yy, m_ix).astype(jnp.float32))(y)
+
+        def tick(carry, xs):
+            slots, slot_mb, f_park, b_park, f_ring, b_ring, grads, loss = \
+                carry
+            t, ph_r, mb_r = xs
+            ph, m = ph_r[stage], mb_r[stage]
+            m_ix = jnp.clip(m, 0, M - 1)
+
+            # park arrivals sent last tick (stamp -1 = nothing)
+            f_src_m, f_act = f_ring
+            b_src_m, b_cot = b_ring
+            f_park = jnp.where(
+                f_src_m >= 0,
+                f_park.at[jnp.clip(f_src_m, 0, M - 1)].set(f_act), f_park)
+            b_park = jnp.where(
+                b_src_m >= 0,
+                b_park.at[jnp.clip(b_src_m, 0, M - 1)].set(b_cot), b_park)
+
+            state = (slots, slot_mb, b_park, grads, loss)
+
+            def do_fwd(state):
+                slots, slot_mb, b_park, grads, loss = state
+                x_in = jnp.where(stage == 0, x_mb[m_ix], f_park[m_ix])
+                key_t = jax.random.fold_in(key0, m_ix)
+                y, vjp_fn = jax.vjp(fwd_local, my, x_in, key_t)
+                new_res = jax.tree_util.tree_flatten(vjp_fn)[0]
+                free_slot = jnp.argmax(slot_mb < 0)
+                slots = tuple(s.at[free_slot].set(r)
+                              for s, r in zip(slots, new_res))
+                slot_mb = slot_mb.at[free_slot].set(m_ix)
+                last = stage == S - 1
+                loss = loss + jnp.where(
+                    last, loss_fn(y, m_ix).astype(jnp.float32), 0.0)
+                b_park = jnp.where(
+                    last,
+                    b_park.at[m_ix].set(seed_grad(y, m_ix).astype(dt)),
+                    b_park)
+                return (slots, slot_mb, b_park, grads, loss), y
+
+            def do_bwd(state):
+                slots, slot_mb, b_park, grads, loss = state
+                my_slot = jnp.argmax(slot_mb == m_ix)
+                res_here = [s[my_slot] for s in slots]
+                vjp_rebuilt = jax.tree_util.tree_unflatten(res_tree,
+                                                           res_here)
+                d_leaves, dx, _ = vjp_rebuilt(b_park[m_ix])
+                grads = tuple(g + dg for g, dg in zip(grads, d_leaves))
+                slot_mb = jnp.where(slot_mb == m_ix, -1, slot_mb)
+                return (slots, slot_mb, b_park, grads, loss), dx.astype(dt)
+
+            def do_idle(state):
+                return state, jnp.zeros(mb_shape, dt)
+
+            state, payload = jax.lax.switch(ph, (do_idle, do_fwd, do_bwd),
+                                            state)
+            slots, slot_mb, b_park, grads, loss = state
+
+            is_f = ph == FWD
+            is_b = ph == BWD
+            fwd_stamp = jnp.where(is_f & (stage < S - 1), m, -1)
+            bwd_stamp = jnp.where(is_b & (stage > 0), m, -1)
+            perm_f = [(i, (i + 1) % S) for i in range(S)]
+            perm_b = [(i, (i - 1) % S) for i in range(S)]
+            f_ring = (jax.lax.ppermute(fwd_stamp, "pp", perm_f),
+                      jax.lax.ppermute(
+                          jnp.where(is_f, payload,
+                                    jnp.zeros(mb_shape, dt)), "pp",
+                          perm_f))
+            b_ring = (jax.lax.ppermute(bwd_stamp, "pp", perm_b),
+                      jax.lax.ppermute(
+                          jnp.where(is_b, payload,
+                                    jnp.zeros(mb_shape, dt)), "pp",
+                          perm_b))
+            return (slots, slot_mb, f_park, b_park, f_ring, b_ring, grads,
+                    loss), None
+
+        carry0 = (slots0, slot_mb0, f_park0, b_park0,
+                  (jnp.int32(-1), jnp.zeros(mb_shape, dt)),
+                  (jnp.int32(-1), jnp.zeros(mb_shape, dt)),
+                  grads0, loss0)
+        (_, _, _, _, _, _, grads, loss), _ = jax.lax.scan(
+            tick, carry0, (jnp.arange(T), phase_tbl, mb_tbl))
+
+        loss = jax.lax.psum(jnp.where(stage == S - 1, loss, 0.0), "pp") / M
+        return (loss,) + grads
+
+    smapped = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=(P(),) + tuple(P("pp") for _ in stacked),
+        axis_names=frozenset({"pp"}),
+        check_vma=False,
+    )
+
+    def run(stacked_t, x_mb, shared, rng_key):
+        st = tuple(
+            jax.lax.with_sharding_constraint(
+                a.reshape((S, a.shape[0] // S) + a.shape[1:]),
+                jax.sharding.NamedSharding(mesh, P("pp", *([U] * a.ndim))))
+            for a in stacked_t)
+        outs = smapped(st, x_mb, shared, rng_key)
+        # grads come back [S*nl, ...] == [L, ...] (pp axis concatenated);
+        # mean-over-microbatch semantics for BOTH loss and grads, matching
+        # the reference train_batch's 1/accumulate_steps scaling
+        return outs[0], tuple(g / M for g in outs[1:])
+
+    return jax.jit(run)(tuple(stacked), x_mb, shared, rng_key)
