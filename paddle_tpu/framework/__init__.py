@@ -1,8 +1,25 @@
 """Framework-level utilities: save/load (reference python/paddle/framework/
-io.py:721 paddle.save, :960 paddle.load — pickled state dicts)."""
+io.py:721 paddle.save, :960 paddle.load — pickled state dicts), including a
+one-way reader for UPSTREAM `.pdparams`/`.pdopt` artifacts (VERDICT r3
+Next#6: migration without re-saving from source).
+
+Reference layout (io.py `_pickle_save:355`): a plain pickle whose Tensors
+were reduced via `reduce_varbase` to `(tuple, ((name, ndarray),))` — they
+unpickle as `(name, ndarray)` tuples with no paddle imports — and whose
+LoDTensors were reduced to `(eval, ('data', {'data': ndarray}))`; arrays
+over 2**30 bytes are split into `key@@.i` slices indexed by an
+`UnpackBigParamInfor@@` entry (io_utils.py:234). `load()` detects the
+unambiguous reference signatures ((name, ndarray) tuples, the chunk
+marker) and restores Tensors; unpickling runs under an allowlisting
+Unpickler (numpy reconstructors + the exact builtins the reference's
+reducers emit) with a plain-pickle fallback for checkpoints holding
+other user classes — pass `safe_load=True` for untrusted files to
+forbid that fallback.
+"""
 
 from __future__ import annotations
 
+import io as _io
 import os
 import pickle
 from typing import Any, Dict
@@ -57,7 +74,146 @@ def save(obj: Any, path: str, protocol: int = 4):
         pickle.dump(_to_host(obj), f, protocol=protocol)
 
 
-def load(path: str, return_numpy: bool = False):
+class _SafeEval:
+    """Stand-in for the reference's `reduce_LoDTensor` target
+    `(eval, ('data', {'data': ndarray}))`: evaluating the literal name
+    'data' in that globals dict just returns the array — reproduce that
+    without exposing real eval to the pickle stream."""
+
+    def __call__(self, expr, glb=None):
+        if expr == "data" and isinstance(glb, dict) and "data" in glb:
+            return glb["data"]
+        raise pickle.UnpicklingError(
+            f"refusing eval of {expr!r} from checkpoint")
+
+
+_ALLOWED_GLOBALS = {
+    # protocol 2 writes the py2-era "__builtin__" module name
+    ("__builtin__", "tuple"): tuple,
+    ("__builtin__", "eval"): _SafeEval(),
+    ("builtins", "tuple"): tuple,
+    ("builtins", "list"): list,
+    ("builtins", "dict"): dict,
+    ("builtins", "set"): set,
+    ("builtins", "frozenset"): frozenset,
+    ("builtins", "bytearray"): bytearray,
+    ("builtins", "complex"): complex,
+    ("builtins", "slice"): slice,
+    ("builtins", "eval"): _SafeEval(),     # reference reduce_LoDTensor
+    ("collections", "OrderedDict"): __import__("collections").OrderedDict,
+    # numpy's protocol-2 reconstruction encodes array bytes via _codecs
+    ("_codecs", "encode"): __import__("_codecs").encode,
+}
+
+
+class _CheckpointUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module in ("numpy", "numpy.core.multiarray",
+                      "numpy._core.multiarray", "numpy.core.numeric",
+                      "numpy._core.numeric", "numpy.dtypes"):
+            return super().find_class(module, name)
+        if (module, name) == ("paddle_tpu.framework", "_TensorPayload"):
+            return _TensorPayload       # our own save() marker, data-only
+        hit = _ALLOWED_GLOBALS.get((module, name))
+        if hit is not None:
+            return hit
+        raise pickle.UnpicklingError(
+            f"checkpoint requests disallowed global {module}.{name}")
+
+
+def _pack_loaded_dict(obj):
+    """Rejoin `key@@.i` slices (reference io_utils.py:216)."""
+    info_key = "UnpackBigParamInfor@@"
+    if isinstance(obj, dict) and info_key in obj:
+        removes = []
+        for key, value in obj[info_key].items():
+            # slices are bare flattened ndarrays; tolerate the varbase
+            # (name, ndarray) form too
+            slices = [obj[part] for part in value["slices"]]
+            slices = [s[1] if isinstance(s, tuple) and len(s) == 2 else s
+                      for s in slices]
+            obj[key] = np.concatenate(
+                [np.asarray(s) for s in slices]).reshape(
+                    value["OriginShape"])
+            removes += value["slices"]
+        for key in removes:
+            obj.pop(key)
+        obj.pop(info_key)
+    return obj
+
+
+def _looks_like_reference_obj(obj) -> bool:
+    """True when the pickle carries the reference save()'s UNAMBIGUOUS
+    signatures: a `(name, ndarray)` varbase reduction or the big-param
+    chunk marker. Bare ndarrays are NOT a signal — this framework's own
+    save() round-trips plain numpy data unchanged, and legacy static-save
+    dicts of bare arrays still feed set_state_dict directly."""
+    if isinstance(obj, dict):
+        if "UnpackBigParamInfor@@" in obj:
+            return True
+        return any(_looks_like_reference_obj(v) for v in obj.values())
+    if isinstance(obj, tuple) and len(obj) == 2 \
+            and isinstance(obj[0], str) and isinstance(obj[1], np.ndarray):
+        return True
+    if isinstance(obj, (list, tuple)):
+        return any(_looks_like_reference_obj(v) for v in obj)
+    return False
+
+
+def _from_reference(obj, return_numpy=False):
+    """Reference load-result parsing (io.py:576 _parse_load_result):
+    (name, ndarray) -> Tensor named `name`; bare ndarray -> Tensor."""
+    if (isinstance(obj, tuple) and len(obj) == 2
+            and isinstance(obj[0], str) and isinstance(obj[1], np.ndarray)):
+        if return_numpy:
+            return obj[1]
+        t = Tensor(obj[1])
+        t.name = obj[0]
+        return t
+    if isinstance(obj, np.ndarray):
+        return obj if return_numpy else Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _from_reference(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_reference(v, return_numpy) for v in obj)
+    return obj
+
+
+def load(path: str, return_numpy: bool = False, safe_load: bool = False):
+    """paddle.load: reads both our own artifacts and upstream
+    `.pdparams`/`.pdopt` pickles (see module docstring for the format).
+
+    Deserialization tries the allowlisting unpickler first — it covers
+    every state-dict-shaped artifact (ours and the reference's) without
+    exposing arbitrary imports. Checkpoints containing other user
+    classes fall back to plain pickle, the reference's own trust model
+    (`io.py:1104` unpickles with no restriction): a checkpoint you load
+    is code you chose to run. Pass `safe_load=True` for UNTRUSTED files
+    to forbid the fallback — state dicts still load, anything requesting
+    a non-allowlisted global raises instead of executing."""
     with open(path, "rb") as f:
-        obj = pickle.load(f)
+        try:
+            obj = _CheckpointUnpickler(f).load()
+        except pickle.UnpicklingError as e:
+            if safe_load or "disallowed global" not in str(e):
+                raise
+            f.seek(0)
+            obj = pickle.load(f)
+    had_chunk_marker = (isinstance(obj, dict)
+                        and "UnpackBigParamInfor@@" in obj)
+    obj = _pack_loaded_dict(obj)
+    if _contains_payload(obj):
+        return _from_host(obj, return_numpy)
+    if had_chunk_marker or _looks_like_reference_obj(obj):
+        return _from_reference(obj, return_numpy)
     return _from_host(obj, return_numpy)
+
+
+def _contains_payload(obj) -> bool:
+    if isinstance(obj, _TensorPayload):
+        return True
+    if isinstance(obj, dict):
+        return any(_contains_payload(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(_contains_payload(v) for v in obj)
+    return False

@@ -1,0 +1,153 @@
+"""Loading UPSTREAM-format checkpoints (VERDICT r3 Next#6).
+
+The golden files are produced by replicating the reference's own pickle
+reducers byte-for-byte (`io.py:367 reduce_varbase` emits
+`(tuple, ((name, ndarray),))`; `:374 reduce_LoDTensor` emits
+`(eval, ('data', {'data': ndarray}))`; `io_utils.py:234
+_unpack_saved_dict` splits big arrays into `key@@.i` slices) — the same
+streams `paddle.save` writes for a state dict, without needing the
+reference runtime in-process.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class _RefVarBase:
+    """Pickles exactly like a reference Tensor under reduce_varbase."""
+
+    def __init__(self, name, data):
+        self.name, self.data = name, data
+
+    def __reduce__(self):
+        return (tuple, ((self.name, self.data),))
+
+
+class _SchedState:
+    """Module-level so our save()'s plain pickle can serialize it."""
+
+    def __init__(self, step):
+        self.step = step
+
+
+class _RefLoDTensor:
+    def __init__(self, data):
+        self.data = data
+
+    def __reduce__(self):
+        return (eval, ("data", {"data": self.data}))
+
+
+def _write(path, obj, protocol=4):
+    with open(path, "wb") as f:
+        pickle.dump(obj, f, protocol=protocol)
+
+
+class TestReferenceFormatLoad:
+    def test_varbase_state_dict_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(0)
+        w = rng.randn(4, 3).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        p = str(tmp_path / "lin.pdparams")
+        _write(p, {"weight": _RefVarBase("linear_0.w_0", w),
+                   "bias": _RefVarBase("linear_0.b_0", b)})
+        sd = paddle.load(p)
+        np.testing.assert_array_equal(sd["weight"].numpy(), w)
+        np.testing.assert_array_equal(sd["bias"].numpy(), b)
+        assert sd["weight"].name == "linear_0.w_0"
+        lin = nn.Linear(4, 3)
+        lin.set_state_dict(sd)
+        np.testing.assert_array_equal(lin.weight.numpy(), w)
+
+    def test_lodtensor_and_numpy_leaves(self, tmp_path):
+        """Legacy static-save layout: {name: ndarray} (the LoDTensor
+        reduction unpickles straight to ndarray). Bare ndarrays are
+        deliberately NOT wrapped into Tensors — they are ambiguous with
+        this framework's own numpy round-trips — and set_state_dict
+        accepts arrays directly, so the migration path holds."""
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        p = str(tmp_path / "static.pdparams")
+        _write(p, {"fc.w_0": _RefLoDTensor(arr), "fc.b_0": arr[0]})
+        sd = paddle.load(p)
+        np.testing.assert_array_equal(np.asarray(sd["fc.w_0"]), arr)
+        np.testing.assert_array_equal(np.asarray(sd["fc.b_0"]), arr[0])
+
+    def test_chunked_big_param_reassembly(self, tmp_path):
+        """`key@@.i` slices + UnpackBigParamInfor@@ (io_utils.py:234)."""
+        big = np.arange(20, dtype=np.float32).reshape(4, 5)
+        flat = big.flatten()
+        # slices are stored as BARE ndarrays (io_utils.py:260 writes the
+        # flattened numpy slices directly)
+        obj = {
+            "emb@@.0": flat[:12],
+            "emb@@.1": flat[12:],
+            "UnpackBigParamInfor@@": {
+                "emb": {"OriginShape": big.shape,
+                        "slices": ["emb@@.0", "emb@@.1"]},
+            },
+        }
+        p = str(tmp_path / "big.pdparams")
+        _write(p, obj, protocol=2)
+        sd = paddle.load(p)
+        assert set(sd) == {"emb"}
+        np.testing.assert_array_equal(sd["emb"].numpy(), big)
+
+    def test_pdopt_nested_structure(self, tmp_path):
+        m = np.ones((2, 2), np.float32)
+        obj = {"LR_Scheduler": {"last_epoch": 3, "last_lr": 0.01},
+               "moment1_0": _RefVarBase("moment1_0", m)}
+        p = str(tmp_path / "opt.pdopt")
+        _write(p, obj)
+        sd = paddle.load(p)
+        assert sd["LR_Scheduler"]["last_epoch"] == 3
+        np.testing.assert_array_equal(sd["moment1_0"].numpy(), m)
+
+    def test_own_format_still_roundtrips(self, tmp_path):
+        lin = nn.Linear(3, 2)
+        p = str(tmp_path / "ours.pdparams")
+        paddle.save(lin.state_dict(), p)
+        sd = paddle.load(p)
+        lin2 = nn.Linear(3, 2)
+        lin2.set_state_dict(sd)
+        np.testing.assert_array_equal(lin.weight.numpy(),
+                                      lin2.weight.numpy())
+
+    def test_safe_load_rejects_hostile_pickle(self, tmp_path):
+        class Evil:
+            def __reduce__(self):
+                return (__import__("os").system, ("true",))
+
+        p = str(tmp_path / "evil.pdparams")
+        _write(p, {"x": Evil()})
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            paddle.load(p, safe_load=True)
+
+    def test_real_eval_not_reachable(self, tmp_path):
+        """The reference's reduce_LoDTensor target is builtins.eval; the
+        allowlisted stand-in must only replay the ('data', {'data': ...})
+        form, never evaluate attacker expressions — with or without
+        safe_load (the eval stand-in is what the restricted pass uses)."""
+        class SneakyEval:
+            def __reduce__(self):
+                return (eval, ("__import__('os').getpid()",))
+
+        p = str(tmp_path / "sneaky.pdparams")
+        _write(p, {"x": SneakyEval()})
+        with pytest.raises(pickle.UnpicklingError, match="refusing eval"):
+            paddle.load(p, safe_load=True)
+
+    def test_own_arbitrary_objects_round_trip(self, tmp_path):
+        """Our save() accepts arbitrary picklable state (e.g. custom LR
+        scheduler objects); default load() must round-trip them — the
+        allowlist applies strictly only under safe_load=True."""
+        p = str(tmp_path / "sched.pdparams")
+        paddle.save({"sched": _SchedState(7), "w": paddle.to_tensor(
+            np.ones((2,), np.float32))}, p)
+        out = paddle.load(p)
+        assert out["sched"].step == 7
+        np.testing.assert_array_equal(out["w"].numpy(), np.ones(2))
