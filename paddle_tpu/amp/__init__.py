@@ -203,3 +203,4 @@ class GradScaler:
         self._bad_steps = sd["bad"]
 
 from . import debugging  # noqa: E402,F401
+from . import accuracy_compare  # noqa: E402,F401

@@ -1,0 +1,164 @@
+"""AMP accuracy comparison — the run-comparison reporter.
+
+Reference: `python/paddle/amp/accuracy_compare.py` (TensorInfo /
+MixedPrecisionTensorInfo over FLAGS_check_nan_inf log dirs, merged into an
+Excel workbook flagging where the low-precision run went infinite or
+diverged).
+
+TPU-first reshape: instead of parsing printed debug logs, the collector
+hooks the dispatcher (`set_tensor_stats_hook`) and records a TensorInfo
+per eager op output, dumped as JSONL — one directory per run. The
+comparer merges two run dirs by tensor key, grades each pair
+(infinite-in-low-precision / diverged / allclose), and writes a JSON
+report (the workbook analog; no xlsxwriter in the image).
+
+Workflow (mirrors the reference docstring's fp32-vs-fp16 flow):
+
+    with collect_tensor_infos("dump_fp32"):
+        model(x)
+    with paddle.amp.auto_cast(dtype="bfloat16"), \
+         collect_tensor_infos("dump_bf16"):
+        model(x)
+    rows = compare_accuracy("dump_fp32", "dump_bf16", "report.json")
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from collections import defaultdict
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["TensorInfo", "collect_tensor_infos", "compare_accuracy"]
+
+
+@dataclass
+class TensorInfo:
+    """Per-op-output statistics (reference accuracy_compare.TensorInfo)."""
+    op_type: str
+    tensor_name: str
+    dtype: str
+    numel: int
+    max_value: float
+    min_value: float
+    mean_value: float
+    num_inf: int
+    num_nan: int
+    num_zero: int
+
+    @property
+    def key(self) -> str:
+        return f"{self.op_type}:{self.tensor_name}"
+
+
+def _info_of(op_type: str, name: str, arr) -> Optional[TensorInfo]:
+    if not jnp.issubdtype(arr.dtype, jnp.inexact):
+        return None
+    a = np.asarray(arr, np.float64)
+    finite = a[np.isfinite(a)]
+    return TensorInfo(
+        op_type=op_type,
+        tensor_name=name,
+        dtype=str(arr.dtype),
+        numel=int(a.size),
+        max_value=float(finite.max()) if finite.size else float("nan"),
+        min_value=float(finite.min()) if finite.size else float("nan"),
+        mean_value=float(finite.mean()) if finite.size else float("nan"),
+        num_inf=int(np.isinf(a).sum()),
+        num_nan=int(np.isnan(a).sum()),
+        num_zero=int((a == 0).sum()),
+    )
+
+
+@contextlib.contextmanager
+def collect_tensor_infos(dump_dir: str,
+                         specified_op_list: Optional[list] = None):
+    """Record a TensorInfo for every eager op output into
+    `dump_dir/tensor_info.jsonl`. Op call sites are disambiguated with a
+    per-op sequence number (op#k:out_i), which is what lets two runs of
+    the SAME code be merged positionally."""
+    from ..ops import dispatcher
+
+    os.makedirs(dump_dir, exist_ok=True)
+    infos: List[TensorInfo] = []
+    seq: Dict[str, int] = defaultdict(int)
+
+    def hook(schema, out_arrays):
+        if specified_op_list and schema.name not in specified_op_list:
+            return
+        k = seq[schema.name]
+        seq[schema.name] += 1
+        for i, arr in enumerate(out_arrays):
+            info = _info_of(schema.name, f"{schema.name}#{k}:out{i}", arr)
+            if info is not None:
+                infos.append(info)
+
+    prev = dispatcher._TENSOR_STATS_HOOK
+    dispatcher.set_tensor_stats_hook(hook)
+    try:
+        yield infos
+    finally:
+        dispatcher.set_tensor_stats_hook(prev)
+        with open(os.path.join(dump_dir, "tensor_info.jsonl"), "w") as f:
+            for info in infos:
+                f.write(json.dumps(asdict(info)) + "\n")
+
+
+def _load_run(dump_dir: str) -> Dict[str, TensorInfo]:
+    path = os.path.join(dump_dir, "tensor_info.jsonl")
+    out: Dict[str, TensorInfo] = {}
+    with open(path) as f:
+        for line in f:
+            info = TensorInfo(**json.loads(line))
+            out[info.key] = info
+    return out
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str, loss_scale: float = 1.0,
+                     dump_all_tensors: bool = False) -> List[dict]:
+    """Merge two collect_tensor_infos dumps (convention: first = fp32
+    reference run, second = low-precision run) and write the graded
+    report. Grades per tensor (reference MixedPrecisionTensorInfo
+    _check_normal semantics):
+
+      infinite  — low-precision run produced inf/nan the fp32 run didn't
+      diverged  — finite but max/min/mean outside rtol 1e-2 of fp32
+      ok        — within tolerance
+    """
+    ref_run = _load_run(dump_path)
+    low_run = _load_run(another_dump_path)
+    rows: List[dict] = []
+    for key in sorted(set(ref_run) | set(low_run)):
+        a, b = ref_run.get(key), low_run.get(key)
+        if a is None or b is None:
+            rows.append({"tensor": key, "grade": "missing",
+                         "present_in": "fp32" if a else "low"})
+            continue
+        if (b.num_inf + b.num_nan) > (a.num_inf + a.num_nan):
+            grade = "infinite"
+        else:
+            def close(x, y):
+                if np.isnan(x) and np.isnan(y):
+                    return True
+                return bool(np.isclose(x, y, rtol=1e-2, atol=1e-2))
+
+            grade = "ok" if (close(a.max_value, b.max_value)
+                             and close(a.min_value, b.min_value)
+                             and close(a.mean_value, b.mean_value)) \
+                else "diverged"
+        if grade == "ok" and not dump_all_tensors:
+            continue
+        rows.append({
+            "tensor": key, "grade": grade,
+            "fp32": asdict(a), "low": asdict(b),
+        })
+    with open(output_filename, "w") as f:
+        json.dump(rows, f, indent=1)
+    return rows

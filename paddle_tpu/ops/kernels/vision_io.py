@@ -1,0 +1,47 @@
+"""Vision IO ops: read_file / decode_jpeg.
+
+Reference: `paddle/phi/kernels/gpu/decode_jpeg_kernel.cu:1` (nvjpeg
+decode to a CHW uint8 DenseTensor) and the `read_file` op returning the
+raw byte stream as a 1-D uint8 tensor. TPU has no on-device JPEG engine;
+these are host ops (`jit: false`) — decode on host, feed the result to
+the device pipeline (the same place the reference's DALI-less path does
+its CPU decode)."""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..dispatcher import register_kernel
+
+
+@register_kernel("read_file")
+def read_file_kernel(filename: str = ""):
+    with open(filename, "rb") as f:
+        data = f.read()
+    return jnp.asarray(np.frombuffer(data, np.uint8))
+
+
+@register_kernel("decode_jpeg")
+def decode_jpeg_kernel(x, mode: str = "unchanged"):
+    """x: 1-D uint8 byte stream -> CHW uint8 (reference decode_jpeg
+    layout). mode: 'unchanged' | 'gray' | 'rgb' (reference accepts the
+    nvjpeg output-format names)."""
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(np.asarray(x, np.uint8).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb" and img.mode != "RGB":
+        img = img.convert("RGB")       # grayscale JPEGs expand to 3ch
+    elif mode == "unchanged" and img.mode not in ("RGB", "L"):
+        img = img.convert("RGB")       # exotic modes (CMYK, P) normalize
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]               # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+    return jnp.asarray(arr)

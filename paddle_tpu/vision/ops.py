@@ -9,7 +9,23 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["box_area", "box_iou", "nms", "deform_conv2d"]
+__all__ = ["box_area", "box_iou", "nms", "deform_conv2d", "read_file",
+           "decode_jpeg"]
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a 1-D uint8 Tensor (reference
+    paddle.vision.ops.read_file)."""
+    from ..ops.dispatcher import call_op
+    return call_op("read_file", filename=str(filename))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG byte stream -> CHW uint8 Tensor (reference decode_jpeg over
+    nvjpeg, `paddle/phi/kernels/gpu/decode_jpeg_kernel.cu:1`; host PIL
+    decode here — see ops/kernels/vision_io.py)."""
+    from ..ops.dispatcher import call_op
+    return call_op("decode_jpeg", _t(x), mode=mode)
 
 
 def _t(x):

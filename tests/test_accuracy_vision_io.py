@@ -1,0 +1,97 @@
+"""Round-4 long tail: vision IO ops (read_file/decode_jpeg) + the AMP
+accuracy_compare run reporter (VERDICT r3 Missing#6/Next#10)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestVisionIO:
+    def _jpeg(self, tmp_path, shape=(12, 10, 3)):
+        from PIL import Image
+        arr = (np.arange(np.prod(shape)) % 255).astype(np.uint8)
+        arr = arr.reshape(shape)
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(arr).save(p, quality=95)
+        return p
+
+    def test_read_file_bytes_golden(self, tmp_path):
+        p = str(tmp_path / "raw.bin")
+        payload = bytes(range(256))
+        open(p, "wb").write(payload)
+        t = paddle.vision.ops.read_file(p)
+        assert str(t.dtype) == "uint8"
+        np.testing.assert_array_equal(t.numpy(),
+                                      np.frombuffer(payload, np.uint8))
+
+    def test_decode_jpeg_matches_pil(self, tmp_path):
+        from PIL import Image
+        p = self._jpeg(tmp_path)
+        raw = paddle.vision.ops.read_file(p)
+        img = paddle.vision.ops.decode_jpeg(raw)
+        ref = np.asarray(Image.open(p).convert("RGB")).transpose(2, 0, 1)
+        assert img.shape == [3, 12, 10]
+        np.testing.assert_array_equal(img.numpy(), ref)
+
+    def test_decode_jpeg_gray_mode(self, tmp_path):
+        p = self._jpeg(tmp_path)
+        raw = paddle.vision.ops.read_file(p)
+        g = paddle.vision.ops.decode_jpeg(raw, mode="gray")
+        assert g.shape[0] == 1 and str(g.dtype) == "uint8"
+
+
+class TestAccuracyCompare:
+    def test_fp32_vs_bf16_report(self, tmp_path):
+        from paddle_tpu.amp.accuracy_compare import (collect_tensor_infos,
+                                                     compare_accuracy)
+        paddle.seed(0)
+        lin = nn.Linear(8, 8)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+
+        d32 = str(tmp_path / "fp32")
+        with collect_tensor_infos(d32) as infos:
+            y = lin(x)
+            paddle.exp(y * 0.01)
+        assert infos and any(i.op_type in ("matmul", "linear")
+                             for i in infos)
+
+        dlow = str(tmp_path / "bf16")
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            with collect_tensor_infos(dlow):
+                y = lin(x)
+                paddle.exp(y * 0.01)
+
+        report = str(tmp_path / "report.json")
+        rows = compare_accuracy(d32, dlow, report, dump_all_tensors=True)
+        assert rows and json.load(open(report)) == rows
+        by_grade = {r["grade"] for r in rows}
+        assert by_grade <= {"ok", "diverged", "infinite", "missing"}
+        # the linear matmul ran in bf16 under auto_cast: dtype per run
+        mm = [r for r in rows
+              if (r["tensor"].startswith("matmul")
+                  or r["tensor"].startswith("linear")) and "fp32" in r]
+        assert mm and mm[0]["low"]["dtype"] == "bfloat16"
+        assert mm[0]["fp32"]["dtype"] == "float32"
+
+    def test_overflow_flagged_infinite(self, tmp_path):
+        from paddle_tpu.amp.accuracy_compare import (collect_tensor_infos,
+                                                     compare_accuracy)
+        # exp(12) = 162754: finite in fp32, overflows fp16's 65504 max
+        big = paddle.to_tensor(np.full((4,), 12.0, np.float32))
+        d32 = str(tmp_path / "a")
+        with collect_tensor_infos(d32):
+            paddle.exp(big)
+        dlow = str(tmp_path / "b")
+        low = big.astype("float16")
+        with collect_tensor_infos(dlow):
+            paddle.exp(low)
+        rows = compare_accuracy(d32, dlow, str(tmp_path / "r.json"),
+                                dump_all_tensors=True)
+        grades = {r["tensor"].split(":")[0].split("#")[0]: r["grade"]
+                  for r in rows if "grade" in r}
+        assert "infinite" in grades.values() or "missing" in grades.values()
