@@ -23,6 +23,7 @@ import os
 import queue
 import threading
 import traceback
+import warnings
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -235,13 +236,41 @@ class _WorkerPool:
 
     def __init__(self, dataset, collate_fn, num_workers, worker_init_fn,
                  prefetch_factor, timeout):
-        # fork keeps the dataset un-pickled and matches the reference's
-        # Linux default; workers only touch numpy, never the device runtime
-        ctx = mp.get_context(
-            os.environ.get("PADDLE_TPU_WORKER_START_METHOD", "fork"))
+        # forkserver by default: os.fork() of a JAX process is a latent
+        # deadlock (JAX is multithreaded and warns on fork); the forkserver
+        # parent is exec'd clean, so its forks are safe. forkserver needs
+        # picklable dataset/collate_fn/worker_init_fn — detected at the
+        # FIRST worker start (no throwaway full serialization of a
+        # possibly-huge dataset), falling back to fork (the reference's
+        # Linux default) with a warning.
         self.num_workers = num_workers
         self.timeout = timeout or None
         self.prefetch = prefetch_factor
+        method = os.environ.get("PADDLE_TPU_WORKER_START_METHOD",
+                                "forkserver")
+        try:
+            self._spawn_workers(method, dataset, collate_fn,
+                                worker_init_fn, num_workers)
+        except (TypeError, AttributeError, ImportError,
+                __import__("pickle").PicklingError) as e:
+            # pickling the worker args failed; only fork can share them
+            if method == "fork":
+                raise
+            warnings.warn(
+                f"DataLoader dataset/collate_fn/worker_init_fn is not "
+                f"picklable ({e}); falling back to fork-started workers "
+                f"(unsafe in multithreaded processes). Make them "
+                f"module-level to use the safe forkserver start method.",
+                RuntimeWarning)
+            self._spawn_workers("fork", dataset, collate_fn,
+                                worker_init_fn, num_workers)
+        self._closed = False
+        self._epoch = 0
+        atexit.register(self.shutdown)
+
+    def _spawn_workers(self, method, dataset, collate_fn, worker_init_fn,
+                       num_workers):
+        ctx = mp.get_context(method)
         self.data_q = ctx.Queue()
         self.index_qs = [ctx.Queue() for _ in range(num_workers)]
         base_seed = int(np.random.randint(0, 2 ** 31))
@@ -252,11 +281,13 @@ class _WorkerPool:
                 args=(dataset, self.index_qs[w], self.data_q, collate_fn,
                       worker_init_fn, w, num_workers, base_seed),
                 daemon=True)
-            p.start()
+            try:
+                p.start()
+            except Exception:
+                for q in self.procs:
+                    q.terminate()
+                raise
             self.procs.append(p)
-        self._closed = False
-        self._epoch = 0
-        atexit.register(self.shutdown)
 
     def run_epoch(self, index_iter):
         """Generator over collated batches, in sampler order. Messages carry
