@@ -324,3 +324,129 @@ def make_crnn_step(batch: int, height: int = 32, width: int = 320,
         return (p_, v_), loss
 
     return step, (p, jax.tree.map(jnp.zeros_like, p))
+
+
+# --------------------------------------------------------------------------
+# DBNet det (PP-OCR config 4 det half) — conv backbone + FPN + DB head
+# --------------------------------------------------------------------------
+
+def make_dbnet_step(batch: int, size: int = 320, scale: float = 0.5,
+                    fpn: int = 96, lr: float = 0.05, dtype=jnp.float32):
+    """Mirrors paddle_tpu.models.ocr.DBNet exactly (stem + 4 ConvBN
+    stages at strides 2, 1x1 FPN laterals + top-down nearest upsample +
+    3x3 smoothing to fpn/4 channels, two DB-head branches of
+    conv-bn-relu-convT-bn-relu-convT-sigmoid) and the DBLoss (BCE +
+    alpha*masked-L1 + beta*dice), Momentum update — so the det train
+    ratio compares identical compute."""
+    key = jax.random.PRNGKey(0)
+    k = iter(jax.random.split(key, 64))
+    c = [int(ch * scale) for ch in (32, 64, 128, 256, 512)]
+    p: Dict[str, jnp.ndarray] = {}
+
+    def conv_w(name, ci, co, kh):
+        p[name + "_w"] = (jax.random.normal(next(k), (co, ci, kh, kh),
+                                            dtype)
+                          * (2 / (ci * kh * kh)) ** 0.5)
+
+    def convbn(name, ci, co, kh):
+        conv_w(name, ci, co, kh)
+        p[name + "_s"] = jnp.ones((co,), dtype)
+        p[name + "_b"] = jnp.zeros((co,), dtype)
+
+    convbn("stem", 3, c[0], 3)
+    stages = [(c[0], c[1]), (c[1], c[2]), (c[2], c[3]), (c[3], c[4])]
+    for i, (ci, co) in enumerate(stages):
+        convbn(f"s{i}a", ci, co, 3)
+        convbn(f"s{i}b", co, co, 3)
+    for i, ci in enumerate(c[1:]):
+        conv_w(f"lat{i}", ci, fpn, 1)
+        conv_w(f"sm{i}", fpn, fpn // 4, 3)
+    hc = fpn // 4
+    for br in ("prob", "thresh"):
+        convbn(f"{br}0", fpn, hc, 3)
+        # ConvTranspose weights [cin, cout, kh, kw] (IOHW)
+        p[f"{br}1_w"] = (jax.random.normal(next(k), (hc, hc, 2, 2), dtype)
+                         * (2 / (hc * 4)) ** 0.5)
+        p[f"{br}1_bb"] = jnp.zeros((hc,), dtype)
+        p[f"{br}1_s"] = jnp.ones((hc,), dtype)
+        p[f"{br}1_b"] = jnp.zeros((hc,), dtype)
+        p[f"{br}2_w"] = (jax.random.normal(next(k), (hc, 1, 2, 2), dtype)
+                         * (2 / (hc * 4)) ** 0.5)
+        p[f"{br}2_bb"] = jnp.zeros((1,), dtype)
+
+    def hswish(x):
+        return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+    def cb(p_, name, x, stride=1):
+        return hswish(_bn(_conv(x, p_[name + "_w"], stride),
+                          p_[name + "_s"], p_[name + "_b"]))
+
+    def convT(x, w, b, stride=2):
+        y = lax.conv_transpose(x, w, (stride, stride), "VALID",
+                               dimension_numbers=("NCHW", "IOHW", "NCHW"))
+        return y + b[None, :, None, None]
+
+    def up2(x, h, w):
+        # nearest-neighbor to (h, w) — factors of 2 throughout
+        fh, fw = h // x.shape[2], w // x.shape[3]
+        return jnp.repeat(jnp.repeat(x, fh, axis=2), fw, axis=3)
+
+    def head_branch(p_, br, x):
+        h = jax.nn.relu(_bn(_conv(x, p_[br + "0_w"]),
+                            p_[br + "0_s"], p_[br + "0_b"]))
+        h = jax.nn.relu(_bn(convT(h, p_[br + "1_w"], p_[br + "1_bb"]),
+                            p_[br + "1_s"], p_[br + "1_b"]))
+        return jax.nn.sigmoid(convT(h, p_[br + "2_w"], p_[br + "2_bb"]))
+
+    def fwd(p_, x):
+        h = cb(p_, "stem", x, 2)
+        feats = []
+        for i in range(4):
+            h = cb(p_, f"s{i}a", h, 2)
+            h = cb(p_, f"s{i}b", h)
+            feats.append(h)
+        lats = [_conv(f, p_[f"lat{i}_w"], 1, "SAME")
+                for i, f in enumerate(feats)]
+        for i in range(3, 0, -1):
+            lats[i - 1] = lats[i - 1] + up2(lats[i], lats[i - 1].shape[2],
+                                            lats[i - 1].shape[3])
+        H, W = lats[0].shape[2], lats[0].shape[3]
+        outs = []
+        for i, lat in enumerate(lats):
+            o = _conv(lat, p_[f"sm{i}_w"], 1, "SAME")
+            if o.shape[2] != H:
+                o = up2(o, H, W)
+            outs.append(o)
+        fused = jnp.concatenate(outs, axis=1)
+        prob = head_branch(p_, "prob", fused)
+        thr = head_branch(p_, "thresh", fused)
+        binary = jax.nn.sigmoid(50.0 * (prob - thr))
+        return prob, thr, binary
+
+    def loss_fn(p_, x, gt_prob, gt_thresh, gt_mask):
+        prob, thr, binary = fwd(p_, x)
+        prob = prob.astype(jnp.float32)
+        thr = thr.astype(jnp.float32)
+        binary = binary.astype(jnp.float32)
+        eps = 1e-6
+        bce = -(gt_prob * jnp.log(prob + eps)
+                + (1 - gt_prob) * jnp.log(1 - prob + eps)).mean()
+        l1 = jnp.abs((thr - gt_thresh) * gt_mask).mean()
+        inter = (binary * gt_prob).sum()
+        union = binary.sum() + gt_prob.sum() + eps
+        dice = 1.0 - 2.0 * inter / union
+        return bce + 5.0 * l1 + 10.0 * dice
+
+    vel = jax.tree.map(jnp.zeros_like, p)
+    momentum = 0.9
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, x, gt_prob, gt_thresh, gt_mask):
+        p_, v = state
+        loss, g = jax.value_and_grad(loss_fn)(p_, x, gt_prob, gt_thresh,
+                                              gt_mask)
+        v = jax.tree.map(lambda vi, gi: momentum * vi + gi, v, g)
+        p_ = jax.tree.map(lambda pi, vi: pi - lr * vi, p_, v)
+        return (p_, v), loss
+
+    return step, (p, vel)
