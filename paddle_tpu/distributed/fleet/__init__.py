@@ -39,7 +39,14 @@ class DistributedStrategy:
         self.recompute_configs = {}
         self.gradient_merge = False
         self.gradient_merge_configs = {}
-        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        # schedule_mode selects the pipeline schedule (reference
+        # pipeline_scheduler choices): "" = the default AD-through-scan
+        # engine (FThenB memory profile bounded by remat); "FThenB" /
+        # "1F1B" / "Eager1F1B" = the table-driven interleaved engine
+        # (distributed/pp_schedules.py)
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": ""}
         # ZeRO stage when sharding_degree > 1: 1/2 = optimizer-state sharding
         # (params replicated), 3 = param sharding with gather-on-use
         self.sharding_configs = {"stage": 1}
