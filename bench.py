@@ -86,18 +86,18 @@ def bench_llama(on_tpu: bool, dev):
                                    LlamaPretrainingCriterion)
 
     if on_tpu:
-        # sized for one v5e chip (16G HBM): ~620M params, bf16 + fp32 master.
-        # Round-3 measured sweep on v5e (seq 2048, no remat, fused CE):
-        #   head_dim 64 (h/64 heads): b8 50.4% MFU
-        #   head_dim 128 (h/128 heads, the Llama-3 geometry): b6 61.4%,
-        #   b8 61.3%, b10 56.3%, b12 53.3%; 5L b6 58.8%, 6L b4 59.5%
-        # head_dim 128 fills the full MXU contraction depth in the flash
-        # kernels (d=64 ran them at ~10% efficiency - profiled); larger
-        # batches/layers lose to HBM pressure. recompute off: activations
-        # fit once attention runs through the Pallas flash kernel and the
-        # criterion uses the bf16-resident fused CE.
+        # sized for one v5e chip (16G HBM): bf16 + fp32 master.
+        # Round-4 device-clock sweep (seq 2048, no remat, fused CE,
+        # head_dim 128 = the Llama-3 geometry; r3's host-clock optimum
+        # was b8/4L at 61.8%):
+        #   4L: b2 59.3%, b3 67.5%, b4 66.1%, b6 64.7%, b8 63.5%, b10 53.8%
+        #   b3: 3L 66.4%, 5L 61.5%, 6L 68.0%, 8L OOM (params)
+        # small batches win on the device clock: per-step HBM traffic is
+        # weight-dominated and the smaller live-activation set keeps the
+        # FFN matmuls resident; head_dim 128 fills the MXU contraction
+        # depth in the flash kernels (d=64 profiled at ~10% efficiency).
         hidden = int(os.environ.get("PTPU_BENCH_HIDDEN", 3072))
-        layers = int(os.environ.get("PTPU_BENCH_LAYERS", 4))
+        layers = int(os.environ.get("PTPU_BENCH_LAYERS", 6))
         heads = int(os.environ.get("PTPU_BENCH_HEADS", hidden // 128))
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=hidden,
@@ -109,7 +109,7 @@ def bench_llama(on_tpu: bool, dev):
             recompute={"0": False, "1": True}.get(
                 os.environ.get("PTPU_RECOMPUTE", "0"),
                 os.environ.get("PTPU_RECOMPUTE")))
-        batch = int(os.environ.get("PTPU_BENCH_BATCH", 8))
+        batch = int(os.environ.get("PTPU_BENCH_BATCH", 3))
         seq = int(os.environ.get("PTPU_BENCH_SEQ", 2048))
         steps = int(os.environ.get("PTPU_BENCH_STEPS", 10))
         paddle.set_default_dtype("bfloat16")

@@ -371,8 +371,22 @@ def pipelined_stack_forward(stack, x, shared, num_stages: int,
     from . import fleet as fleet_mod
     from .topology import get_hybrid_communicate_group
 
-    mesh = get_hybrid_communicate_group().mesh.mesh
     strategy = fleet_mod.get_strategy()
+    # the table-driven F/B-interleaved engine needs the loss INSIDE the
+    # pipeline (per-microbatch seeding) — this AD-through-scan path
+    # computes loss outside, so a requested table schedule must not be
+    # silently ignored
+    mode = "" if strategy is None else str(
+        strategy.pipeline_configs.get("schedule_mode") or "")
+    if mode:
+        raise ValueError(
+            f"pipeline_configs['schedule_mode']={mode!r} selects the "
+            f"table-driven interleaved engine, which requires the "
+            f"per-microbatch loss inside the pipeline — use "
+            f"distributed.pipeline_train_tables(..., loss_fn=...) for "
+            f"that schedule, or leave schedule_mode empty for this "
+            f"AD-through-scan engine")
+    mesh = get_hybrid_communicate_group().mesh.mesh
     if accumulate_steps is None:
         accumulate_steps = 1 if strategy is None else int(
             strategy.pipeline_configs.get("accumulate_steps", 1))

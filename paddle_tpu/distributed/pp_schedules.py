@@ -150,6 +150,16 @@ def _stage_fn_builder(block_apply, remat):
     return stage_fn
 
 
+def resolve_schedule_mode(default: str = "1F1B") -> str:
+    """Read the fleet strategy's pipeline_configs['schedule_mode'] (the
+    reference pipeline_scheduler knob); empty/unset -> `default`."""
+    from . import fleet as fleet_mod
+    strategy = fleet_mod.get_strategy()
+    if strategy is None:
+        return default
+    return strategy.pipeline_configs.get("schedule_mode") or default
+
+
 def pipeline_train_tables(block_apply: Callable,
                           stacked: Sequence[jax.Array],
                           x_mb: jax.Array,
@@ -158,7 +168,7 @@ def pipeline_train_tables(block_apply: Callable,
                           mesh: Mesh,
                           num_stages: int,
                           num_micro: int,
-                          schedule: str = "1F1B",
+                          schedule: str = None,
                           remat: bool = False,
                           rng_key=None):
     """Run one interleaved F/B pipeline step under `schedule`.
@@ -172,6 +182,8 @@ def pipeline_train_tables(block_apply: Callable,
     Returns (mean_loss, grads) where grads matches `stacked` in
     structure ([L, ...] leaves, summed over microbatches).
     """
+    if schedule is None:
+        schedule = resolve_schedule_mode()
     S, M = num_stages, num_micro
     sched = build_fb_schedule(S, M, schedule)
     T = sched["T"]

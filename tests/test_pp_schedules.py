@@ -119,3 +119,39 @@ class TestTableEngineParity:
         np.testing.assert_allclose(np.asarray(grads[0]),
                                    np.asarray(ref_grad),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestScheduleModeWiring:
+    def test_strategy_resolves_default(self):
+        import paddle_tpu.distributed.fleet as fleet_mod
+        from paddle_tpu.distributed.pp_schedules import resolve_schedule_mode
+        prev = fleet_mod._fleet_strategy
+        try:
+            fleet_mod._fleet_strategy = None
+            assert resolve_schedule_mode() == "1F1B"
+            s = fleet_mod.DistributedStrategy()
+            s.pipeline_configs["schedule_mode"] = "Eager1F1B"
+            fleet_mod._fleet_strategy = s
+            assert resolve_schedule_mode() == "Eager1F1B"
+        finally:
+            fleet_mod._fleet_strategy = prev
+
+    def test_ad_engine_rejects_table_mode(self):
+        """The AD-through-scan path must not silently ignore a requested
+        table schedule (its loss lives outside the pipeline)."""
+        import paddle_tpu.distributed.fleet as fleet_mod
+        from paddle_tpu.distributed import pipeline as pl_mod
+        prev = fleet_mod._fleet_strategy
+        try:
+            s = fleet_mod.DistributedStrategy()
+            s.pipeline_configs["schedule_mode"] = "1F1B"
+            fleet_mod._fleet_strategy = s
+
+            class _FakeStack:
+                pass
+
+            with pytest.raises(ValueError, match="pipeline_train_tables"):
+                pl_mod.pipelined_stack_forward(
+                    _FakeStack(), None, (), num_stages=2, remat=False)
+        finally:
+            fleet_mod._fleet_strategy = prev
