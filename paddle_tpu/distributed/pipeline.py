@@ -375,10 +375,12 @@ def pipelined_stack_forward(stack, x, shared, num_stages: int,
     # the table-driven F/B-interleaved engine needs the loss INSIDE the
     # pipeline (per-microbatch seeding) — this AD-through-scan path
     # computes loss outside, so a requested table schedule must not be
-    # silently ignored
-    mode = "" if strategy is None else str(
-        strategy.pipeline_configs.get("schedule_mode") or "")
-    if mode:
+    # silently ignored on a TRAINING forward (eval/no_grad forwards have
+    # no backward schedule; the knob is meaningless there, not an error)
+    from ..autograd import engine as _engine
+    from .pp_schedules import resolve_schedule_mode as _resolve_mode
+    mode = _resolve_mode(default="")
+    if mode and _engine.is_grad_enabled():
         raise ValueError(
             f"pipeline_configs['schedule_mode']={mode!r} selects the "
             f"table-driven interleaved engine, which requires the "

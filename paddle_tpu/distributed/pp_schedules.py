@@ -168,10 +168,12 @@ def pipeline_train_tables(block_apply: Callable,
                           mesh: Mesh,
                           num_stages: int,
                           num_micro: int,
-                          schedule: str = None,
+                          schedule: "str | None" = None,
                           remat: bool = False,
                           rng_key=None):
-    """Run one interleaved F/B pipeline step under `schedule`.
+    """Run one interleaved F/B pipeline step under `schedule` (None =
+    resolve from the fleet strategy's pipeline_configs['schedule_mode'],
+    defaulting to 1F1B).
 
     block_apply(leaves, x, shared, key) -> y   (one block, pure)
     loss_fn(y, m) -> scalar  — per-microbatch criterion applied to the

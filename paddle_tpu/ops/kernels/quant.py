@@ -78,8 +78,9 @@ def weight_only_linear_kernel(x, weight, bias=None, weight_scale=None,
                               weight_dtype="int8", arch=80, group_size=-1):
     """x [..., k] @ dequant(weight) + bias. Per-channel int8 runs as
     (x @ q_int8) * scale — the convert fuses into the MXU feed and the
-    scale commutes onto the [m, n] output (weight_only_gemm.py docstring);
-    per-group/int4 dequantize first."""
+    scale commutes onto the [m, n] output; per-channel int4 runs the
+    split-nibble two-dot formulation (weight_only_gemm.py docstring);
+    per-group paths dequantize first."""
     from .pallas import weight_only_gemm as wog
     lead = x.shape[:-1]
     k = x.shape[-1]
