@@ -80,6 +80,21 @@ def get_flag(name: str) -> Any:
     return _REGISTRY[name.removeprefix("FLAGS_")].value
 
 
+# fingerprint of the current flag VALUES: kernels read flags at TRACE
+# time, so cached per-op executables are keyed on the state they were
+# traced under (ops/dispatcher.py _get_exec) — otherwise toggling e.g.
+# FLAGS_use_pallas_kernels after an op has run once is silently ignored.
+# A value fingerprint (not a counter) means toggling back to a previous
+# state REUSES its executables and a same-value set_flags is a no-op.
+version = 0
+
+
+def _refingerprint() -> None:
+    global version
+    version = hash(tuple(sorted((k, repr(f.value))
+                                for k, f in _REGISTRY.items())))
+
+
 def set_flags(flags: Dict[str, Any]) -> None:
     for k, v in flags.items():
         k = k.removeprefix("FLAGS_")
@@ -94,6 +109,7 @@ def set_flags(flags: Dict[str, Any]) -> None:
             f.value = f.ctype(v)
         if _NATIVE is not None:
             _NATIVE.PT_SetFlag(k.encode(), str(f.value).encode())
+    _refingerprint()
 
 
 # -- Core flags (subset mirroring paddle/common/flags.cc) ---------------------
@@ -118,3 +134,4 @@ define_flag("rng_impl", "rbg",
 from .native import on_load as _native_on_load  # noqa: E402
 
 _native_on_load(_mirror_native)
+_refingerprint()

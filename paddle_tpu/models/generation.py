@@ -71,6 +71,8 @@ class PagedKVCache:
         # blocks handed to each sequence so far — allocation is per TOKEN,
         # not per layer-write (all layers share one block table)
         self._allocated = np.zeros((batch,), np.int32)
+        self._slot_cache_key = None   # memoized update() slot map key
+        self._prefill_kv: dict = {}   # per-layer prompt K/V, prefill only
 
     # -- host-side allocator -------------------------------------------------
     def _ensure_block(self, seq: int, pos: int) -> int:
@@ -92,6 +94,9 @@ class PagedKVCache:
         self.block_tables[seq, :] = 0
         self.context_lens[seq] = 0
         self._allocated[seq] = 0
+        # the memoized slot map points into blocks just freed — a
+        # re-prefill at the same (pos, len) must re-run the allocator
+        self._slot_cache_key = None
 
     def write_token(self, layer: int, seq_positions: np.ndarray,
                     k_new: Tensor, v_new: Tensor):
@@ -113,7 +118,64 @@ class PagedKVCache:
                 self.context_lens[b] = max(self.context_lens[b],
                                            int(pos) + 1)
 
-    def attend(self, layer: int, q: Tensor) -> Tensor:
+    # -- model-facing cache interface (same contract as KVCache, so
+    # LlamaAttention's decode path and generate() can run fully paged:
+    # reference block_multi_head serving flow) ------------------------------
+    def update(self, layer: int, k_new: Tensor, v_new: Tensor, pos):
+        b, s = k_new.shape[0], k_new.shape[1]
+        p0 = int(np.asarray(pos._data)) if isinstance(pos, Tensor) \
+            else int(pos)
+        if s == 1 and self._prefill_kv:
+            # decode has begun: the stashed prompt K/V (only needed for
+            # the prefill attend) would otherwise pin ~prompt-sized HBM
+            # for the whole decode
+            self._prefill_kv.clear()
+        if self._slot_cache_key != (p0, s):
+            slots = np.empty((b, s), np.int64)
+            for seq in range(b):
+                for i in range(s):
+                    blk = self._ensure_block(seq, p0 + i)
+                    slots[seq, i] = (blk * self.block_size
+                                     + (p0 + i) % self.block_size)
+            self._slots = Tensor(jnp.asarray(slots.reshape(-1), jnp.int32))
+            self._slot_cache_key = (p0, s)
+        self.k[layer] = call_op("paged_cache_write", self.k[layer], k_new,
+                                self._slots)
+        self.v[layer] = call_op("paged_cache_write", self.v[layer], v_new,
+                                self._slots)
+        if layer == 0:
+            self.context_lens[:] = np.maximum(self.context_lens, p0 + s)
+        if s > 1:
+            # prefill: stash the prompt k/v so attend() can run ordinary
+            # causal attention instead of gathering the pool back out
+            self._prefill_kv[layer] = (k_new, v_new)
+        return self.k[layer], self.v[layer]
+
+    def attend(self, layer: int, q: Tensor, pos=None,
+               attn_mask: Optional[Tensor] = None) -> Tensor:
+        if pos is None and attn_mask is None:
+            # legacy 2-arg decode form
+            return call_op("paged_attention", q, self.k[layer],
+                           self.v[layer],
+                           Tensor(jnp.asarray(self.block_tables)),
+                           Tensor(jnp.asarray(self.context_lens)))
+        s = q.shape[1]
+        if s > 1:
+            p0 = int(np.asarray(pos._data)) if isinstance(pos, Tensor) \
+                else int(pos)
+            if p0 != 0 or layer not in getattr(self, "_prefill_kv", {}):
+                raise NotImplementedError(
+                    "PagedKVCache prefill attends only the freshly "
+                    "written prompt (pos 0); chunked prefill is not "
+                    "supported")
+            k_new, v_new = self._prefill_kv[layer]
+            return call_op("scaled_dot_product_attention", q, k_new,
+                           v_new, attn_mask=attn_mask, is_causal=True)
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "PagedKVCache decode attention has no attn_mask input "
+                "(context_lens bound what each sequence attends to); "
+                "left-padded batches need the contiguous KVCache")
         return call_op("paged_attention", q, self.k[layer], self.v[layer],
                        Tensor(jnp.asarray(self.block_tables)),
                        Tensor(jnp.asarray(self.context_lens)))
@@ -126,7 +188,12 @@ class GenerationMixin:
     def generate(self, input_ids: Tensor, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_token_id: Optional[int] = None,
-                 max_cache_len: Optional[int] = None):
+                 max_cache_len: Optional[int] = None,
+                 cache_type: str = "contiguous", block_size: int = 64):
+        """cache_type="paged" runs the whole loop over the block-pool
+        cache (bulk prefill write + Pallas paged decode attention — the
+        reference block_multi_head serving flow); "contiguous" is the
+        dense [B, T] cache."""
         from ..autograd.engine import no_grad
         cfg = self.config
         b, s = input_ids.shape[0], input_ids.shape[1]
@@ -140,11 +207,21 @@ class GenerationMixin:
                 f"prompt+max_new_tokens={total} exceeds "
                 f"max_position_embeddings={cfg.max_position_embeddings} "
                 f"(rope table would clamp positions)")
-        cache = KVCache(cfg.num_hidden_layers, b,
-                        max_cache_len or total,
-                        cfg.num_key_value_heads,
-                        cfg.hidden_size // cfg.num_attention_heads,
-                        dtype=getattr(cfg, "dtype", "float32"))
+        if cache_type == "paged":
+            mb = -(-(max_cache_len or total) // block_size)
+            cache = PagedKVCache(
+                cfg.num_hidden_layers, b, num_blocks=b * mb,
+                block_size=block_size,
+                num_kv_heads=cfg.num_key_value_heads,
+                head_dim=cfg.hidden_size // cfg.num_attention_heads,
+                max_blocks_per_seq=mb,
+                dtype=getattr(cfg, "dtype", "float32"))
+        else:
+            cache = KVCache(cfg.num_hidden_layers, b,
+                            max_cache_len or total,
+                            cfg.num_key_value_heads,
+                            cfg.hidden_size // cfg.num_attention_heads,
+                            dtype=getattr(cfg, "dtype", "float32"))
         tokens = [input_ids]
         finished = np.zeros((b,), bool)
         with no_grad():

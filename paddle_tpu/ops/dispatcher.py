@@ -165,7 +165,8 @@ def _unhash(v):
 
 @functools.lru_cache(maxsize=None)
 def _get_exec(op_name: str, attrs_key: Tuple, present_mask: Tuple[bool, ...],
-              dmask: Tuple[bool, ...], fmask_len: int, use_jit: bool):
+              dmask: Tuple[bool, ...], fmask_len: int, use_jit: bool,
+              fver: int = 0):
     """Build (fwd, vjp) callables for one (op, attrs, masks) combination.
 
     fwd(*primals) -> tuple of output arrays
@@ -372,7 +373,7 @@ def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
             for t, p in zip(in_tensors, primals)
         ) if need_grad else tuple(False for _ in primals)
         fwd, vjp_j = _get_exec(schema.kernel, attrs_key, tuple(present), dmask,
-                               0, use_jit)
+                               0, use_jit, flags.version)
         out_arrays = fwd(*primals)
     else:
         # dynamic attrs (e.g. tensor-valued indices): no cross-call caching
@@ -605,7 +606,8 @@ def _dispatch_binary_fast(schema, attrs_key, a: Tensor, b):
                  not b._stop_gradient
                  and jnp.issubdtype(p1.dtype, jnp.inexact))
         fwd, vjp_j = _get_exec(schema.kernel, attrs_key, (1, 1), dmask, 0,
-                               schema.jit and _F_EAGER_JIT.value)
+                               schema.jit and _F_EAGER_JIT.value,
+                               flags.version)
         out_arrays = fwd(p0, p1)
         outs = [Tensor._wrap(arr) for arr in out_arrays]
         vjp_callable = _make_vjp_callable(vjp_j, dmask,
@@ -614,16 +616,17 @@ def _dispatch_binary_fast(schema, attrs_key, a: Tensor, b):
                            [a, b], outs)
         return outs[0] if len(outs) == 1 else outs
 
-    # no-grad: the exec is constant per (schema, jit flag) — memoize on
-    # the schema to replace the _get_exec key build + dict probe with one
-    # attribute read
+    # no-grad: the exec is constant per (schema, jit flag, flags version)
+    # — memoize on the schema to replace the _get_exec key build + dict
+    # probe with one attribute read
     jit_on = schema.jit and _F_EAGER_JIT.value
+    fver = flags.version
     cached = schema.__dict__.get("_fast_ex")
-    if cached is None or cached[0] is not jit_on:
+    if cached is None or cached[0] is not jit_on or cached[1] != fver:
         fwd, _ = _get_exec(schema.kernel, attrs_key, (1, 1),
-                           (False, False), 0, jit_on)
-        schema._fast_ex = cached = (jit_on, fwd)
-    out_arrays = cached[1](p0, p1)
+                           (False, False), 0, jit_on, fver)
+        schema._fast_ex = cached = (jit_on, fver, fwd)
+    out_arrays = cached[2](p0, p1)
     if len(out_arrays) == 1:
         return Tensor._wrap(out_arrays[0])
     return [Tensor._wrap(arr) for arr in out_arrays]

@@ -57,12 +57,15 @@ def cache_attention_kernel(q, k_cache, v_cache, pos, attn_mask=None,
 
 @register_kernel("paged_cache_write")
 def paged_cache_write_kernel(pool, new, slot_ids):
-    """pool[NB,BS,KV,D]; new[B,1,KV,D]; slot_ids[B] (flat block*BS+offset)
-    → pool with each sequence's token written into its slot."""
+    """pool[NB,BS,KV,D]; new[B,S,KV,D]; slot_ids[B*S] (flat
+    block*BS+offset per token, row-major over (B,S)) → pool with every
+    token written into its slot. S=1 is the per-token decode write; S>1
+    is the bulk prefill write."""
     nb, bs = pool.shape[0], pool.shape[1]
     flat = pool.reshape(nb * bs, *pool.shape[2:])
-    flat = flat.at[slot_ids.astype(jnp.int32)].set(
-        new[:, 0].astype(pool.dtype))
+    flat_new = new.reshape(-1, *new.shape[2:])
+    flat = flat.at[slot_ids.reshape(-1).astype(jnp.int32)].set(
+        flat_new.astype(pool.dtype))
     return flat.reshape(pool.shape)
 
 

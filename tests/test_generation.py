@@ -310,3 +310,95 @@ class TestReviewRegressions:
 # multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
 import pytest as _pytest_mark  # noqa: E402
 pytestmark = _pytest_mark.mark.heavy
+
+
+class TestPagedGenerate:
+    """generate(cache_type='paged'): the whole loop over the block-pool
+    cache (bulk prefill write + paged decode attention), VERDICT r4
+    serving e2e. Parity is asserted on LOGITS (sampling consumes RNG, so
+    token-level comparison would conflate numerics with key streams)."""
+
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        return LlamaForCausalLM(LlamaConfig.tiny())
+
+    def test_paged_prefill_and_decode_logits_match_contiguous(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.models.generation import KVCache, PagedKVCache
+        m = self._model()
+        cfg = m.config
+        b, s, steps = 2, 12, 4
+        ids = Tensor(jnp.asarray(
+            np.arange(b * s, dtype=np.int32).reshape(b, s) % cfg.vocab_size))
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        total = s + steps
+        dense = KVCache(cfg.num_hidden_layers, b, total,
+                        cfg.num_key_value_heads, hd)
+        mb = -(-total // 4)
+        paged = PagedKVCache(cfg.num_hidden_layers, b, num_blocks=b * mb,
+                             block_size=4,
+                             num_kv_heads=cfg.num_key_value_heads,
+                             head_dim=hd, max_blocks_per_seq=mb)
+        zero = Tensor(jnp.asarray(0, jnp.int32))
+        l_d = m(ids, cache=dense, start_pos=zero)
+        l_p = m(ids, cache=paged, start_pos=zero)
+        np.testing.assert_allclose(l_p.numpy(), l_d.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        tok = Tensor(jnp.asarray(
+            np.full((b, 1), 5, np.int32)))
+        for step in range(steps):
+            pos = Tensor(jnp.asarray(s + step, jnp.int32))
+            l_d = m(tok, cache=dense, start_pos=pos)
+            l_p = m(tok, cache=paged, start_pos=pos)
+            np.testing.assert_allclose(l_p.numpy(), l_d.numpy(),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_generate_paged_end_to_end(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        m = self._model()
+        ids = Tensor(jnp.asarray(np.array([[1, 2, 3, 4]], np.int32)))
+        out = m.generate(ids, max_new_tokens=5, cache_type="paged",
+                         block_size=4)
+        assert out.shape == [1, 9]
+        assert (out.numpy()[:, :4] == np.array([[1, 2, 3, 4]])).all()
+
+    def test_release_invalidates_slot_cache(self):
+        """Re-prefilling a recycled sequence at the same (pos, len) must
+        re-run the block allocator, not reuse freed slots (r4 review)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.models.generation import PagedKVCache
+        cache = PagedKVCache(1, 1, num_blocks=4, block_size=2,
+                             num_kv_heads=1, head_dim=4,
+                             max_blocks_per_seq=4)
+        k = Tensor(jnp.ones((1, 4, 1, 4), jnp.float32))
+        cache.update(0, k, k, 0)
+        assert cache._allocated[0] == 2
+        cache.release(0)
+        assert cache._allocated[0] == 0
+        cache.update(0, k, k, 0)
+        assert cache._allocated[0] == 2          # allocator re-ran
+        assert cache.context_lens[0] == 4
+
+    def test_paged_decode_rejects_attn_mask(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import pytest
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.models.generation import PagedKVCache
+        cache = PagedKVCache(1, 1, num_blocks=4, block_size=2,
+                             num_kv_heads=1, head_dim=4,
+                             max_blocks_per_seq=4)
+        k = Tensor(jnp.ones((1, 2, 1, 4), jnp.float32))
+        cache.update(0, k, k, 0)
+        q = Tensor(jnp.ones((1, 1, 1, 4), jnp.float32))
+        mask = Tensor(jnp.ones((1, 1, 1, 2), jnp.bool_))
+        with pytest.raises(NotImplementedError, match="attn_mask"):
+            cache.attend(0, q, Tensor(jnp.asarray(2, jnp.int32)), mask)
