@@ -1,8 +1,13 @@
-"""paddle_tpu.audio — audio features/functionals (SURVEY §2.6 domain libs)."""
+"""paddle_tpu.audio — audio features/functionals/backends/datasets
+(SURVEY §2.6 domain libs; reference python/paddle/audio)."""
 
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from . import features  # noqa: F401
 from . import functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+__all__ = ["functional", "features", "backends", "datasets",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+           "info", "load", "save"]

@@ -1,0 +1,183 @@
+"""Audio classification datasets (reference python/paddle/audio/datasets/:
+dataset.py AudioClassificationDataset, esc50.py ESC50, tess.py TESS).
+
+Archives are read from LOCAL paths — this stack has no network egress, so
+a missing file raises with instructions instead of downloading (same
+convention as paddle_tpu.vision.datasets).
+"""
+
+from __future__ import annotations
+
+import collections
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..utils.download import require_local_file
+from . import features
+from .backends import load as _load_audio
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+# feat_type → feature-extractor class (None = raw waveform); reference
+# datasets/dataset.py feat_funcs
+_FEAT_CLASSES = {
+    "raw": None,
+    "spectrogram": features.Spectrogram,
+    "melspectrogram": features.MelSpectrogram,
+    "logmelspectrogram": features.LogMelSpectrogram,
+    "mfcc": features.MFCC,
+}
+
+
+def _require(path, name):
+    return require_local_file(path, name, arg="data_dir")
+
+
+class AudioClassificationDataset(Dataset):
+    """(files, labels) → (feature, label) pairs; feat_type selects raw
+    waveform or an on-the-fly feature front-end (reference
+    datasets/dataset.py)."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: Optional[int] = None,
+                 **feat_config):
+        super().__init__()
+        if feat_type not in _FEAT_CLASSES:
+            raise RuntimeError(f"Unknown feat_type: {feat_type}, must be one "
+                               f"of {sorted(_FEAT_CLASSES)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = feat_config
+        self._extractor = None  # built lazily: mel/DCT matrices depend on sr
+
+    def _get_extractor(self, sample_rate: int):
+        feat_cls = _FEAT_CLASSES[self.feat_type]
+        if feat_cls is None:
+            return None
+        if self._extractor is None or self.sample_rate != sample_rate:
+            self.sample_rate = sample_rate
+            if self.feat_type == "spectrogram":
+                self._extractor = feat_cls(**self.feat_config)
+            else:
+                self._extractor = feat_cls(sr=sample_rate,
+                                           **self.feat_config)
+        return self._extractor
+
+    def _convert_to_record(self, idx: int):
+        file, label = self.files[idx], self.labels[idx]
+        waveform, sample_rate = _load_audio(file)
+        wave = waveform.numpy()
+        if wave.ndim == 2:
+            wave = wave[0]  # mono channel
+        extractor = self._get_extractor(sample_rate)
+        if extractor is None:
+            self.sample_rate = sample_rate
+            return Tensor(wave), label
+        feat = extractor(Tensor(wave[None, :]))
+        return Tensor(feat.numpy()[0]), label
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental-sound set from an extracted local
+    ESC-50-master directory (reference datasets/esc50.py: 2000 5-second
+    recordings, 50 classes, 5 predefined folds; `split` selects the
+    held-out fold)."""
+
+    meta = os.path.join("meta", "esc50.csv")
+    audio_dir = "audio"
+    meta_info = collections.namedtuple(
+        "META_INFO", ("filename", "fold", "target", "category",
+                      "esc10", "src_file", "take"))
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", data_dir: Optional[str] = None,
+                 **kwargs):
+        self.data_dir = _require(data_dir, "ESC50")
+        files, labels = self._get_data(mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self) -> List["ESC50.meta_info"]:
+        ret = []
+        with open(os.path.join(self.data_dir, self.meta)) as rf:
+            for row in csv.reader(rf):
+                if row and row[0] != "filename":
+                    ret.append(self.meta_info(*row))
+        return ret
+
+    def _get_data(self, mode: str, split: int
+                  ) -> Tuple[List[str], List[int]]:
+        files, labels = [], []
+        for sample in self._get_meta_info():
+            filename, fold, target = sample[0], int(sample[1]), int(sample[2])
+            if (mode == "train") != (fold == split):
+                files.append(os.path.join(self.data_dir, self.audio_dir,
+                                          filename))
+                labels.append(target)
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set from an extracted local directory
+    (reference datasets/tess.py: 2800 recordings, 7 emotions encoded in the
+    filename's last underscore field; `n_folds` k-fold split on sorted
+    file order, `split` selects the held-out fold)."""
+
+    n_class = 7
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+    meta_info = collections.namedtuple("META_INFO",
+                                       ("speaker", "word", "emotion"))
+    audio_path = "TESS_Toronto_emotional_speech_set"
+
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1,
+                 feat_type: str = "raw", data_dir: Optional[str] = None,
+                 **kwargs):
+        if not (1 <= split <= n_folds):
+            raise ValueError(f"split {split} outside 1..{n_folds}")
+        self.data_dir = _require(data_dir, "TESS")
+        files, labels = self._get_data(mode, n_folds, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self, files) -> List["TESS.meta_info"]:
+        ret = []
+        for file in files:
+            basename_without_extension = os.path.basename(file)[:-len(".wav")]
+            ret.append(self.meta_info(
+                *basename_without_extension.strip().split("_")))
+        return ret
+
+    def _get_data(self, mode: str, n_folds: int, split: int
+                  ) -> Tuple[List[str], List[int]]:
+        wav_files = []
+        root = os.path.join(self.data_dir, self.audio_path)
+        if not os.path.isdir(root):
+            root = self.data_dir
+        for dirpath, _, filenames in os.walk(root):
+            for fname in filenames:
+                if fname.lower().endswith(".wav"):
+                    wav_files.append(os.path.join(dirpath, fname))
+        wav_files.sort()
+        files, labels = [], []
+        for idx, (file, sample) in enumerate(
+                zip(wav_files, self._get_meta_info(wav_files))):
+            emotion = sample.emotion.lower()
+            if emotion not in self.label_list:
+                continue
+            fold = idx % n_folds + 1
+            if (mode == "train") != (fold == split):
+                files.append(file)
+                labels.append(self.label_list.index(emotion))
+        return files, labels

@@ -155,6 +155,129 @@ def _gaussian(n, fftbins=True, std=7.0):
     return w[:-1] if fftbins else w
 
 
+@_window("general_gaussian")
+def _general_gaussian(n, fftbins=True, p=1.0, sig=7.0):
+    """w[i] = exp(-0.5 * |i/sig|^(2p)) (reference window.py
+    _general_gaussian)."""
+    m = n + 1 if fftbins else n
+    i = jnp.arange(m) - (m - 1) / 2
+    w = jnp.exp(-0.5 * jnp.abs(i / sig) ** (2 * p))
+    return w[:-1] if fftbins else w
+
+
+def _general_cosine_np(m, a):
+    fac = np.linspace(-np.pi, np.pi, m)
+    w = np.zeros(m)
+    for k, coef in enumerate(a):
+        w += coef * np.cos(k * fac)
+    return w
+
+
+@_window("general_cosine")
+def _general_cosine(n, fftbins=True, a=(0.5, 0.5)):
+    m = n + 1 if fftbins else n
+    w = jnp.asarray(_general_cosine_np(m, a))
+    return w[:-1] if fftbins else w
+
+
+@_window("general_hamming")
+def _general_hamming(n, fftbins=True, alpha=0.54):
+    return _general_cosine(n, fftbins, (alpha, 1.0 - alpha))
+
+
+@_window("triang")
+def _triang(n, fftbins=True):
+    m = n + 1 if fftbins else n
+    i = np.arange(1, (m + 1) // 2 + 1)
+    if m % 2 == 0:
+        half = (2 * i - 1.0) / m
+        w = np.concatenate([half, half[::-1]])
+    else:
+        half = 2 * i / (m + 1.0)
+        w = np.concatenate([half, half[-2::-1]])
+    w = jnp.asarray(w)
+    return w[:-1] if fftbins else w
+
+
+@_window("bohman")
+def _bohman(n, fftbins=True):
+    m = n + 1 if fftbins else n
+    fac = np.abs(np.linspace(-1, 1, m)[1:-1])
+    mid = (1 - fac) * np.cos(np.pi * fac) + 1.0 / np.pi * np.sin(np.pi * fac)
+    w = jnp.asarray(np.r_[0.0, mid, 0.0])
+    return w[:-1] if fftbins else w
+
+
+@_window("cosine")
+def _cosine(n, fftbins=True):
+    m = n + 1 if fftbins else n
+    w = jnp.sin(math.pi / m * (jnp.arange(m) + 0.5))
+    return w[:-1] if fftbins else w
+
+
+@_window("tukey")
+def _tukey(n, fftbins=True, alpha=0.5):
+    m = n + 1 if fftbins else n
+    if alpha <= 0:
+        w = np.ones(m)
+    elif alpha >= 1.0:
+        w = np.hanning(m)
+    else:
+        i = np.arange(m)
+        width = int(np.floor(alpha * (m - 1) / 2.0))
+        n1, n2, n3 = i[: width + 1], i[width + 1 : m - width - 1], \
+            i[m - width - 1 :]
+        w1 = 0.5 * (1 + np.cos(np.pi * (-1 + 2.0 * n1 / alpha / (m - 1))))
+        w2 = np.ones(n2.shape[0])
+        w3 = 0.5 * (1 + np.cos(np.pi * (-2.0 / alpha + 1
+                                        + 2.0 * n3 / alpha / (m - 1))))
+        w = np.concatenate([w1, w2, w3])
+    w = jnp.asarray(w)
+    return w[:-1] if fftbins else w
+
+
+@_window("exponential")
+def _exponential(n, fftbins=True, center=None, tau=1.0):
+    m = n + 1 if fftbins else n
+    if center is None:
+        center = (m - 1) / 2
+    i = np.arange(m)
+    w = jnp.asarray(np.exp(-np.abs(i - center) / tau))
+    return w[:-1] if fftbins else w
+
+
+@_window("taylor")
+def _taylor(n, fftbins=True, nbar=4, sll=30, norm=True):
+    """Taylor window (reference window.py _taylor; scipy formulation:
+    sidelobe level `sll` dB below mainlobe, `nbar` nearly-constant
+    sidelobes)."""
+    m = n + 1 if fftbins else n
+    B = 10.0 ** (sll / 20)
+    A = np.arccosh(B) / np.pi
+    s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+    ma = np.arange(1, nbar)
+    Fm = np.zeros(nbar - 1)
+    signs = np.ones(max(nbar - 1, 0))
+    signs[1::2] = -1
+    m2 = ma * ma
+    for mi in range(len(ma)):
+        numer = signs[mi] * np.prod(
+            1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+        denom = 2 * np.prod([1 - m2[mi] / m2[j]
+                             for j in range(len(ma)) if j != mi])
+        Fm[mi] = numer / denom
+
+    def W(x):
+        return 1 + 2 * np.dot(
+            Fm, np.cos(2 * np.pi * ma[:, None] * (x - m / 2.0 + 0.5) / m))
+
+    w = W(np.arange(m))
+    if norm:
+        w = w / W((m - 1) / 2)
+    w = jnp.asarray(w)
+    return w[:-1] if fftbins else w
+
+
 def get_window(window: Union[str, tuple], win_length: int,
                fftbins: bool = True) -> Tensor:
     """reference window.py get_window: name or (name, param) tuple."""

@@ -21,9 +21,10 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..io import Dataset
 from ..nn.layer_base import Layer
+from .datasets import Conll05st, Imikolov, Movielens, WMT14, WMT16  # noqa: F401
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb",
-           "Vocab"]
+           "Vocab", "Imikolov", "Movielens", "WMT14", "WMT16", "Conll05st"]
 
 
 def viterbi_decode(potentials: Tensor, transition: Tensor,

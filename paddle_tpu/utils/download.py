@@ -1,0 +1,22 @@
+"""Dataset-file resolution (reference python/paddle/dataset/common.py
+_check_exists_and_download). This stack has no network egress, so the
+"download" step is always a clear error pointing at the local-file
+contract shared by vision/audio/text datasets.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["require_local_file"]
+
+
+def require_local_file(path, name, arg="data_file"):
+    """Return `path` if it exists; otherwise raise the shared
+    downloading-unavailable error."""
+    if path is None or not os.path.exists(path):
+        raise RuntimeError(
+            f"{name}: {arg} {path!r} not found and downloading is "
+            f"unavailable in this environment; place the data locally and "
+            f"pass {arg}=")
+    return path

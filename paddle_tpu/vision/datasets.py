@@ -24,12 +24,8 @@ _HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
 
 
 def _require(path, name):
-    if path is None or not os.path.exists(path):
-        raise RuntimeError(
-            f"{name}: data_file {path!r} not found and downloading is "
-            f"unavailable in this environment; place the archive locally and "
-            f"pass data_file=")
-    return path
+    from ..utils.download import require_local_file
+    return require_local_file(path, name, arg="data_file")
 
 
 class Cifar10(Dataset):

@@ -1,0 +1,311 @@
+"""Audio backends/datasets + text datasets + window breadth
+(reference python/paddle/audio/{backends,datasets}, python/paddle/text/
+datasets/{imikolov,movielens,wmt14,wmt16,conll05}.py)."""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+pytestmark = pytest.mark.smoke
+
+
+class TestWindows:
+    """New round-4 windows vs scipy (periodic == scipy sym=False)."""
+
+    @pytest.mark.parametrize("name,params", [
+        ("triang", ()),
+        ("bohman", ()),
+        ("cosine", ()),
+        ("tukey", (0.5,)),
+        ("tukey", (0.25,)),
+        ("exponential", (None, 3.0)),
+        ("general_gaussian", (1.5, 5.0)),
+        ("general_hamming", (0.6,)),
+        ("taylor", ()),
+    ])
+    def test_matches_scipy_periodic(self, name, params):
+        from scipy.signal import windows as sw
+        fn = getattr(sw, name)
+        for m in (16, 17):
+            ours = paddle.audio.functional.get_window(
+                (name, *params) if params else name, m, fftbins=True)
+            ref = fn(m, *[p for p in params], sym=False)
+            np.testing.assert_allclose(ours.numpy(), ref, atol=1e-5)
+
+    def test_general_cosine(self):
+        from scipy.signal import windows as sw
+        a = [0.42, 0.5, 0.08]
+        ours = paddle.audio.functional.get_window(
+            ("general_cosine", a), 32, fftbins=True)
+        np.testing.assert_allclose(ours.numpy(),
+                                   sw.general_cosine(32, a, sym=False),
+                                   atol=1e-5)
+
+    def test_symmetric_variant(self):
+        from scipy.signal import windows as sw
+        ours = paddle.audio.functional.get_window("triang", 15,
+                                                  fftbins=False)
+        np.testing.assert_allclose(ours.numpy(), sw.triang(15, sym=True),
+                                   atol=1e-5)
+
+
+def _write_wav(path, data, sr=16000):
+    """data: float32 (channels, time) in (-1, 1)."""
+    paddle.audio.save(str(path), paddle.to_tensor(data), sr)
+
+
+class TestWaveBackend:
+    def test_save_load_roundtrip(self, tmp_path):
+        sr = 16000
+        t = np.linspace(0, 1, sr, dtype=np.float32)
+        wave = 0.5 * np.sin(2 * np.pi * 440 * t)[None, :]
+        f = tmp_path / "tone.wav"
+        _write_wav(f, wave, sr)
+
+        got, got_sr = paddle.audio.load(str(f))
+        assert got_sr == sr
+        assert tuple(got.shape) == (1, sr)
+        np.testing.assert_allclose(got.numpy(), wave, atol=1.0 / 2 ** 14)
+
+    def test_info(self, tmp_path):
+        f = tmp_path / "st.wav"
+        _write_wav(f, np.zeros((2, 800), np.float32), 8000)
+        info = paddle.audio.info(str(f))
+        assert (info.sample_rate, info.num_channels, info.num_samples,
+                info.bits_per_sample) == (8000, 2, 800, 16)
+
+    def test_load_options(self, tmp_path):
+        f = tmp_path / "m.wav"
+        data = (np.arange(100, dtype=np.float32) / 200)[None, :]
+        _write_wav(f, data, 8000)
+        raw, _ = paddle.audio.load(str(f), normalize=False)
+        assert abs(float(raw.numpy()[0, 50]) - round(0.25 * 2 ** 15)) <= 1
+        seg, _ = paddle.audio.load(str(f), frame_offset=10, num_frames=20,
+                                   channels_first=False)
+        assert tuple(seg.shape) == (20, 1)
+
+    def test_backend_registry(self):
+        assert "wave" in paddle.audio.backends.list_available_backends()
+        assert paddle.audio.backends.get_current_backend() == "wave"
+        with pytest.raises(NotImplementedError):
+            paddle.audio.backends.set_backend("nonexistent")
+
+    def test_non_wav_rejected(self, tmp_path):
+        f = tmp_path / "x.wav"
+        f.write_bytes(b"not a wav file at all")
+        with pytest.raises(NotImplementedError):
+            paddle.audio.load(str(f))
+
+
+class TestAudioDatasets:
+    def _make_esc50(self, root):
+        os.makedirs(root / "meta")
+        os.makedirs(root / "audio")
+        rows = ["filename,fold,target,category,esc10,src_file,take"]
+        rng = np.random.RandomState(0)
+        for i in range(10):
+            name = f"clip_{i}.wav"
+            fold = i % 5 + 1
+            rows.append(f"{name},{fold},{i % 3},cat{i % 3},False,src,A")
+            _write_wav(root / "audio" / name,
+                       rng.randn(1, 2048).astype(np.float32) * 0.1, 8000)
+        (root / "meta" / "esc50.csv").write_text("\n".join(rows))
+
+    def test_esc50_split_and_raw(self, tmp_path):
+        self._make_esc50(tmp_path)
+        train = paddle.audio.datasets.ESC50(mode="train", split=1,
+                                            data_dir=str(tmp_path))
+        dev = paddle.audio.datasets.ESC50(mode="dev", split=1,
+                                          data_dir=str(tmp_path))
+        assert len(train) + len(dev) == 10
+        assert len(dev) == 2  # fold 1 of 5
+        feat, label = train[0]
+        assert tuple(feat.shape) == (2048,) and isinstance(label, int)
+
+    def test_esc50_mfcc_features(self, tmp_path):
+        self._make_esc50(tmp_path)
+        ds = paddle.audio.datasets.ESC50(mode="dev", split=1,
+                                         data_dir=str(tmp_path),
+                                         feat_type="mfcc", n_mfcc=13)
+        feat, _ = ds[0]
+        assert feat.shape[0] == 13
+
+    def test_tess(self, tmp_path):
+        root = tmp_path / "TESS_Toronto_emotional_speech_set"
+        os.makedirs(root)
+        for i, emo in enumerate(["angry", "happy", "sad", "fear", "neutral",
+                                 "disgust", "ps", "angry", "happy", "sad"]):
+            _write_wav(root / f"OAF_word{i}_{emo}.wav",
+                       np.zeros((1, 512), np.float32), 8000)
+        train = paddle.audio.datasets.TESS(mode="train", n_folds=5, split=1,
+                                           data_dir=str(tmp_path))
+        dev = paddle.audio.datasets.TESS(mode="dev", n_folds=5, split=1,
+                                         data_dir=str(tmp_path))
+        assert len(train) + len(dev) == 10
+        _, label = train[0]
+        assert 0 <= label < 7
+
+    def test_missing_dir_raises(self):
+        with pytest.raises(RuntimeError, match="downloading is unavailable"):
+            paddle.audio.datasets.ESC50(data_dir="/nonexistent/path")
+
+
+class TestImikolov:
+    def _make_archive(self, path):
+        train = "a b c d\nb c d e\na a b b c c\n"
+        valid = "a b\nc d\n"
+        test = "a b c\nd e a\n"
+        with tarfile.open(path, "w:gz") as tf:
+            for name, text in [("ptb.train.txt", train),
+                               ("ptb.valid.txt", valid),
+                               ("ptb.test.txt", test)]:
+                data = text.encode()
+                ti = tarfile.TarInfo(f"./simple-examples/data/{name}")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+
+    def test_ngram(self, tmp_path):
+        f = tmp_path / "simple-examples.tgz"
+        self._make_archive(f)
+        ds = paddle.text.Imikolov(data_file=str(f), data_type="NGRAM",
+                                  window_size=2, mode="train",
+                                  min_word_freq=0)
+        assert len(ds) > 0
+        gram = ds[0]
+        assert len(gram) == 2 and all(g.shape == () for g in gram)
+
+    def test_seq_and_dict(self, tmp_path):
+        f = tmp_path / "simple-examples.tgz"
+        self._make_archive(f)
+        ds = paddle.text.Imikolov(data_file=str(f), data_type="SEQ",
+                                  mode="test", min_word_freq=0)
+        assert ds.word_idx["<unk>"] == len(ds.word_idx) - 1
+        src, trg = ds[0]
+        # shifted: src = <s> + ids, trg = ids + <e>
+        assert len(src) == len(trg)
+        assert src[0] == ds.word_idx["<s>"]
+        assert trg[-1] == ds.word_idx["<e>"]
+
+
+class TestMovielens:
+    def _make_zip(self, path):
+        movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+                  "2::Heat (1995)::Action|Crime\n")
+        users = "1::M::25::12::55117\n2::F::35::7::02460\n"
+        rng = np.random.RandomState(3)
+        ratings = "".join(
+            f"{rng.randint(1, 3)}::{rng.randint(1, 3)}::"
+            f"{rng.randint(1, 6)}::97830{i}\n" for i in range(40))
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("ml-1m/movies.dat", movies.encode("latin1"))
+            zf.writestr("ml-1m/users.dat", users.encode("latin1"))
+            zf.writestr("ml-1m/ratings.dat", ratings.encode("latin1"))
+
+    def test_split_and_record(self, tmp_path):
+        f = tmp_path / "ml-1m.zip"
+        self._make_zip(f)
+        train = paddle.text.Movielens(data_file=str(f), mode="train",
+                                      test_ratio=0.25, rand_seed=0)
+        test = paddle.text.Movielens(data_file=str(f), mode="test",
+                                     test_ratio=0.25, rand_seed=0)
+        assert len(train) + len(test) == 40
+        rec = train[0]
+        assert len(rec) == 8  # uid, gender, age, job, mid, cats, title, rating
+        uid, gender, age, job, mid, cats, title, rating = rec
+        assert gender[0] in (0, 1)
+        assert -5.0 <= rating[0] <= 5.0
+
+
+def _add_member(tf, name, text):
+    data = text.encode()
+    ti = tarfile.TarInfo(name)
+    ti.size = len(data)
+    tf.addfile(ti, io.BytesIO(data))
+
+
+class TestWMT:
+    def test_wmt14(self, tmp_path):
+        f = tmp_path / "wmt14.tgz"
+        with tarfile.open(f, "w:gz") as tf:
+            _add_member(tf, "data/src.dict", "<s>\n<e>\n<unk>\nhello\nworld\n")
+            _add_member(tf, "data/trg.dict",
+                        "<s>\n<e>\n<unk>\nbonjour\nmonde\n")
+            _add_member(tf, "train/train",
+                        "hello world\tbonjour monde\nhello\tbonjour\n")
+        ds = paddle.text.WMT14(data_file=str(f), mode="train")
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        # <s> hello world <e>
+        np.testing.assert_array_equal(src, [0, 3, 4, 1])
+        np.testing.assert_array_equal(trg, [0, 3, 4])
+        np.testing.assert_array_equal(trg_next, [3, 4, 1])
+        src_d, trg_d = ds.get_dict()
+        assert src_d["hello"] == 3 and trg_d["monde"] == 4
+
+    def test_wmt16_dict_built_from_train(self, tmp_path):
+        f = tmp_path / "wmt16.tgz"
+        with tarfile.open(f, "w:gz") as tf:
+            _add_member(tf, "wmt16/train",
+                        "a b a\tx y\nb a\ty x y\n")
+            _add_member(tf, "wmt16/test", "a c\tx z\n")
+        ds = paddle.text.WMT16(data_file=str(f), mode="test", lang="en")
+        # 'a' most common en word → id 3; unseen 'c' → <unk>=2
+        src, trg, trg_next = ds[0]
+        np.testing.assert_array_equal(src, [0, 3, 2, 1])
+        assert ds.get_dict("en")["a"] == 3
+        rev = ds.get_dict("de", reverse=True)
+        assert rev[3] in ("x", "y")
+
+
+class TestConll05:
+    def _make(self, tmp_path):
+        words = "The\ncat\nsat\non\nmats\n\n"
+        props = ("-\t(A0*\n-\t*)\nsat\t(V*)\n-\t(A1*\n-\t*)\n\n")
+
+        def gz(text):
+            buf = io.BytesIO()
+            with gzip.GzipFile(fileobj=buf, mode="w") as g:
+                g.write(text.encode())
+            return buf.getvalue()
+
+        f = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(f, "w:gz") as tf:
+            for name, blob in [
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 gz(words)),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 gz(props))]:
+                ti = tarfile.TarInfo(name)
+                ti.size = len(blob)
+                tf.addfile(ti, io.BytesIO(blob))
+        wd = tmp_path / "word.dict"
+        wd.write_text("The\ncat\nsat\non\nmats\nbos\neos\n")
+        vd = tmp_path / "verb.dict"
+        vd.write_text("sat\n")
+        td = tmp_path / "target.dict"
+        td.write_text("B-A0\nI-A0\nB-A1\nI-A1\nB-V\nI-V\nO\n")
+        return f, wd, vd, td
+
+    def test_parse_and_record(self, tmp_path):
+        f, wd, vd, td = self._make(tmp_path)
+        ds = paddle.text.Conll05st(data_file=str(f), word_dict_file=str(wd),
+                                   verb_dict_file=str(vd),
+                                   target_dict_file=str(td))
+        assert len(ds) == 1
+        rec = ds[0]
+        assert len(rec) == 9
+        word_idx, n2, n1, c0, p1, p2, pred, mark, label = rec
+        assert word_idx.tolist() == [0, 1, 2, 3, 4]
+        assert c0.tolist() == [2] * 5          # ctx_0 = 'sat'
+        assert pred.tolist() == [0] * 5        # verb dict id
+        assert mark.tolist() == [1, 1, 1, 1, 1]  # verb+-2 window all marked
+        labels = ds.label_dict
+        assert label[2] == labels["B-V"]
+        assert label[0] == labels["B-A0"] and label[1] == labels["I-A0"]
