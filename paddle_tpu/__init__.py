@@ -11,6 +11,9 @@ subpackages.
 
 import os as _os
 
+import jax as _jax
+import numpy as _np
+
 from . import flags  # noqa: F401
 from .core import dtype as _dtype_mod
 from .core.dtype import (  # noqa: F401
@@ -66,6 +69,37 @@ def is_tensor(x):
     return isinstance(x, Tensor)
 
 
+# -- long-tail top-level API (reference __all__ closure) -----------------------
+from .tensor_api import (  # noqa: E402,F401
+    mm, inner, tensordot, pdist, histogramdd, cumulative_trapezoid,
+    combinations, diagonal_scatter, select_scatter, slice_scatter,
+    scatter_nd, broadcast_shape, randint_like, standard_normal, rank,
+    tolist, view, clone, is_complex, is_floating_point, is_integer,
+    triu_indices, where_, floor_mod, set_printoptions, set_grad_enabled,
+    get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
+    in_dynamic_mode, disable_signal_handler, batch, check_shape)
+from .nn.layer_base import LazyGuard  # noqa: E402,F401
+from .nn.initializer import ParamAttr  # noqa: E402,F401
+
+# dtype objects at module level (reference paddle.bool / paddle.dtype)
+bool = _dtype_mod.bool_  # noqa: A001 — mirrors paddle.bool
+dtype = _np.dtype  # paddle.dtype: the type of dtype objects
+
+
+class CUDAPlace(device.Place):
+    """Reference-API alias: maps to this runtime's accelerator place
+    (there is no CUDA here; kept so reference code constructing
+    paddle.CUDAPlace(i) keeps running on the TPU/CPU device roster)."""
+
+    def __init__(self, idx: int = 0):
+        devs = _jax.devices()
+        super().__init__(devs[idx % len(devs)])
+
+
+class CUDAPinnedPlace(CUDAPlace):
+    """Pinned-memory alias (host staging is PJRT-managed here)."""
+
+
 # -- subpackages ---------------------------------------------------------------
 from . import autograd  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
@@ -90,6 +124,7 @@ from . import ops  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from .static import enable_static, disable_static  # noqa: E402,F401
+from .static import create_parameter  # noqa: E402,F401 — reference paddle.create_parameter
 from . import sparse  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
@@ -117,3 +152,9 @@ def finfo(dtype):
     import jax.numpy as _jnp
     from .core import dtype as _dt
     return _jnp.finfo(_dt.convert_dtype(dtype))
+
+
+# attach the long-tail Tensor methods (needs signal/static/linalg imported)
+from .tensor_api import _attach_tensor_methods as _atm  # noqa: E402
+_atm()
+del _atm

@@ -84,19 +84,21 @@ def _wave_load(filepath, frame_offset=0, num_frames=-1, normalize=True,
     try:
         channels = f.getnchannels()
         sample_rate = f.getframerate()
-        frames = f.getnframes()
-        raw = f.readframes(frames)
+        total = f.getnframes()
+        # read only the requested segment — a num_frames slice of an
+        # hour-long file must not decode the whole recording
+        if frame_offset:
+            f.setpos(min(int(frame_offset), total))
+        want = (total - frame_offset if num_frames == -1
+                else max(int(num_frames), 0))
+        raw = f.readframes(want)
     finally:
         if not caller_owned:
             file_obj.close()
     data = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
     if normalize:
         data = data / 2.0 ** 15
-    wavef = data.reshape(frames, channels)
-    if num_frames != -1:
-        wavef = wavef[frame_offset: frame_offset + num_frames, :]
-    elif frame_offset:
-        wavef = wavef[frame_offset:, :]
+    wavef = data.reshape(-1, channels)
     if channels_first:
         wavef = wavef.T
     return Tensor(np.ascontiguousarray(wavef)), sample_rate

@@ -48,6 +48,11 @@ def _traced_rng(key: jax.Array):
 
 
 def _collect_state(layer: Layer) -> Tuple[List[Tensor], List[Tensor]]:
+    from ..nn import layer_base
+    # LazyGuard-deferred params must materialize before a compiled path
+    # snapshots their buffers (zeros placeholders would be baked into the
+    # jit args and the real init silently lost)
+    layer_base._materialize_params(layer)
     params = list(layer.parameters())
     buffers = [b for _, b in layer.named_buffers()]
     return params, buffers

@@ -19,6 +19,51 @@ from ..core.tensor import Tensor
 from . import initializer as I
 
 
+# -- lazy parameter initialization (reference paddle.LazyGuard,
+# python/paddle/nn/initializer/lazy_init.py) ---------------------------------
+_lazy_depth = 0
+
+
+class LazyGuard:
+    """Defer parameter materialization (reference paddle.LazyGuard).
+
+    Inside the guard, Layer.create_parameter allocates only a host-RAM
+    zero buffer (on the CPU backend — no accelerator HBM is touched) and
+    records the initializer. The real initializer runs on the default
+    device at the first forward pass of the owning layer — after the
+    model has (optionally) been sharded, which is the TPU-native reason
+    to defer: init computes directly into the sharded layout. Pending
+    state is tracked per-Layer (`_has_lazy`), so lazily-built models
+    that are never run cost unrelated models nothing."""
+
+    def __enter__(self):
+        global _lazy_depth
+        _lazy_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _lazy_depth
+        _lazy_depth -= 1
+        return False
+
+
+def _materialize_one(p: "Parameter") -> None:
+    init, shape, dtype = p._lazy_spec
+    data = init(shape, dtype)
+    p._set_data(data._data if isinstance(data, Tensor) else data)
+    del p._lazy_spec
+
+
+def _materialize_params(layer: "Layer") -> None:
+    """Run deferred initializers for every lazy Parameter under `layer`
+    (compiled paths call this before snapshotting buffers)."""
+    for name, sub, _ in layer._walk(""):
+        if sub.__dict__.pop("_has_lazy", None):
+            for p in sub._parameters.values():
+                if p is not None and hasattr(p, "_lazy_spec"):
+                    _materialize_one(p)
+
+
 class Parameter(Tensor):
     """Trainable tensor (stop_gradient=False, persistable)."""
 
@@ -109,6 +154,20 @@ class Layer:
             init = attr.initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        if _lazy_depth > 0:
+            # LazyGuard active: host-RAM zeros placeholder, init deferred
+            import jax
+            import jax.numpy as jnp
+            cpu = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu):
+                placeholder = jnp.zeros(tuple(int(s) for s in shape),
+                                        dtype)
+            p = Parameter(placeholder)
+            p._lazy_spec = (init, tuple(int(s) for s in shape), dtype)
+            object.__setattr__(self, "_has_lazy", True)
+            if attr is not None and getattr(attr, "trainable", True) is False:
+                p.trainable = False
+            return p
         data = init(tuple(shape), dtype)
         p = Parameter(data)
         if attr is not None and getattr(attr, "trainable", True) is False:
@@ -261,6 +320,8 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        if "_has_lazy" in self.__dict__:
+            _materialize_params(self)
         for hook in self._forward_pre_hooks.values():
             res = hook(self, inputs)
             if res is not None:

@@ -523,7 +523,24 @@ def build_ops(yaml_path: str) -> Dict[str, Callable]:
             setattr(Tensor, schema.method, _as_method(fn))
     _attach_inplace_ops()
     _attach_dunders()
+    _attach_generic_methods()
     return dict(_OP_FNS)
+
+
+def _attach_generic_methods():
+    """Attach every tensor-first op as a Tensor method (reference
+    python/paddle/tensor/__init__.py tensor_method_func: the whole op
+    surface is monkey-patched onto Tensor). Explicit `method:` names from
+    the YAML win; existing attributes are never overridden."""
+    for name, schema in OPS.items():
+        if schema.inplace_of or name.startswith("_"):
+            continue
+        if not schema.params or schema.params[0].kind not in ("tensor",
+                                                              "tensors"):
+            continue
+        if hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, _as_method(_OP_FNS[name]))
 
 
 def _as_method(fn):
@@ -534,6 +551,35 @@ def _as_method(fn):
     return method
 
 
+def inplace_rebind(target: "Tensor", compute) -> "Tensor":
+    """Shared inplace discipline (used by every `*_` op and
+    tensor_api.where_): leaf guard, pre-op snapshot, rebind.
+
+    - reference EagerUtils::CheckInplace (eager/utils.cc:224): a
+      grad-requiring LEAF may not be written in place — its accumulated
+      grad would silently land on the snapshot;
+    - the op is recorded against a snapshot of the pre-op tensor so the
+      grad graph never references `target` (which is about to be
+      rebound) — a direct rebind creates a self-referential GradNode and
+      backward() loops forever."""
+    from ..autograd import engine as _eng
+    if (_eng.is_grad_enabled() and not target._stop_gradient
+            and target._node is None):
+        raise ValueError(
+            "Leaf Tensor that doesn't stop gradient can't use "
+            "inplace strategy")
+    snap = Tensor(target._data, stop_gradient=target._stop_gradient)
+    snap._node = target._node
+    snap._out_idx = target._out_idx
+    out = compute(snap)
+    target._set_data(out._data)
+    target._node = out._node
+    target._out_idx = out._out_idx
+    if out._node is not None:
+        target._stop_gradient = False
+    return target
+
+
 def _attach_inplace_ops():
     """x.add_(y) style: compute out-of-place, rebind buffer (donation-friendly)."""
     for name, schema in OPS.items():
@@ -541,21 +587,20 @@ def _attach_inplace_ops():
             base = _OP_FNS[schema.inplace_of]
 
             def ip(self, *args, _base=base, **kwargs):
-                # Record the op against a snapshot of the pre-op tensor so the
-                # grad graph never references `self` (which is about to be
-                # rebound) — avoids a self-referential GradNode cycle.
-                snap = Tensor(self._data, stop_gradient=self._stop_gradient)
-                snap._node = self._node
-                snap._out_idx = self._out_idx
-                out = _base(snap, *args, **kwargs)
-                self._set_data(out._data)
-                self._node = out._node
-                self._out_idx = out._out_idx
-                if out._node is not None:
-                    self._stop_gradient = False
-                return self
+                return inplace_rebind(
+                    self, lambda snap: _base(snap, *args, **kwargs))
 
             setattr(Tensor, name, ip)
+
+            # reference exports every inplace op at module level too
+            # (python/paddle/__init__.py __all__ lists abs_, tanh_, ...)
+            def fn(x, *args, _name=name, **kwargs):
+                return getattr(x, _name)(*args, **kwargs)
+
+            fn.__name__ = name
+            fn.__doc__ = (f"In-place variant of `{schema.inplace_of}` "
+                          f"(reference paddle.{name}).")
+            _OP_FNS[name] = fn
 
 
 def _binary_fast_key(schema):
@@ -689,3 +734,7 @@ def _attach_dunders():
     T.__gt__ = binop("greater_than")
     T.__ge__ = binop("greater_equal")
     T.__invert__ = lambda self: _OP_FNS["logical_not"](self)
+    # bitwise dunders (reference math_op_patch: & | ^ → bitwise ops)
+    T.__and__ = binop("bitwise_and");  T.__rand__ = binop("bitwise_and")
+    T.__or__ = binop("bitwise_or");    T.__ror__ = binop("bitwise_or")
+    T.__xor__ = binop("bitwise_xor");  T.__rxor__ = binop("bitwise_xor")

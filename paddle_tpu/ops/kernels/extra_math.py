@@ -28,6 +28,30 @@ def gammaincc_kernel(x, y):
     return _jsp.gammaincc(x, y)
 
 
+@register_kernel("gammainc")
+def gammainc_kernel(x, y):
+    """Regularized lower incomplete gamma P(x, y) (reference
+    phi/kernels/impl/gammainc_kernel_impl.h)."""
+    return _jsp.gammainc(x, y)
+
+
+@register_kernel("multigammaln")
+def multigammaln_kernel(x, p=1):
+    """log multivariate gamma (reference python/paddle/tensor/math.py
+    multigammaln: sum_i gammaln(x - i/2) + p(p-1)/4 * log(pi))."""
+    p = int(p)
+    i = jnp.arange(p, dtype=x.dtype)
+    return (_jsp.gammaln(x[..., None] - i / 2.0).sum(-1)
+            + p * (p - 1) / 4.0 * np.log(np.pi))
+
+
+@register_kernel("addmm")
+def addmm_kernel(input, x, y, beta=1.0, alpha=1.0):
+    """out = beta*input + alpha*(x @ y) (reference
+    phi/kernels/impl/addmm_kernel_impl.h)."""
+    return beta * input + alpha * (x @ y)
+
+
 @register_kernel("polygamma")
 def polygamma_kernel(x, n=1):
     return _jsp.polygamma(int(n), x)

@@ -67,6 +67,22 @@ def exponential(x, key=None, lam=1.0):
     return jax.random.exponential(key, x.shape, dtype=x.dtype) / lam
 
 
+@register_kernel("cauchy_like")
+def cauchy_like(x, key=None, loc=0.0, scale=1.0):
+    """Cauchy fill (reference Tensor.cauchy_): loc + scale*tan(pi*(u-1/2))."""
+    return loc + scale * jax.random.cauchy(key, x.shape, dtype=x.dtype)
+
+
+@register_kernel("geometric_like")
+def geometric_like(x, key=None, probs=0.5):
+    """Geometric fill (reference Tensor.geometric_; number of Bernoulli
+    trials to first success, support {1, 2, ...})."""
+    u = jax.random.uniform(key, x.shape, dtype=jnp.float32,
+                           minval=jnp.finfo(jnp.float32).tiny)
+    out = jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.clip(probs, 1e-7, 1 - 1e-7)))
+    return out.astype(x.dtype)
+
+
 @register_kernel("poisson")
 def poisson(x, key=None):
     return jax.random.poisson(key, x, dtype=jnp.int32).astype(x.dtype)
@@ -88,3 +104,11 @@ def dropout(x, key=None, p=0.5, training=True, mode="upscale_in_train"):
 @register_kernel("shuffle")
 def shuffle(x, key=None, axis=0):
     return jax.random.permutation(key, x, axis=axis)
+
+
+@register_kernel("uniform_like")
+def uniform_like(x, key=None, min=-1.0, max=1.0):
+    """Uniform fill on x's shape (reference Tensor.uniform_,
+    phi/kernels/gpu/uniform_inplace_kernel.cu)."""
+    return jax.random.uniform(key, x.shape, dtype=x.dtype,
+                              minval=min, maxval=max)

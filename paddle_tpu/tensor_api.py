@@ -1,0 +1,492 @@
+"""Long-tail top-level tensor API (reference python/paddle/__init__.py
+__all__ closure): linear-algebra conveniences (mm/inner/tensordot),
+distance/histogram ops, scatter-into views (diagonal/select/slice), dtype
+predicates, RNG-state facade, printoptions, the `batch` reader decorator,
+and grad-mode helpers. Each function cites its reference definition.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import engine as _engine
+from .core import dtype as _dtype_mod
+from .core.generator import default_generator
+from .core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "mm", "inner", "tensordot", "pdist", "histogramdd",
+    "cumulative_trapezoid", "combinations", "diagonal_scatter",
+    "select_scatter", "slice_scatter", "scatter_nd", "broadcast_shape",
+    "randint_like", "standard_normal", "rank", "tolist", "view", "clone",
+    "is_complex", "is_floating_point", "is_integer", "triu_indices",
+    "where_", "floor_mod", "set_printoptions", "set_grad_enabled",
+    "get_rng_state", "set_rng_state", "get_cuda_rng_state",
+    "set_cuda_rng_state", "in_dynamic_mode", "disable_signal_handler",
+    "batch", "check_shape",
+]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- linear algebra conveniences ----------------------------------------------
+
+def mm(input: Tensor, mat2: Tensor) -> Tensor:
+    """Matrix product without broadcasting (reference
+    python/paddle/tensor/math.py mm)."""
+    from . import matmul
+    return matmul(input, mat2)
+
+
+def inner(x: Tensor, y: Tensor) -> Tensor:
+    """Sum-product over the last dimension; output shape
+    x.shape[:-1] + y.shape[:-1] (reference tensor/math.py inner)."""
+    a, b = _data(x), _data(y)
+    if a.ndim == 0 or b.ndim == 0:
+        return Tensor(a * b)
+    return Tensor(jnp.inner(a, b))
+
+
+def tensordot(x: Tensor, y: Tensor, axes=2) -> Tensor:
+    """reference tensor/linalg.py tensordot: axes may be an int (contract
+    last-n with first-n), a list/tuple of two axis lists, or a single axis
+    list applied to both operands."""
+    a, b = _data(x), _data(y)
+    if isinstance(axes, Tensor):
+        axes = axes.numpy().tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = [ax.numpy().tolist() if isinstance(ax, Tensor) else ax
+                for ax in axes]
+        if len(axes) == 1:
+            axes = (axes[0], axes[0])
+        elif len(axes) == 2:
+            a_ax = axes[0] if isinstance(axes[0], (list, tuple)) else [axes[0]]
+            b_ax = axes[1] if isinstance(axes[1], (list, tuple)) else [axes[1]]
+            if len(a_ax) != len(b_ax):
+                # reference extends the shorter list with the longer
+                # list's tail (tensor/manipulation.py:
+                # axes_x.extend(axes_y[len_axes_x:]))
+                a_ax, b_ax = list(a_ax), list(b_ax)
+                if len(a_ax) < len(b_ax):
+                    a_ax.extend(b_ax[len(a_ax):])
+                else:
+                    b_ax.extend(a_ax[len(b_ax):])
+            axes = (tuple(a_ax), tuple(b_ax))
+        else:
+            axes = (tuple(axes), tuple(axes))
+    return Tensor(jnp.tensordot(a, b, axes=axes))
+
+
+def pdist(x: Tensor, p: float = 2.0) -> Tensor:
+    """Condensed pairwise p-norm distances of an [N, D] matrix →
+    [N*(N-1)/2] (reference tensor/linalg.py pdist; row order (0,1),
+    (0,2), ..., (N-2,N-1))."""
+    a = _data(x)
+    n = a.shape[0]
+    iu, ju = np.triu_indices(n, k=1)
+    diff = a[iu] - a[ju]
+    if p == 0:
+        d = jnp.count_nonzero(diff, axis=-1).astype(a.dtype)
+    elif p == float("inf"):
+        d = jnp.abs(diff).max(axis=-1)
+    elif p == 2.0:
+        d = jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    else:
+        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return Tensor(d)
+
+
+def histogramdd(x: Tensor, bins=10, ranges=None, density: bool = False,
+                weights: Optional[Tensor] = None):
+    """Multidimensional histogram of an [N, D] sample (reference
+    tensor/linalg.py histogramdd). Returns (hist, list-of-edges)."""
+    a = np.asarray(_data(x))
+    if weights is not None:
+        weights = np.asarray(_data(weights))
+    if isinstance(bins, (list, tuple)) and len(bins) and \
+            isinstance(bins[0], Tensor):
+        bins = [np.asarray(_data(b)) for b in bins]
+    rng = None
+    if ranges is not None:
+        flat = list(ranges)
+        rng = [(flat[2 * i], flat[2 * i + 1]) for i in range(len(flat) // 2)]
+    hist, edges = np.histogramdd(a, bins=bins, range=rng, density=density,
+                                 weights=weights)
+    return (Tensor(jnp.asarray(hist.astype(np.float32 if density
+                                           else a.dtype))),
+            [Tensor(jnp.asarray(e.astype(a.dtype))) for e in edges])
+
+
+def cumulative_trapezoid(y: Tensor, x: Optional[Tensor] = None,
+                         dx: Optional[float] = None, axis: int = -1
+                         ) -> Tensor:
+    """Cumulative trapezoidal integral (reference tensor/math.py
+    cumulative_trapezoid; result has size n-1 along `axis`)."""
+    yv = _data(y)
+    if x is not None and dx is not None:
+        raise ValueError("either x or dx should be provided, not both")
+    n = yv.shape[axis]
+    y0 = jax.lax.slice_in_dim(yv, 0, n - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(yv, 1, n, axis=axis)
+    if x is not None:
+        xv = _data(x)
+        if xv.ndim == 1:
+            shape = [1] * yv.ndim
+            shape[axis] = xv.shape[0]
+            xv = xv.reshape(shape)
+        d = (jax.lax.slice_in_dim(xv, 1, xv.shape[axis], axis=axis)
+             - jax.lax.slice_in_dim(xv, 0, xv.shape[axis] - 1, axis=axis))
+        seg = (y0 + y1) / 2.0 * d
+    else:
+        seg = (y0 + y1) / 2.0 * (1.0 if dx is None else dx)
+    return Tensor(jnp.cumsum(seg, axis=axis))
+
+
+def combinations(x: Tensor, r: int = 2, with_replacement: bool = False
+                 ) -> Tensor:
+    """r-combinations of a 1-D tensor → [C, r] (reference tensor/math.py
+    combinations)."""
+    import itertools
+    a = _data(x)
+    n = a.shape[0]
+    picker = (itertools.combinations_with_replacement if with_replacement
+              else itertools.combinations)
+    idx = np.array(list(picker(range(n), r)), dtype=np.int32)
+    if idx.size == 0:
+        return Tensor(jnp.zeros((0, r), a.dtype))
+    return Tensor(a[jnp.asarray(idx)])
+
+
+# -- scatter-into-view family -------------------------------------------------
+
+def diagonal_scatter(x: Tensor, y: Tensor, offset: int = 0, axis1: int = 0,
+                     axis2: int = 1) -> Tensor:
+    """Embed `y` into the (offset, axis1, axis2) diagonal of a copy of `x`
+    (reference tensor/manipulation.py diagonal_scatter)."""
+    a, b = _data(x), _data(y)
+    nd = a.ndim
+    ax1, ax2 = axis1 % nd, axis2 % nd
+    # move the two diagonal axes last, scatter, move back
+    perm = [i for i in range(nd) if i not in (ax1, ax2)] + [ax1, ax2]
+    inv = np.argsort(perm).tolist()
+    at = jnp.transpose(a, perm)
+    rows, cols = at.shape[-2], at.shape[-1]
+    if offset >= 0:
+        i = jnp.arange(min(rows, cols - offset))
+        j = i + offset
+    else:
+        j = jnp.arange(min(cols, rows + offset))
+        i = j - offset
+    out = at.at[..., i, j].set(b.astype(a.dtype))
+    return Tensor(jnp.transpose(out, inv))
+
+
+def select_scatter(x: Tensor, values: Tensor, axis: int, index: int
+                   ) -> Tensor:
+    """Write `values` into x[..., index, ...] along `axis` (reference
+    tensor/manipulation.py select_scatter)."""
+    a, v = _data(x), _data(values)
+    idx = [slice(None)] * a.ndim
+    idx[axis % a.ndim] = index
+    return Tensor(a.at[tuple(idx)].set(v.astype(a.dtype)))
+
+
+def slice_scatter(x: Tensor, value: Tensor, axes: Sequence[int],
+                  starts: Sequence[int], ends: Sequence[int],
+                  strides: Sequence[int]) -> Tensor:
+    """Write `value` into the strided slice of a copy of `x` (reference
+    tensor/manipulation.py slice_scatter)."""
+    a, v = _data(x), _data(value)
+    idx = [slice(None)] * a.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax % a.ndim] = slice(int(s), int(e), int(st))
+    return Tensor(a.at[tuple(idx)].set(v.astype(a.dtype)))
+
+
+def scatter_nd(index: Tensor, updates: Tensor, shape: Sequence[int]
+               ) -> Tensor:
+    """Zeros of `shape` with `updates` scatter-ADDED at `index` (reference
+    phi/kernels scatter_nd_add over a zero tensor; duplicate indices
+    accumulate)."""
+    idx, upd = _data(index), _data(updates)
+    zeros = jnp.zeros(tuple(int(s) for s in shape), upd.dtype)
+    if idx.shape[-1] == 0:
+        # rank-0 index tuple: add updates everywhere (degenerate reference
+        # case: index last dim 0 means full-tensor accumulate)
+        return Tensor(zeros + upd.reshape(zeros.shape))
+    flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+    return Tensor(zeros.at[flat_idx].add(upd))
+
+
+def broadcast_shape(x_shape: Sequence[int], y_shape: Sequence[int]
+                    ) -> List[int]:
+    """reference tensor/manipulation.py broadcast_shape."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# -- creation / conversion ----------------------------------------------------
+
+def randint_like(x: Tensor, low: int = 0, high: Optional[int] = None,
+                 dtype=None) -> Tensor:
+    """reference tensor/random.py randint_like."""
+    if high is None:
+        low, high = 0, low
+    a = _data(x)
+    dt = _dtype_mod.convert_dtype(dtype) or a.dtype
+    key = default_generator().next_key()
+    out = jax.random.randint(key, a.shape, int(low), int(high), jnp.int32)
+    return Tensor(out.astype(dt))
+
+
+def standard_normal(shape, dtype=None) -> Tensor:
+    """reference tensor/random.py standard_normal."""
+    dt = _dtype_mod.convert_dtype(dtype) or _dtype_mod.get_default_dtype()
+    key = default_generator().next_key()
+    return Tensor(jax.random.normal(key, tuple(int(s) for s in shape),
+                                    dtype=dt))
+
+
+def rank(input: Tensor) -> Tensor:
+    """0-D int32 tensor holding ndim (reference tensor/attribute.py rank)."""
+    return Tensor(jnp.asarray(_data(input).ndim, jnp.int32))
+
+
+def tolist(x: Tensor) -> list:
+    """reference tensor/manipulation.py tolist."""
+    return np.asarray(_data(x)).tolist()
+
+
+def view(x: Tensor, shape_or_dtype) -> Tensor:
+    """Reshape view or bitcast view (reference tensor/manipulation.py
+    view). XLA has no aliasing views; this returns a reshaped/bitcast
+    tensor (the reference's static-graph path copies too)."""
+    a = _data(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return Tensor(a.reshape(tuple(int(s) for s in shape_or_dtype)))
+    dt = _dtype_mod.convert_dtype(shape_or_dtype)
+    old, new = jnp.dtype(a.dtype).itemsize, jnp.dtype(dt).itemsize
+    if old == new:
+        return Tensor(jax.lax.bitcast_convert_type(a, dt))
+    if a.shape[-1] * old % new != 0:
+        raise ValueError(
+            f"cannot view {a.shape} {a.dtype} as {dt}: last-dim byte size "
+            f"{a.shape[-1] * old} not divisible by {new}")
+    if old < new:
+        # widening: XLA wants the collapsed ratio as an explicit trailing
+        # dim — reshape (..., n) → (..., n/r, r), bitcast drops the r
+        ratio = new // old
+        a = a.reshape(a.shape[:-1] + (a.shape[-1] // ratio, ratio))
+        return Tensor(jax.lax.bitcast_convert_type(a, dt))
+    # narrowing: bitcast appends the ratio dim — merge it back
+    out = jax.lax.bitcast_convert_type(a, dt)
+    return Tensor(out.reshape(a.shape[:-1] + (a.shape[-1] * old // new,)))
+
+
+def clone(x: Tensor) -> Tensor:
+    """reference tensor/creation.py clone (differentiable copy)."""
+    return x.clone()
+
+
+def is_complex(x: Tensor) -> bool:
+    return jnp.issubdtype(_data(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x: Tensor) -> bool:
+    return jnp.issubdtype(_data(x).dtype, jnp.floating)
+
+
+def is_integer(x: Tensor) -> bool:
+    return jnp.issubdtype(_data(x).dtype, jnp.integer)
+
+
+def triu_indices(row: int, col: Optional[int] = None, offset: int = 0,
+                 dtype="int64") -> Tensor:
+    """reference tensor/creation.py triu_indices → [2, n] tensor."""
+    if col is None:
+        col = row
+    i, j = np.triu_indices(int(row), k=int(offset), m=int(col))
+    dt = _dtype_mod.convert_dtype(dtype)
+    # build in numpy at the final width first (int64 truncates to int32
+    # under disabled x64 — avoid the jnp truncation warning)
+    stacked = np.stack([i, j]).astype(np.dtype(dt) if np.dtype(dt).itemsize <= 4
+                                      else np.int32)
+    return Tensor(jnp.asarray(stacked))
+
+
+def where_(condition: Tensor, x: Tensor, y: Tensor) -> Tensor:
+    """In-place where: writes select(condition, x, y) into `x` (reference
+    tensor/search.py where_). Note the modified operand is `x`, not the
+    first argument — which is why this is not a YAML inplace_of entry."""
+    from . import where
+    from .ops.dispatcher import inplace_rebind
+    return inplace_rebind(x, lambda snap: where(condition, snap, y))
+
+
+def floor_mod(x: Tensor, y: Tensor) -> Tensor:
+    """Alias of remainder (reference tensor/math.py floor_mod == mod)."""
+    from . import remainder
+    return remainder(x, y)
+
+
+# -- runtime facade -----------------------------------------------------------
+
+def set_printoptions(precision: Optional[int] = None,
+                     threshold: Optional[int] = None,
+                     edgeitems: Optional[int] = None,
+                     sci_mode: Optional[bool] = None,
+                     linewidth: Optional[int] = None) -> None:
+    """Tensor repr formatting (reference tensor/to_string.py
+    set_printoptions); maps onto numpy printoptions, which our repr
+    path uses."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+class set_grad_enabled:
+    """Grad-mode control, usable both as a plain statement and as a
+    context manager (reference base/dygraph/base.py set_grad_enabled:
+    __init__ applies the mode immediately; `with` restores on exit)."""
+
+    def __init__(self, mode: bool):
+        self._prev = _engine.is_grad_enabled()
+        _engine._grad_enabled = builtins.bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _engine._grad_enabled = self._prev
+        return False
+
+
+def get_rng_state(device: Optional[str] = None):
+    """reference framework/random.py get_rng_state: generator-state list."""
+    return [default_generator().get_state()]
+
+
+def set_rng_state(state_list, device: Optional[str] = None) -> None:
+    states = list(state_list)
+    if len(states) != 1:
+        raise ValueError(
+            f"Length of rng state list should be 1 (single-controller "
+            f"runtime), but got {len(states)}")
+    default_generator().set_state(states[0])
+
+
+def get_cuda_rng_state():
+    """CUDA-named alias kept for reference API compat (framework/random.py
+    get_cuda_rng_state); the accelerator generator is the same threefry
+    registry on TPU."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list) -> None:
+    set_rng_state(state_list)
+
+
+def in_dynamic_mode() -> bool:
+    """reference base/framework.py in_dynamic_mode."""
+    from .static import graph as _graph
+    return not _graph._static_mode
+
+
+def disable_signal_handler() -> None:
+    """No-op: the reference installs C++ crash handlers
+    (paddle/fluid/platform/init.cc SignalHandle) that this runtime never
+    installs, so there is nothing to disable."""
+
+
+def check_shape(shape) -> None:
+    """Validate a shape spec (reference utils/layers_utils.py
+    check_shape): entries must be ints (or -1 placeholders)."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if isinstance(s, Tensor):
+            continue
+        if not isinstance(s, (int, np.integer)):
+            raise TypeError(f"shape entries must be int, got {type(s)}")
+        if s < -1:
+            raise ValueError(f"invalid dim {s} in shape")
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Reader decorator grouping samples into lists of `batch_size`
+    (reference python/paddle/batch.py)."""
+    if not isinstance(batch_size, (int, np.integer)) or batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer")
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def _attach_tensor_methods():
+    """Attach this module's functions (plus a few cross-module ones) as
+    Tensor methods — the reference monkey-patches its whole op surface
+    onto Tensor (python/paddle/tensor/__init__.py tensor_method_func)."""
+    fns = [mm, inner, tensordot, pdist, histogramdd, cumulative_trapezoid,
+           combinations, diagonal_scatter, select_scatter, slice_scatter,
+           scatter_nd, randint_like, rank, tolist, view, is_complex,
+           is_floating_point, is_integer, where_, floor_mod]
+    for fn in fns:
+        if not hasattr(Tensor, fn.__name__):
+            setattr(Tensor, fn.__name__, fn)
+
+    def _broadcast_shape_method(self, y_shape):
+        return broadcast_shape(self.shape, y_shape)
+
+    if not hasattr(Tensor, "broadcast_shape"):
+        Tensor.broadcast_shape = _broadcast_shape_method
+
+    from .linalg import pca_lowrank
+    if not hasattr(Tensor, "pca_lowrank"):
+        Tensor.pca_lowrank = pca_lowrank
+
+    from . import signal as _signal
+    if not hasattr(Tensor, "stft"):
+        Tensor.stft = _signal.stft
+    if not hasattr(Tensor, "istft"):
+        Tensor.istft = _signal.istft
+
+    # Variable-era names the reference also binds (static-graph parity);
+    # bound as staticmethods so they stay callable with their real args
+    from . import static as _static
+
+    def _is_tensor(x):
+        return isinstance(x, Tensor)
+
+    def _create_tensor(dtype="float32", name=None, persistable=False):
+        # reference tensor/creation.py create_tensor: empty typed tensor
+        return Tensor(jnp.zeros((0,), _dtype_mod.convert_dtype(dtype)))
+
+    for name, fn in [("create_parameter", _static.create_parameter),
+                     ("create_tensor", _create_tensor),
+                     ("is_tensor", _is_tensor)]:
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, staticmethod(fn))

@@ -43,6 +43,8 @@ class Imikolov(Dataset):
                  data_type: str = "NGRAM", window_size: int = -1,
                  mode: str = "train", min_word_freq: int = 50,
                  download: bool = True):
+        data_type = data_type.upper()  # reference normalizes case
+        mode = mode.lower()
         assert data_type in ("NGRAM", "SEQ"), data_type
         assert mode in ("train", "test"), mode
         self.data_file = _require(data_file, "Imikolov")
