@@ -33,12 +33,17 @@ def _traced_rng(key: jax.Array):
     """Route generator.next_key() through a traced key during tracing so
     random ops stay random across compiled steps."""
     gen = generator.default_generator()
-    box = {"key": key}
+    box = {"n": 0}
     orig = gen.next_key
 
     def traced_next_key():
-        box["key"], sub = jax.random.split(box["key"])
-        return sub
+        # counter fold_in, NOT a sequential split chain: every subkey
+        # derives independently from the step's base key, so XLA can
+        # compute all mask keys in parallel instead of serializing ~40
+        # tiny threefry key-derivations through a data dependency (a
+        # measured ~4ms/step on BERT-base dropout)
+        box["n"] += 1
+        return jax.random.fold_in(key, box["n"])
 
     gen.next_key = traced_next_key
     try:

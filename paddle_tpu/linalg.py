@@ -31,36 +31,10 @@ triangular_solve = _get_op("triangular_solve")
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     """Randomized low-rank PCA → (U, S, V) with X ≈ U diag(S) Vᵀ
     (reference python/paddle/tensor/linalg.py:2546 pca_lowrank;
-    Halko-Martinsson-Tropp randomized range finder with `niter` power
-    iterations). TPU-native: pure jnp/QR — everything maps to MXU matmuls
-    and compiles under jit."""
-    import jax.numpy as jnp
-
-    from .core.generator import default_generator
-    from .core.tensor import Tensor
-    import jax
-
-    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    m, n = a.shape[-2], a.shape[-1]
-    if q is None:
-        q = min(6, m, n)
-    if not (0 <= q <= min(m, n)):
-        raise ValueError(f"q={q} must be in [0, {min(m, n)}]")
-    if center:
-        a = a - a.mean(axis=-2, keepdims=True)
-    key = default_generator().next_key()
-    omega = jax.random.normal(key, a.shape[:-2] + (n, q), dtype=a.dtype)
-    y = a @ omega
-    qmat, _ = jnp.linalg.qr(y)
-    for _ in range(int(niter)):
-        z = jnp.swapaxes(a, -2, -1) @ qmat
-        zq, _ = jnp.linalg.qr(z)
-        y = a @ zq
-        qmat, _ = jnp.linalg.qr(y)
-    b = jnp.swapaxes(qmat, -2, -1) @ a
-    u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
-    return (Tensor(qmat @ u_b), Tensor(s),
-            Tensor(jnp.swapaxes(vh, -2, -1)))
+    Halko-Martinsson-Tropp randomized range finder + power iterations,
+    kernels/tensor_api_ext.py). Dispatcher op: gradients flow and the
+    range-finder draw uses the global Generator key stream."""
+    return _get_op("pca_lowrank")(x, q=q, center=center, niter=int(niter))
 
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -333,6 +333,11 @@ def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
             primals.append(v._data)
             in_tensors.append(v)
         elif p.kind == "tensors":
+            if isinstance(v, Tensor):
+                # lone Tensor → one-element list: makes method-form calls
+                # of list-first ops (x.concat(), x.add_n()) well-defined
+                # instead of tripping Tensor.__bool__ in `v or ()`
+                v = [v]
             ts = [t if isinstance(t, Tensor) else Tensor(t) for t in (v or ())]
             present.append(len(ts) + 2)
             primals.extend(t._data for t in ts)

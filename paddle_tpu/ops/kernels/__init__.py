@@ -24,3 +24,4 @@ from . import extra_math  # noqa: F401
 from . import extra_nn  # noqa: F401
 from . import extra_misc  # noqa: F401
 from . import vision_io  # noqa: F401
+from . import tensor_api_ext  # noqa: F401

@@ -1,0 +1,153 @@
+"""Round-4 API-closure ops with real autograd (registered through the
+dispatcher so VJPs come from the standard cached-jax.vjp wiring — the
+first tensor_api.py cut computed on raw buffers and silently dropped
+gradients).
+
+Reference counterparts: python/paddle/tensor/{manipulation,math,linalg}.py
+tensordot/inner/pdist/cumulative_trapezoid/combinations and the
+diagonal/select/slice scatter family; pca_lowrank at linalg.py:2546.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatcher import register_kernel
+
+
+@register_kernel("tensordot_impl")
+def tensordot_impl(x, y, axes_x=(), axes_y=()):
+    """Contraction with pre-normalized per-operand axis lists (the
+    Python wrapper in tensor_api.py applies the reference's axes
+    normalization, manipulation.py:5306-5337, including the
+    extend-shorter-with-longer's-tail rule)."""
+    ax, ay = tuple(int(a) for a in axes_x), tuple(int(a) for a in axes_y)
+    # reference size-1 semantics (manipulation.py:5345-5352): a size-1
+    # dim paired with size-n sums the other operand over its dim
+    for i in range(len(ax)):
+        sx, sy = x.shape[ax[i]], y.shape[ay[i]]
+        if sx == 1 and sy != 1:
+            y = y.sum(axis=ay[i], keepdims=True)
+        elif sy == 1 and sx != 1:
+            x = x.sum(axis=ax[i], keepdims=True)
+    return jnp.tensordot(x, y, axes=(ax, ay))
+
+
+@register_kernel("inner")
+def inner_kernel(x, y):
+    if x.ndim == 0 or y.ndim == 0:
+        return x * y
+    return jnp.inner(x, y)
+
+
+@register_kernel("pdist")
+def pdist_kernel(x, p=2.0):
+    n = x.shape[0]
+    iu, ju = np.triu_indices(n, k=1)  # static (shape-derived) indices
+    diff = x[iu] - x[ju]
+    if p == 0:
+        return jnp.count_nonzero(diff, axis=-1).astype(x.dtype)
+    if p == float("inf"):
+        return jnp.abs(diff).max(axis=-1)
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+@register_kernel("cumulative_trapezoid")
+def cumulative_trapezoid_kernel(y, x=None, dx=None, axis=-1):
+    n = y.shape[axis]
+    y0 = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    if x is not None:
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = (jax.lax.slice_in_dim(x, 1, x.shape[axis], axis=axis)
+             - jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis))
+        seg = (y0 + y1) / 2.0 * d
+    else:
+        seg = (y0 + y1) / 2.0 * (1.0 if dx is None else dx)
+    return jnp.cumsum(seg, axis=axis)
+
+
+@register_kernel("combinations")
+def combinations_kernel(x, r=2, with_replacement=False):
+    import itertools
+    n = x.shape[0]
+    picker = (itertools.combinations_with_replacement if with_replacement
+              else itertools.combinations)
+    idx = np.array(list(picker(range(n), int(r))), dtype=np.int32)
+    if idx.size == 0:
+        return jnp.zeros((0, int(r)), x.dtype)
+    return x[jnp.asarray(idx)]
+
+
+@register_kernel("diagonal_scatter")
+def diagonal_scatter_kernel(x, y, offset=0, axis1=0, axis2=1):
+    nd = x.ndim
+    ax1, ax2 = axis1 % nd, axis2 % nd
+    perm = [i for i in range(nd) if i not in (ax1, ax2)] + [ax1, ax2]
+    inv = np.argsort(perm).tolist()
+    at = jnp.transpose(x, perm)
+    rows, cols = at.shape[-2], at.shape[-1]
+    if offset >= 0:
+        i = jnp.arange(min(rows, cols - offset))
+        j = i + offset
+    else:
+        j = jnp.arange(min(cols, rows + offset))
+        i = j - offset
+    out = at.at[..., i, j].set(y.astype(x.dtype))
+    return jnp.transpose(out, inv)
+
+
+@register_kernel("select_scatter")
+def select_scatter_kernel(x, values, axis=0, index=0):
+    idx = [slice(None)] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(values.astype(x.dtype))
+
+
+@register_kernel("slice_scatter")
+def slice_scatter_kernel(x, value, axes=(), starts=(), ends=(), strides=()):
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[int(ax) % x.ndim] = slice(int(s), int(e), int(st))
+    return x.at[tuple(idx)].set(value.astype(x.dtype))
+
+
+@register_kernel("scatter_nd")
+def scatter_nd_kernel(index, updates, shape=()):
+    zeros = jnp.zeros(tuple(int(s) for s in shape), updates.dtype)
+    if index.shape[-1] == 0:
+        return zeros + updates.reshape(zeros.shape)
+    flat_idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[flat_idx].add(updates)
+
+
+@register_kernel("pca_lowrank")
+def pca_lowrank_kernel(x, key=None, q=None, center=True, niter=2):
+    """Randomized PCA (Halko-Martinsson-Tropp range finder + power
+    iterations); qr/svd have jax VJPs, so grads flow."""
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    q = int(q)
+    if not (0 <= q <= min(m, n)):
+        raise ValueError(f"q={q} must be in [0, {min(m, n)}]")
+    if center:
+        x = x - x.mean(axis=-2, keepdims=True)
+    omega = jax.random.normal(key, x.shape[:-2] + (n, q), dtype=x.dtype)
+    y = x @ omega
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(int(niter)):
+        z = jnp.swapaxes(x, -2, -1) @ qmat
+        zq, _ = jnp.linalg.qr(z)
+        y = x @ zq
+        qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -2, -1) @ x
+    u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u_b, s, jnp.swapaxes(vh, -2, -1)

@@ -47,60 +47,50 @@ def mm(input: Tensor, mat2: Tensor) -> Tensor:
 
 def inner(x: Tensor, y: Tensor) -> Tensor:
     """Sum-product over the last dimension; output shape
-    x.shape[:-1] + y.shape[:-1] (reference tensor/math.py inner)."""
-    a, b = _data(x), _data(y)
-    if a.ndim == 0 or b.ndim == 0:
-        return Tensor(a * b)
-    return Tensor(jnp.inner(a, b))
+    x.shape[:-1] + y.shape[:-1] (reference tensor/math.py inner).
+    Routed through the dispatcher op so gradients flow."""
+    from .ops.dispatcher import get_op
+    return get_op("inner")(x, y)
 
 
 def tensordot(x: Tensor, y: Tensor, axes=2) -> Tensor:
-    """reference tensor/linalg.py tensordot: axes may be an int (contract
-    last-n with first-n), a list/tuple of two axis lists, or a single axis
-    list applied to both operands."""
-    a, b = _data(x), _data(y)
-    if isinstance(axes, Tensor):
-        axes = axes.numpy().tolist()
-    if isinstance(axes, (list, tuple)):
-        axes = [ax.numpy().tolist() if isinstance(ax, Tensor) else ax
-                for ax in axes]
-        if len(axes) == 1:
-            axes = (axes[0], axes[0])
-        elif len(axes) == 2:
-            a_ax = axes[0] if isinstance(axes[0], (list, tuple)) else [axes[0]]
-            b_ax = axes[1] if isinstance(axes[1], (list, tuple)) else [axes[1]]
-            if len(a_ax) != len(b_ax):
-                # reference extends the shorter list with the longer
-                # list's tail (tensor/manipulation.py:
-                # axes_x.extend(axes_y[len_axes_x:]))
-                a_ax, b_ax = list(a_ax), list(b_ax)
-                if len(a_ax) < len(b_ax):
-                    a_ax.extend(b_ax[len(a_ax):])
-                else:
-                    b_ax.extend(a_ax[len(b_ax):])
-            axes = (tuple(a_ax), tuple(b_ax))
+    """reference tensor/manipulation.py tensordot (normalization at
+    :5306-5337): int axes contract x's last-n with y's first-n; a FLAT
+    int list applies to both operands; a pair of lists is per-operand,
+    the shorter extended with the other's tail. Routed through the
+    dispatcher op so gradients flow."""
+    from .ops.dispatcher import get_op
+
+    def to_list(a):
+        return a.numpy().tolist() if isinstance(a, Tensor) else a
+
+    axes = to_list(axes)
+    if isinstance(axes, (int, np.integer)):
+        if axes < 0:
+            raise ValueError(f"'axes' should not be negative, got {axes}")
+        nx, ny = len(x.shape), len(y.shape)
+        axes_x = list(range(nx - axes, nx))
+        axes_y = list(range(axes))
+    else:
+        axes = [to_list(a) for a in axes]
+        if not axes or isinstance(axes[0], (int, np.integer)):
+            axes_x, axes_y = list(axes), []      # flat list → both
         else:
-            axes = (tuple(axes), tuple(axes))
-    return Tensor(jnp.tensordot(a, b, axes=axes))
+            axes_x = list(axes[0])
+            axes_y = list(axes[1]) if len(axes) > 1 else []
+        if len(axes_x) < len(axes_y):
+            axes_x.extend(axes_y[len(axes_x):])
+        elif len(axes_y) < len(axes_x):
+            axes_y.extend(axes_x[len(axes_y):])
+    return get_op("tensordot_impl")(x, y, axes_x=axes_x, axes_y=axes_y)
 
 
 def pdist(x: Tensor, p: float = 2.0) -> Tensor:
     """Condensed pairwise p-norm distances of an [N, D] matrix →
     [N*(N-1)/2] (reference tensor/linalg.py pdist; row order (0,1),
     (0,2), ..., (N-2,N-1))."""
-    a = _data(x)
-    n = a.shape[0]
-    iu, ju = np.triu_indices(n, k=1)
-    diff = a[iu] - a[ju]
-    if p == 0:
-        d = jnp.count_nonzero(diff, axis=-1).astype(a.dtype)
-    elif p == float("inf"):
-        d = jnp.abs(diff).max(axis=-1)
-    elif p == 2.0:
-        d = jnp.sqrt(jnp.sum(diff * diff, axis=-1))
-    else:
-        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
-    return Tensor(d)
+    from .ops.dispatcher import get_op
+    return get_op("pdist")(x, p=float(p))
 
 
 def histogramdd(x: Tensor, bins=10, ranges=None, density: bool = False,
@@ -129,39 +119,19 @@ def cumulative_trapezoid(y: Tensor, x: Optional[Tensor] = None,
                          ) -> Tensor:
     """Cumulative trapezoidal integral (reference tensor/math.py
     cumulative_trapezoid; result has size n-1 along `axis`)."""
-    yv = _data(y)
     if x is not None and dx is not None:
         raise ValueError("either x or dx should be provided, not both")
-    n = yv.shape[axis]
-    y0 = jax.lax.slice_in_dim(yv, 0, n - 1, axis=axis)
-    y1 = jax.lax.slice_in_dim(yv, 1, n, axis=axis)
-    if x is not None:
-        xv = _data(x)
-        if xv.ndim == 1:
-            shape = [1] * yv.ndim
-            shape[axis] = xv.shape[0]
-            xv = xv.reshape(shape)
-        d = (jax.lax.slice_in_dim(xv, 1, xv.shape[axis], axis=axis)
-             - jax.lax.slice_in_dim(xv, 0, xv.shape[axis] - 1, axis=axis))
-        seg = (y0 + y1) / 2.0 * d
-    else:
-        seg = (y0 + y1) / 2.0 * (1.0 if dx is None else dx)
-    return Tensor(jnp.cumsum(seg, axis=axis))
+    from .ops.dispatcher import get_op
+    return get_op("cumulative_trapezoid")(y, x, dx=dx, axis=int(axis))
 
 
 def combinations(x: Tensor, r: int = 2, with_replacement: bool = False
                  ) -> Tensor:
     """r-combinations of a 1-D tensor → [C, r] (reference tensor/math.py
     combinations)."""
-    import itertools
-    a = _data(x)
-    n = a.shape[0]
-    picker = (itertools.combinations_with_replacement if with_replacement
-              else itertools.combinations)
-    idx = np.array(list(picker(range(n), r)), dtype=np.int32)
-    if idx.size == 0:
-        return Tensor(jnp.zeros((0, r), a.dtype))
-    return Tensor(a[jnp.asarray(idx)])
+    from .ops.dispatcher import get_op
+    return get_op("combinations")(x, r=int(r),
+                                  with_replacement=bool(with_replacement))
 
 
 # -- scatter-into-view family -------------------------------------------------
@@ -170,32 +140,18 @@ def diagonal_scatter(x: Tensor, y: Tensor, offset: int = 0, axis1: int = 0,
                      axis2: int = 1) -> Tensor:
     """Embed `y` into the (offset, axis1, axis2) diagonal of a copy of `x`
     (reference tensor/manipulation.py diagonal_scatter)."""
-    a, b = _data(x), _data(y)
-    nd = a.ndim
-    ax1, ax2 = axis1 % nd, axis2 % nd
-    # move the two diagonal axes last, scatter, move back
-    perm = [i for i in range(nd) if i not in (ax1, ax2)] + [ax1, ax2]
-    inv = np.argsort(perm).tolist()
-    at = jnp.transpose(a, perm)
-    rows, cols = at.shape[-2], at.shape[-1]
-    if offset >= 0:
-        i = jnp.arange(min(rows, cols - offset))
-        j = i + offset
-    else:
-        j = jnp.arange(min(cols, rows + offset))
-        i = j - offset
-    out = at.at[..., i, j].set(b.astype(a.dtype))
-    return Tensor(jnp.transpose(out, inv))
+    from .ops.dispatcher import get_op
+    return get_op("diagonal_scatter")(x, y, offset=int(offset),
+                                      axis1=int(axis1), axis2=int(axis2))
 
 
 def select_scatter(x: Tensor, values: Tensor, axis: int, index: int
                    ) -> Tensor:
     """Write `values` into x[..., index, ...] along `axis` (reference
     tensor/manipulation.py select_scatter)."""
-    a, v = _data(x), _data(values)
-    idx = [slice(None)] * a.ndim
-    idx[axis % a.ndim] = index
-    return Tensor(a.at[tuple(idx)].set(v.astype(a.dtype)))
+    from .ops.dispatcher import get_op
+    return get_op("select_scatter")(x, values, axis=int(axis),
+                                    index=int(index))
 
 
 def slice_scatter(x: Tensor, value: Tensor, axes: Sequence[int],
@@ -203,11 +159,10 @@ def slice_scatter(x: Tensor, value: Tensor, axes: Sequence[int],
                   strides: Sequence[int]) -> Tensor:
     """Write `value` into the strided slice of a copy of `x` (reference
     tensor/manipulation.py slice_scatter)."""
-    a, v = _data(x), _data(value)
-    idx = [slice(None)] * a.ndim
-    for ax, s, e, st in zip(axes, starts, ends, strides):
-        idx[ax % a.ndim] = slice(int(s), int(e), int(st))
-    return Tensor(a.at[tuple(idx)].set(v.astype(a.dtype)))
+    from .ops.dispatcher import get_op
+    return get_op("slice_scatter")(x, value, axes=list(axes),
+                                   starts=list(starts), ends=list(ends),
+                                   strides=list(strides))
 
 
 def scatter_nd(index: Tensor, updates: Tensor, shape: Sequence[int]
@@ -215,14 +170,9 @@ def scatter_nd(index: Tensor, updates: Tensor, shape: Sequence[int]
     """Zeros of `shape` with `updates` scatter-ADDED at `index` (reference
     phi/kernels scatter_nd_add over a zero tensor; duplicate indices
     accumulate)."""
-    idx, upd = _data(index), _data(updates)
-    zeros = jnp.zeros(tuple(int(s) for s in shape), upd.dtype)
-    if idx.shape[-1] == 0:
-        # rank-0 index tuple: add updates everywhere (degenerate reference
-        # case: index last dim 0 means full-tensor accumulate)
-        return Tensor(zeros + upd.reshape(zeros.shape))
-    flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
-    return Tensor(zeros.at[flat_idx].add(upd))
+    from .ops.dispatcher import get_op
+    return get_op("scatter_nd")(index, updates,
+                                shape=[int(s) for s in shape])
 
 
 def broadcast_shape(x_shape: Sequence[int], y_shape: Sequence[int]
@@ -267,9 +217,10 @@ def view(x: Tensor, shape_or_dtype) -> Tensor:
     """Reshape view or bitcast view (reference tensor/manipulation.py
     view). XLA has no aliasing views; this returns a reshaped/bitcast
     tensor (the reference's static-graph path copies too)."""
-    a = _data(x)
     if isinstance(shape_or_dtype, (list, tuple)):
-        return Tensor(a.reshape(tuple(int(s) for s in shape_or_dtype)))
+        from . import reshape
+        return reshape(x, shape=[int(s) for s in shape_or_dtype])
+    a = _data(x)
     dt = _dtype_mod.convert_dtype(shape_or_dtype)
     old, new = jnp.dtype(a.dtype).itemsize, jnp.dtype(dt).itemsize
     if old == new:
