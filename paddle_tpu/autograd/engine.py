@@ -148,9 +148,12 @@ def register_tensor_hook(t: Tensor, hook: Callable):
 
 def _run_hooks(hooks, g: jax.Array) -> jax.Array:
     for hook in hooks:  # hook: Tensor -> Tensor | None
-        res = hook(Tensor(g))
+        res = hook(g if isinstance(g, Tensor) else Tensor(g))
         if res is not None:
-            g = res._data if isinstance(res, Tensor) else res
+            if isinstance(g, Tensor):
+                g = res if isinstance(res, Tensor) else Tensor(res)
+            else:
+                g = res._data if isinstance(res, Tensor) else res
     return g
 
 
@@ -160,9 +163,75 @@ def _is_float0(arr) -> bool:
     return getattr(arr, "dtype", None) == jax.dtypes.float0
 
 
+def _second_order_vjp(fn, n_p: int, diff_slots):
+    """VJP of a node's first-order vjp_callable.
+
+    `fn(primals, cts) -> grads-aligned-with-primals` is jax-traceable (it
+    closes over jitted executables / jax.vjp pullbacks, both of which trace),
+    so differentiating THROUGH it gives the double-grad the reference eager
+    engine computes by re-walking higher-order GradNodes
+    (paddle/fluid/eager/general_grad.h; backward.cc:429 RunBackward with
+    create_graph). Returns grads aligned with (primals + cts)."""
+
+    def vjp2(primals2, cts2):
+        prim, cts_in = primals2[:n_p], primals2[n_p:]
+
+        def g_fn(*args):
+            outs = fn(tuple(args[:n_p]), tuple(args[n_p:]))
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            return tuple(outs[i] for i in diff_slots)
+
+        _, pull = jax.vjp(g_fn, *prim, *cts_in)
+        return list(pull(tuple(cts2)))
+
+    return vjp2
+
+
+def _run_vjp_create_graph(node: "GradNode", ct_tensors):
+    """Run one node's vjp with the call itself recorded on the tape.
+
+    The produced input-grads become tape tensors whose GradNode is the VJP
+    application — so a second backward() differentiates through them
+    (create_graph=True semantics)."""
+    fn = node.vjp_callable
+    primals = node.primals
+    cts = tuple(t._data for t in ct_tensors)
+    raw = fn(primals, cts)
+    if not isinstance(raw, (tuple, list)):
+        raw = (raw,)
+    results: List[Optional[Tensor]] = []
+    out_tensors: List[Tensor] = []
+    diff_slots: List[int] = []
+    for i, g in enumerate(raw):
+        t_in = node.in_tensors[i] if i < len(node.in_tensors) else None
+        if g is None or _is_float0(g) or t_in is None or t_in._stop_gradient:
+            results.append(None)
+        else:
+            gt = Tensor(g)
+            results.append(gt)
+            out_tensors.append(gt)
+            diff_slots.append(i)
+    if out_tensors and _grad_enabled:
+        vjp2 = _second_order_vjp(fn, len(primals), tuple(diff_slots))
+        record_node("grad::" + node.op_name, vjp2,
+                    tuple(primals) + cts,
+                    list(node.in_tensors) + list(ct_tensors),
+                    out_tensors)
+    return results
+
+
 def backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]],
-             retain_graph: bool = False) -> None:
-    """Run reverse accumulation from `tensors` into leaf `.grad` slots."""
+             retain_graph: bool = False, create_graph: bool = False,
+             accumulate_ids=None, capture: Sequence[Tensor] = ()) -> None:
+    """Run reverse accumulation from `tensors` into leaf `.grad` slots.
+
+    `accumulate_ids`: optional set of id(tensor) — when given, only those
+    leaves receive .grad (the functional-grad path: torch/paddle
+    autograd.grad semantics, which never touch other leaves' .grad).
+    `capture`: non-leaf tensors whose fully-accumulated cotangent should be
+    deposited into their .grad too (functional grad() with intermediate
+    inputs — the walk normally flows THROUGH non-leaves without storing)."""
     # Seed cotangents.
     heap = []          # max-heap over node id → reverse topological order
     in_heap: Dict[int, GradNode] = {}
@@ -190,12 +259,21 @@ def backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]]
             g_arr = jnp.ones_like(t._data)
         else:
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            # seed cotangents join the tape; keep the caller's Tensor
+            # identity (leaf or not) so grads W.R.T. grad_outputs work —
+            # the double-vjp pattern differentiates through the seed
+            g_arr = g if isinstance(g, Tensor) else Tensor(g_arr)
         if t._node is None:
             if not t._stop_gradient:
                 accumulate_leaf(t, g_arr)
             continue
         t._node.accumulate_out_grad(t._out_idx, g_arr)
         push(t._node)
+
+    # (node-id, out_idx) -> non-leaf input tensor whose cotangent we capture
+    cap_slots = {(t._node.id, t._out_idx): t for t in capture
+                 if t._node is not None}
 
     while heap:
         node = in_heap.pop(-heapq.heappop(heap))
@@ -204,11 +282,23 @@ def backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]]
         for idx, hook in node.hooks:
             if node.out_grads[idx] is not None:
                 node.out_grads[idx] = _run_hooks([hook], node.out_grads[idx])
-        cts = tuple(
-            g if g is not None else jnp.zeros(shape, dtype)
-            for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
-        )
-        in_grads = node.vjp_callable(node.primals, cts)
+        if cap_slots:  # after hooks: captured grad == the propagated one
+            for idx, g in enumerate(node.out_grads):
+                t_cap = cap_slots.get((node.id, idx))
+                if t_cap is not None and g is not None:
+                    accumulate_leaf(t_cap, g)
+        if create_graph:
+            ct_tensors = [
+                g if g is not None else Tensor(jnp.zeros(shape, dtype))
+                for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
+            ]
+            in_grads = _run_vjp_create_graph(node, ct_tensors)
+        else:
+            cts = tuple(
+                g if g is not None else jnp.zeros(shape, dtype)
+                for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
+            )
+            in_grads = node.vjp_callable(node.primals, cts)
         if not isinstance(in_grads, (tuple, list)):
             in_grads = (in_grads,)
         for t, g in zip(node.in_tensors, in_grads):
@@ -224,13 +314,21 @@ def backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]]
         node.out_grads = [None] * len(node.out_avals)  # per-pass accumulator
 
     for _, (t, g) in leaf_acc.items():
+        if accumulate_ids is not None and id(t) not in accumulate_ids:
+            continue
         g = _run_hooks(getattr(t, "_leaf_hooks", None) or (), g)
-        if t._grad is None:
-            t._grad = Tensor(g)
+        if create_graph:
+            gt = g if isinstance(g, Tensor) else Tensor(g)
+            # keep the tape connection: .grad is a non-leaf tensor whose
+            # GradNode is the recorded VJP application
+            t._grad = gt if t._grad is None else t._grad + gt
+        elif t._grad is None:
+            t._grad = Tensor(g._data if isinstance(g, Tensor) else g)
         else:
-            t._grad._set_data(t._grad._data + g)
+            t._grad._set_data(
+                t._grad._data + (g._data if isinstance(g, Tensor) else g))
 
-    if not retain_graph:
+    if not (retain_graph or create_graph):
         for t in tensors:
             _free_graph(t)
 
@@ -246,8 +344,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
          allow_unused=False):
     """Functional paddle.grad: returns grads of `outputs` w.r.t. `inputs`.
 
-    Implemented over the same tape (create_graph/higher-order goes through
-    paddle_tpu.incubate.autograd jax transforms instead).
+    Implemented over the same tape. With create_graph=True every VJP
+    application during the walk is itself recorded as a tape op (the
+    returned grads carry a GradNode), so differentiating them again — via
+    another grad()/backward() — computes true double grads, matching the
+    reference eager engine's higher-order path
+    (paddle/fluid/eager/general_grad.h, backward.cc:429).
     """
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
@@ -255,19 +357,24 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
         grad_outputs = [None] * len(outputs)
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.incubate.autograd (jax.grad) "
-            "for higher-order differentiation")
     saved = [(t, t._grad) for t in inputs]
     for t in inputs:
         t._grad = None
-    backward(outputs, grad_outputs, retain_graph=retain_graph)
-    result = []
-    for t, old in saved:
+    backward(outputs, grad_outputs,
+             retain_graph=retain_graph or create_graph,
+             create_graph=create_graph,
+             accumulate_ids={id(t) for t in inputs},
+             capture=[t for t in inputs if t._node is not None])
+    result, unused = [], None
+    for i, (t, old) in enumerate(saved):
         g = t._grad
-        if g is None and not allow_unused:
-            g = Tensor(jnp.zeros_like(t._data))
+        if g is None and unused is None:
+            unused = i
         result.append(g)
-        t._grad = old
+        t._grad = old  # restore ALL before any raise: no side effects
+    if unused is not None and not allow_unused:
+        raise ValueError(
+            f"The {unused}th input tensor is not used in the graph of "
+            f"the given outputs (set allow_unused=True to return None "
+            f"for it)")
     return result

@@ -419,13 +419,21 @@ def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
 
             _, vjp_fn = jax.vjp(f_float, *primals)
             out_dtypes = [o.dtype for o in out_arrays]
+            stored = tuple(primals)
 
-            def vjp_callable(primals_, cts, _vjp=vjp_fn, _dts=out_dtypes):
+            def vjp_callable(primals_, cts, _vjp=vjp_fn, _dts=out_dtypes,
+                             _stored=stored, _f=f_float):
                 cts_f = tuple(c for c, dt in zip(cts, _dts)
                               if jnp.issubdtype(dt, jnp.inexact))
-                return _vjp(cts_f)
+                if primals_ is _stored:
+                    return _vjp(cts_f)  # fast path: residuals already held
+                # functional re-derivation: under create_graph the engine
+                # differentiates THROUGH this callable with traced primals,
+                # so the vjp must actually depend on its arguments
+                _, fresh = jax.vjp(_f, *primals_)
+                return fresh(cts_f)
 
-            engine.record_node(schema.name, vjp_callable, tuple(primals),
+            engine.record_node(schema.name, vjp_callable, stored,
                                in_tensors, outs)
 
     if len(outs) == 1:
