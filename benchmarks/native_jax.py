@@ -196,7 +196,8 @@ def _bert_fwd(p, ids, layers, heads, dropout=0.0, key=None):
 def make_bert_step(batch: int, seq: int, vocab: int = 30522,
                    hidden: int = 768, layers: int = 12, heads: int = 12,
                    ffn: int = 3072, lr: float = 3e-5, dropout: float = 0.0,
-                   dtype=jnp.float32, key_impl: str = "rbg"):
+                   dtype=jnp.float32, key_impl: str = "rbg",
+                   amp_o2: bool = False):
     # rbg keys: dropout-mask generation via XLA RngBitGenerator, the
     # strongest-baseline choice on TPU (threefry masks cost ~12ms/step
     # extra at BERT-base b8 s384 — measured round 4); same impl the
@@ -207,6 +208,14 @@ def make_bert_step(batch: int, seq: int, vocab: int = 30522,
     v = jax.tree.map(jnp.zeros_like, p)
 
     def loss_fn(p_, ids, starts, ends, key):
+        if amp_o2:
+            # AMP O2 twin: bf16 compute against f32 master weights +
+            # f32 Adam states — the exact regime the framework step uses
+            # on TPU (ADVICE r4: the baseline must not run in f32 while
+            # 'ours' runs bf16)
+            p_ = jax.tree.map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, p_)
         logits = _bert_fwd(p_, ids, layers, heads, dropout,
                            key).astype(jnp.float32)
         ls = jax.nn.log_softmax(logits[..., 0], -1)

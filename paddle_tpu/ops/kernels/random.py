@@ -75,11 +75,16 @@ def cauchy_like(x, key=None, loc=0.0, scale=1.0):
 
 @register_kernel("geometric_like")
 def geometric_like(x, key=None, probs=0.5):
-    """Geometric fill (reference Tensor.geometric_; number of Bernoulli
-    trials to first success, support {1, 2, ...})."""
+    """Geometric fill (reference Tensor.geometric_,
+    python/paddle/tensor/creation.py:2882): log(u)/log1p(-probs) with NO
+    rounding — the reference emits continuous positive values (its
+    docstring example includes 0.16), not integer trial counts.
+    Deliberate deviation: probs is clamped to [1e-7, 1-1e-7] so
+    degenerate probs (0, 1, out-of-range) yield finite samples instead
+    of inf/NaN (the reference leaves validation to the caller)."""
     u = jax.random.uniform(key, x.shape, dtype=jnp.float32,
                            minval=jnp.finfo(jnp.float32).tiny)
-    out = jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.clip(probs, 1e-7, 1 - 1e-7)))
+    out = jnp.log(u) / jnp.log1p(-jnp.clip(probs, 1e-7, 1 - 1e-7))
     return out.astype(x.dtype)
 
 

@@ -288,6 +288,9 @@ def floor_mod(x: Tensor, y: Tensor) -> Tensor:
 
 # -- runtime facade -----------------------------------------------------------
 
+_sci_state = False  # sticky sci_mode across set_printoptions calls
+
+
 def set_printoptions(precision: Optional[int] = None,
                      threshold: Optional[int] = None,
                      edgeitems: Optional[int] = None,
@@ -305,8 +308,23 @@ def set_printoptions(precision: Optional[int] = None,
         kw["edgeitems"] = edgeitems
     if linewidth is not None:
         kw["linewidth"] = linewidth
+    global _sci_state
     if sci_mode is not None:
+        _sci_state = bool(sci_mode)
         kw["suppress"] = not sci_mode
+    if _sci_state:
+        # numpy has no force-scientific flag; install a float formatter
+        # so sci_mode=True actually renders exponents the way the
+        # reference's to_string.py does (ADVICE r4). Rebuilt on EVERY
+        # call while sci mode is on, so a later precision= change takes
+        # effect instead of being shadowed by a stale formatter.
+        prec = (precision if precision is not None
+                else np.get_printoptions()["precision"])
+        kw["formatter"] = {"float_kind":
+                           lambda v, _p=prec:
+                           np.format_float_scientific(v, precision=_p)}
+    elif sci_mode is not None:
+        kw["formatter"] = None
     np.set_printoptions(**kw)
 
 

@@ -1,0 +1,141 @@
+"""Headline-bench robustness (VERDICT r4 Missing#1 / Next#1+#7).
+
+The flagship MFU metric must never read 0.0 because one geometry OOMed:
+bench_llama_headline walks a pre-registered fallback ladder on
+RESOURCE_EXHAUSTED, and _run_isolated promotes the best companion
+geometry if every headline rung fails. Reference stance: benchmark
+robustness as CI infrastructure (tools/ci_op_benchmark.sh,
+check_op_benchmark_result.py).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+class _FakeOOM(RuntimeError):
+    pass
+
+
+class TestHeadlineLadder:
+    def test_pinned_geometry_is_preregistered(self):
+        # rung 0 is the frozen r5 headline: stated in code before any
+        # measurement, remat on (selective), NOT the r4 sweep argmax
+        r0 = bench._HEADLINE_LADDER[0]
+        assert r0["rung"] == 0
+        assert r0["recompute"] == "selective"
+        # ladder strictly loosens memory pressure going down
+        assert [r["rung"] for r in bench._HEADLINE_LADDER] == [0, 1, 2, 3, 4]
+
+    def test_explicit_env_geometry_bypasses_ladder(self, monkeypatch):
+        monkeypatch.setenv("PTPU_BENCH_BATCH", "8")
+        monkeypatch.setattr(bench, "bench_llama",
+                            lambda on_tpu, dev: {"mfu": 0.2})
+        r = bench.bench_llama_headline(True, None)
+        assert "rung" not in r  # user sweep geometry ran verbatim
+
+    def test_ladder_descends_on_oom(self, monkeypatch):
+        for k in ("PTPU_BENCH_BATCH", "PTPU_BENCH_LAYERS",
+                  "PTPU_RECOMPUTE"):
+            monkeypatch.delenv(k, raising=False)
+        calls = []
+
+        def fake_llama(on_tpu, dev):
+            calls.append((os.environ["PTPU_BENCH_BATCH"],
+                          os.environ["PTPU_BENCH_LAYERS"],
+                          os.environ["PTPU_RECOMPUTE"]))
+            if len(calls) < 3:
+                raise _FakeOOM("RESOURCE_EXHAUSTED: Out of memory "
+                               "allocating 123 bytes")
+            return {"mfu": 0.5, "batch": 2, "seq": 2048}
+
+        monkeypatch.setattr(bench, "bench_llama", fake_llama)
+        r = bench.bench_llama_headline(True, None)
+        assert r["rung"] == 2
+        assert r["headline_geometry"] == "pinned"
+        assert calls == [("3", "6", "selective"), ("3", "6", "1"),
+                         ("2", "6", "1")]
+
+    def test_non_oom_error_propagates(self, monkeypatch):
+        def fake_llama(on_tpu, dev):
+            raise ValueError("a real bug, not memory")
+
+        monkeypatch.setattr(bench, "bench_llama", fake_llama)
+        with pytest.raises(ValueError):
+            bench.bench_llama_headline(True, None)
+
+    def test_env_pin_zero_bypasses_ladder(self, monkeypatch):
+        monkeypatch.setenv("PTPU_BENCH_PINNED", "0")
+        monkeypatch.setattr(bench, "bench_llama",
+                            lambda on_tpu, dev: {"mfu": 0.1})
+        r = bench.bench_llama_headline(True, None)
+        assert "rung" not in r  # explicit env geometry ran verbatim
+
+
+class TestHeadlineRescue:
+    def test_zero_headline_promotes_companion(self):
+        cfgs = [
+            {"metric": "llama_pretrain_mfu_1chip_large", "value": 0.499,
+             "detail": {"batch": 2}},
+            {"metric": "llama_pretrain_mfu_1chip_seq8k", "value": 0.557,
+             "detail": {"batch": 1}},
+            {"metric": "bert_base_squad_step_ms", "value": 30.0},
+        ]
+        h = bench._rescue_headline({"value": 0.0, "detail": {}}, cfgs)
+        assert h["value"] == 0.557
+        assert h["detail"]["headline_fallback"] == (
+            "llama_pretrain_mfu_1chip_seq8k")
+
+    def test_missing_headline_promotes_companion(self):
+        cfgs = [{"metric": "llama_pretrain_mfu_1chip_large", "value": 0.4}]
+        h = bench._rescue_headline(None, cfgs)
+        assert h["value"] == 0.4
+
+    def test_good_headline_untouched(self):
+        h0 = {"value": 0.62, "detail": {"rung": 0}}
+        assert bench._rescue_headline(h0, []) is h0
+
+    def test_all_failed_stays_zero(self):
+        h = bench._rescue_headline(None, [])
+        assert h["value"] == 0.0
+
+
+class TestCompactTail:
+    def test_compact_line_fits_tail_window(self, monkeypatch, capsys):
+        # simulate the isolated merge with a representative config count
+        # and assert the LAST printed line (the driver's record) is short
+        fake = {"detail": {"configs": [
+            {"metric": f"m{i}", "value": 1.234, "unit": "x",
+             "vs_baseline": 1.0,
+             "detail": {"blah": "y" * 120}} for i in range(16)]}}
+
+        def fake_run(cmd, capture_output, text, env):
+            class R:
+                stdout = json.dumps({**fake, "value": 0.62,
+                                     "metric": "llama_pretrain_mfu_1chip",
+                                     "unit": "mfu_fraction",
+                                     "vs_baseline": 1.55})
+                stderr = ""
+            return R()
+
+        import subprocess
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        bench._run_isolated(["llama", "bert"])
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
+        last = json.loads(lines[-1])
+        assert last["metric"] == "llama_pretrain_mfu_1chip"
+        assert last["value"] == 0.62
+        assert len(lines[-1]) < 2000  # whole record survives the tail
+        # detail stripped to metric/value/ratio triples
+        assert all(set(c) == {"metric", "value", "vs_baseline"}
+                   for c in last["detail"]["configs"])
+
+
+pytestmark = pytest.mark.smoke
