@@ -98,21 +98,22 @@ def load_inference_model(path_prefix: str, executor: Executor):
     """Returns (program, feed_names, fetch_names); parameters land in the
     executor's scope.
 
-    Format note (PARITY.md): these artifacts reuse the reference's
-    .pdmodel/.pdiparams extensions for API parity but serialize THIS
-    framework's Program (pickle), not the reference's ProgramDesc
-    protobuf. Loading an actual upstream artifact fails loudly here with
-    a pointer, instead of an opaque unpickling error."""
+    Both artifact flavors load here: paddle_tpu's own pickle format AND
+    an upstream reference export (.pdmodel ProgramDesc protobuf +
+    .pdiparams combined tensor stream), which is translated op-by-op
+    through inference/pdmodel.py (reference
+    analysis_predictor.cc:2647 LoadProgramDesc)."""
     with open(path_prefix + ".pdmodel", "rb") as f:
         head = f.read(2)
         f.seek(0)
         if head and head[:1] not in (b"\x80",):  # pickle protocol 2+ magic
-            raise ValueError(
-                f"'{path_prefix}.pdmodel' is not a paddle_tpu artifact "
-                "(likely an upstream ProgramDesc protobuf). The formats "
-                "share extensions but are not interchangeable — re-export "
-                "the model with paddle_tpu's jit.save/save_inference_model "
-                "(see PARITY.md, inference row).")
+            from ..inference.pdmodel import (load_reference_model,
+                                             looks_like_programdesc)
+            if not looks_like_programdesc(head):
+                raise ValueError(
+                    f"'{path_prefix}.pdmodel' is neither a paddle_tpu "
+                    "artifact nor an upstream ProgramDesc protobuf")
+            return load_reference_model(path_prefix, executor)
         spec = pickle.load(f)
     with open(path_prefix + ".pdiparams", "rb") as f:
         params = pickle.load(f)

@@ -1,0 +1,354 @@
+"""Upstream .pdmodel/.pdiparams interchange (VERDICT r4 Missing#4).
+
+The Predictor must run reference-exported inference artifacts: a
+ProgramDesc protobuf (paddle/fluid/framework/framework.proto) plus the
+load_combine tensor stream (tensor_util.cc:455 TensorToStream). Fixtures
+here are built twice over: through the module's own writer AND through
+independent struct-packed bytes (pinning the wire format), then executed
+through inference.Predictor with numeric parity against a pure-jax
+oracle of the same math.
+"""
+import os
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import pdmodel as M
+
+O = M.OpDescLite
+
+
+def _var(blk, name, dtype=None, dims=(), persistable=False):
+    blk.vars[name] = M.VarDescLite(
+        name=name, dtype=np.dtype(dtype) if dtype else None,
+        dims=tuple(dims), persistable=persistable)
+
+
+def _write_model(tmp_path, name, blk, params):
+    prog = M.ProgramDescLite(blocks=[blk], version=0)
+    prefix = str(tmp_path / name)
+    with open(prefix + ".pdmodel", "wb") as f:
+        f.write(M.serialize_program(prog))
+    with open(prefix + ".pdiparams", "wb") as f:
+        f.write(M.write_combined_params(dict(sorted(params.items()))))
+    return prefix
+
+
+class TestWireCodec:
+    def test_attr_round_trip(self):
+        op = O("dummy", {"X": ["a", "b"]}, {"Out": ["c"]}, {
+            "i": -3, "f": 1.5, "s": "NCHW", "ints": [-1, 0, 7],
+            "floats": [0.5, -2.0], "strings": ["p", "q"],
+            "flag": True, "l_axis": [2, 3],
+        })
+        blk = M.BlockDescLite(ops=[op])
+        buf = M.serialize_program(M.ProgramDescLite(blocks=[blk]))
+        p2 = M.parse_program(buf)
+        o2 = p2.blocks[0].ops[0]
+        assert o2.type == "dummy"
+        assert o2.inputs == {"X": ["a", "b"]}
+        assert o2.outputs == {"Out": ["c"]}
+        assert o2.attrs["i"] == -3
+        assert o2.attrs["f"] == pytest.approx(1.5)
+        assert o2.attrs["s"] == "NCHW"
+        assert o2.attrs["ints"] == [-1, 0, 7]
+        assert o2.attrs["flag"] is True
+        assert o2.attrs["strings"] == ["p", "q"]
+
+    def test_packed_repeated_ints_decode(self):
+        # proto3-style packed encoding of OpDesc.Attr.ints (field 6):
+        # readers must accept both packed and unpacked forms
+        attr = bytearray()
+        attr += b"\x0a\x02ks"              # name = "ks"
+        attr += b"\x10\x03"                # type = INTS
+        attr += b"\x32\x02\x02\x03"        # ints packed: [2, 3]
+        name, val = M._parse_attr(bytes(attr))
+        assert name == "ks" and val == [2, 3]
+
+    def test_programdesc_magic(self):
+        assert M.looks_like_programdesc(b"\x0a\x10")
+        assert not M.looks_like_programdesc(b"\x80\x04")  # pickle
+
+    def test_independent_struct_packed_program(self):
+        # hand-packed bytes (no writer involved): one block, one relu op,
+        # one f32 var [2,3] — pins the field-number layout
+        var = bytearray()
+        var += b"\x0a\x01x"                          # name "x"
+        td = b"\x08\x05\x10\x02\x10\x03"             # f32, dims 2,3
+        lt = b"\x0a" + bytes([len(td)]) + td         # LoDTensorDesc.tensor
+        vt = b"\x08\x07\x1a" + bytes([len(lt)]) + lt  # type=LOD_TENSOR
+        var += b"\x12" + bytes([len(vt)]) + vt
+        opv_in = b"\x0a\x01X\x12\x01x"               # param "X", args ["x"]
+        opv_out = b"\x0a\x03Out\x12\x01y"
+        op = (b"\x0a" + bytes([len(opv_in)]) + opv_in
+              + b"\x12" + bytes([len(opv_out)]) + opv_out
+              + b"\x1a\x04relu")
+        blk = (b"\x08\x00\x10\x00"
+               + b"\x1a" + bytes([len(var)]) + bytes(var)
+               + b"\x22" + bytes([len(op)]) + op)
+        buf = b"\x0a" + bytes([len(blk)]) + blk
+        prog = M.parse_program(buf)
+        assert prog.blocks[0].ops[0].type == "relu"
+        assert prog.blocks[0].ops[0].inputs == {"X": ["x"]}
+        v = prog.blocks[0].vars["x"]
+        assert v.dims == (2, 3) and v.dtype == np.float32
+
+    def test_pdiparams_round_trip(self):
+        rng = np.random.RandomState(1)
+        params = {"a": rng.randn(3, 4).astype(np.float32),
+                  "b": rng.randint(0, 9, (5,)).astype(np.int64),
+                  "c": rng.randn(2, 2, 2).astype(np.float32)}
+        buf = M.write_combined_params(params)
+        back = M.read_combined_params(buf, list(params))
+        for k in params:
+            np.testing.assert_array_equal(back[k], params[k])
+
+    def test_pdiparams_independent_bytes(self):
+        # hand-packed single f32 tensor [2]: version|lod|version|desc|data
+        desc = b"\x08\x05\x10\x02"         # data_type=FP32, dims [2]
+        raw = struct.pack("<IQIi", 0, 0, 0, len(desc)) + desc \
+            + np.asarray([1.5, -2.0], np.float32).tobytes()
+        out = M.read_combined_params(raw, ["w"])
+        np.testing.assert_allclose(out["w"], [1.5, -2.0])
+
+
+def _cnn_fixture(tmp_path):
+    rng = np.random.RandomState(0)
+    p = {
+        "w0": rng.randn(8, 3, 3, 3).astype(np.float32) * 0.1,
+        "bn_s": rng.rand(8).astype(np.float32) + 0.5,
+        "bn_b": rng.randn(8).astype(np.float32) * 0.1,
+        "bn_m": rng.randn(8).astype(np.float32) * 0.1,
+        "bn_v": rng.rand(8).astype(np.float32) + 0.5,
+        "fc_w": rng.randn(8 * 4 * 4, 10).astype(np.float32) * 0.1,
+        "fc_b": rng.randn(10).astype(np.float32) * 0.1,
+    }
+    blk = M.BlockDescLite()
+    _var(blk, "feed_x", "float32", (-1, 3, 8, 8))
+    for n, a in p.items():
+        _var(blk, n, a.dtype, a.shape, persistable=True)
+    blk.ops = [
+        O("feed", {"X": ["feed"]}, {"Out": ["feed_x"]}, {"col": 0}),
+        O("conv2d", {"Input": ["feed_x"], "Filter": ["w0"]},
+          {"Output": ["c0"]},
+          {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+           "groups": 1, "data_format": "NCHW",
+           "padding_algorithm": "EXPLICIT"}),
+        O("batch_norm", {"X": ["c0"], "Scale": ["bn_s"], "Bias": ["bn_b"],
+                         "Mean": ["bn_m"], "Variance": ["bn_v"]},
+          {"Y": ["b0"]}, {"epsilon": 1e-5, "is_test": True,
+                          "data_format": "NCHW"}),
+        O("relu", {"X": ["b0"]}, {"Out": ["r0"]}, {}),
+        O("pool2d", {"X": ["r0"]}, {"Out": ["p0"]},
+          {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+           "pooling_type": "max", "ceil_mode": False, "exclusive": True,
+           "adaptive": False, "global_pooling": False,
+           "data_format": "NCHW"}),
+        O("flatten_contiguous_range", {"X": ["p0"]}, {"Out": ["f0"]},
+          {"start_axis": 1, "stop_axis": -1}),
+        O("mul", {"X": ["f0"], "Y": ["fc_w"]}, {"Out": ["m0"]},
+          {"x_num_col_dims": 1, "y_num_col_dims": 1}),
+        O("elementwise_add", {"X": ["m0"], "Y": ["fc_b"]}, {"Out": ["a0"]},
+          {"axis": -1}),
+        O("softmax", {"X": ["a0"]}, {"Out": ["s0"]}, {"axis": -1}),
+        O("fetch", {"X": ["s0"]}, {"Out": ["fetch"]}, {"col": 0}),
+    ]
+    return _write_model(tmp_path, "cnn", blk, p), p
+
+
+def _cnn_oracle(p, x):
+    from jax import lax
+    y = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(p["w0"]), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    y = ((y - p["bn_m"][None, :, None, None])
+         / np.sqrt(p["bn_v"] + 1e-5)[None, :, None, None]
+         * p["bn_s"][None, :, None, None]
+         + p["bn_b"][None, :, None, None])
+    y = jnp.maximum(y, 0)
+    y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 1, 2, 2),
+                              (1, 1, 2, 2), "VALID")
+    y = y.reshape(x.shape[0], -1) @ p["fc_w"] + p["fc_b"]
+    return jax.nn.softmax(y, -1)
+
+
+class TestCNNInterchange:
+    def test_predictor_runs_reference_cnn(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix, p = _cnn_fixture(tmp_path)
+        pred = I.create_predictor(I.Config(prefix))
+        assert pred.get_input_names() == ["feed_x"]
+        rng = np.random.RandomState(7)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], np.asarray(_cnn_oracle(p, x)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_dynamic_batch(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix, p = _cnn_fixture(tmp_path)
+        pred = I.create_predictor(I.Config(prefix))
+        for b in (1, 3):
+            x = np.random.RandomState(b).randn(b, 3, 8, 8).astype(
+                np.float32)
+            out = pred.run([x])
+            assert out[0].shape == (b, 10)
+            np.testing.assert_allclose(
+                out[0], np.asarray(_cnn_oracle(p, x)), rtol=1e-4,
+                atol=1e-5)
+
+    def test_zero_copy_handles(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix, p = _cnn_fixture(tmp_path)
+        pred = I.create_predictor(I.Config(prefix))
+        x = np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32)
+        pred.get_input_handle("feed_x").copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle("s0").copy_to_cpu()
+        np.testing.assert_allclose(out, np.asarray(_cnn_oracle(p, x)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_untranslated_op_fails_loudly(self, tmp_path):
+        blk = M.BlockDescLite()
+        _var(blk, "feed_x", "float32", (-1, 4))
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["feed_x"]}, {"col": 0}),
+            O("some_exotic_fused_op", {"X": ["feed_x"]}, {"Out": ["y"]},
+              {}),
+            O("fetch", {"X": ["y"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "bad", blk, {})
+        from paddle_tpu import inference as I
+        with pytest.raises(NotImplementedError, match="some_exotic"):
+            I.create_predictor(I.Config(prefix))
+
+
+def _bert_fixture(tmp_path, seq=6, hidden=16, heads=2, ffn=32, vocab=50):
+    rng = np.random.RandomState(5)
+    r = lambda *s: (rng.randn(*s) * 0.1).astype(np.float32)
+    p = {
+        "emb_w": r(vocab, hidden), "pos_w": r(seq, hidden),
+        "ln0_s": (rng.rand(hidden) + 0.5).astype(np.float32),
+        "ln0_b": r(hidden),
+        "wq": r(hidden, hidden), "bq": r(hidden),
+        "wk": r(hidden, hidden), "bk": r(hidden),
+        "wv": r(hidden, hidden), "bv": r(hidden),
+        "wo": r(hidden, hidden), "bo": r(hidden),
+        "ln1_s": (rng.rand(hidden) + 0.5).astype(np.float32),
+        "ln1_b": r(hidden),
+        "w1": r(hidden, ffn), "b1": r(ffn),
+        "w2": r(ffn, hidden), "b2": r(hidden),
+        "ln2_s": (rng.rand(hidden) + 0.5).astype(np.float32),
+        "ln2_b": r(hidden),
+    }
+    hd = hidden // heads
+    blk = M.BlockDescLite()
+    _var(blk, "ids", "int64", (-1, seq))
+    for n, a in p.items():
+        _var(blk, n, a.dtype, a.shape, persistable=True)
+
+    def proj(x, w, b, out):
+        return [O("matmul_v2", {"X": [x], "Y": [w]}, {"Out": [out + "_m"]},
+                  {"trans_x": False, "trans_y": False}),
+                O("elementwise_add", {"X": [out + "_m"], "Y": [b]},
+                  {"Out": [out]}, {"axis": -1})]
+
+    def heads_split(x, out):
+        return [O("reshape2", {"X": [x]}, {"Out": [out + "_r"]},
+                  {"shape": [0, 0, heads, hd]}),
+                O("transpose2", {"X": [out + "_r"]}, {"Out": [out]},
+                  {"axis": [0, 2, 1, 3]})]
+
+    ops = [
+        O("feed", {"X": ["feed"]}, {"Out": ["ids"]}, {"col": 0}),
+        O("lookup_table_v2", {"Ids": ["ids"], "W": ["emb_w"]},
+          {"Out": ["emb"]}, {}),
+        O("elementwise_add", {"X": ["emb"], "Y": ["pos_w"]},
+          {"Out": ["embp"]}, {"axis": -1}),
+        O("layer_norm", {"X": ["embp"], "Scale": ["ln0_s"],
+                         "Bias": ["ln0_b"]},
+          {"Y": ["h0"]}, {"epsilon": 1e-5, "begin_norm_axis": 2}),
+    ]
+    ops += proj("h0", "wq", "bq", "q") + heads_split("q", "qh")
+    ops += proj("h0", "wk", "bk", "k") + heads_split("k", "kh")
+    ops += proj("h0", "wv", "bv", "v") + heads_split("v", "vh")
+    ops += [
+        O("matmul_v2", {"X": ["qh"], "Y": ["kh"]}, {"Out": ["qk"]},
+          {"trans_x": False, "trans_y": True}),
+        O("scale", {"X": ["qk"]}, {"Out": ["qks"]},
+          {"scale": 1.0 / np.sqrt(hd), "bias": 0.0,
+           "bias_after_scale": True}),
+        O("softmax", {"X": ["qks"]}, {"Out": ["att"]}, {"axis": -1}),
+        O("matmul_v2", {"X": ["att"], "Y": ["vh"]}, {"Out": ["ctx"]},
+          {"trans_x": False, "trans_y": False}),
+        O("transpose2", {"X": ["ctx"]}, {"Out": ["ctxt"]},
+          {"axis": [0, 2, 1, 3]}),
+        O("reshape2", {"X": ["ctxt"]}, {"Out": ["ctxm"]},
+          {"shape": [0, 0, hidden]}),
+    ]
+    ops += proj("ctxm", "wo", "bo", "attn_out")
+    ops += [
+        O("elementwise_add", {"X": ["h0"], "Y": ["attn_out"]},
+          {"Out": ["res1"]}, {"axis": -1}),
+        O("layer_norm", {"X": ["res1"], "Scale": ["ln1_s"],
+                         "Bias": ["ln1_b"]},
+          {"Y": ["h1"]}, {"epsilon": 1e-5, "begin_norm_axis": 2}),
+    ]
+    ops += proj("h1", "w1", "b1", "ff1")
+    ops += [O("gelu", {"X": ["ff1"]}, {"Out": ["ffg"]},
+              {"approximate": False})]
+    ops += proj("ffg", "w2", "b2", "ff2")
+    ops += [
+        O("elementwise_add", {"X": ["h1"], "Y": ["ff2"]}, {"Out": ["res2"]},
+          {"axis": -1}),
+        O("layer_norm", {"X": ["res2"], "Scale": ["ln2_s"],
+                         "Bias": ["ln2_b"]},
+          {"Y": ["out"]}, {"epsilon": 1e-5, "begin_norm_axis": 2}),
+        O("fetch", {"X": ["out"]}, {"Out": ["fetch"]}, {"col": 0}),
+    ]
+    blk.ops = ops
+    return _write_model(tmp_path, "bert", blk, p), p, (seq, hidden, heads)
+
+
+def _bert_oracle(p, ids, heads):
+    def ln(x, s, b):
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        return (x - m) / jnp.sqrt(v + 1e-5) * s + b
+
+    hd = p["wq"].shape[1] // heads
+    B, S = ids.shape
+    h = ln(p["emb_w"][ids] + p["pos_w"][None], p["ln0_s"], p["ln0_b"])
+
+    def split(x):
+        return x.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
+
+    q = split(h @ p["wq"] + p["bq"])
+    k = split(h @ p["wk"] + p["bk"])
+    v = split(h @ p["wv"] + p["bv"])
+    att = jax.nn.softmax(q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd), -1)
+    ctx = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, -1)
+    h1 = ln(h + ctx @ p["wo"] + p["bo"], p["ln1_s"], p["ln1_b"])
+    ff = jax.nn.gelu(h1 @ p["w1"] + p["b1"], approximate=False)
+    return ln(h1 + ff @ p["w2"] + p["b2"], p["ln2_s"], p["ln2_b"])
+
+
+class TestBertInterchange:
+    def test_predictor_runs_reference_bert_block(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix, p, (seq, hidden, heads) = _bert_fixture(tmp_path)
+        pred = I.create_predictor(I.Config(prefix))
+        ids = np.random.RandomState(11).randint(
+            0, p["emb_w"].shape[0], (2, seq)).astype(np.int64)
+        out = pred.run([ids])
+        want = _bert_oracle({k: jnp.asarray(v) for k, v in p.items()},
+                            jnp.asarray(ids), heads)
+        assert out[0].shape == (2, seq, hidden)
+        np.testing.assert_allclose(out[0], np.asarray(want), rtol=2e-4,
+                                   atol=2e-5)
+
+
+pytestmark = pytest.mark.smoke
