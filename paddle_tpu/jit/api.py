@@ -258,6 +258,7 @@ class TrainStep:
                for i, p in enumerate(opt._parameter_list)
                if i < len(opt._states)}
         opt._parameter_list = params
+        abstract = getattr(self, "_abstract_state", False)
         states, masters = [], []
         for p in params:
             s, m = old.get(id(p), (None, None))
@@ -265,10 +266,22 @@ class TrainStep:
                 m = None
                 if opt._multi_precision and p._data.dtype in (jnp.bfloat16,
                                                               jnp.float16):
-                    m = opt._place_state(p, p._data.astype(jnp.float32))
-                s = jax.tree.map(lambda a: opt._place_state(p, a),
-                                 opt._init_state(m if m is not None
-                                                 else p._data))
+                    m = (jax.ShapeDtypeStruct(p._data.shape, jnp.float32)
+                         if abstract
+                         else opt._place_state(p, p._data.astype(jnp.float32)))
+                if abstract:
+                    # AOT planning (distributed/auto_parallel/aot.py): the
+                    # step is only LOWERED, never executed here — optimizer
+                    # state stays as avals so an 8B-param plan costs no RAM
+                    s = jax.eval_shape(
+                        opt._init_state,
+                        m if m is not None
+                        else jax.ShapeDtypeStruct(p._data.shape,
+                                                  p._data.dtype))
+                else:
+                    s = jax.tree.map(lambda a: opt._place_state(p, a),
+                                     opt._init_state(m if m is not None
+                                                     else p._data))
             states.append(s)
             masters.append(m)
         opt._states, opt._masters = states, masters
