@@ -47,17 +47,30 @@ _state = {"enable": False, "dtype": None, "level": "O1",
           "custom_white": set(), "custom_black": set()}
 
 
-def _amp_hook(schema, primals):
+def cast_spec(name):
+    """The autocast decision for op `name` under the CURRENT amp state:
+    (low_dtype, cast_low, black), or None when autocast is off.
+
+    Factored out of the dispatcher hook so SOT traces can RECORD it per
+    node and replay the exact pre-kernel casts inside the compiled
+    segment (reference jit/sot/translate.py:91-99 simulates bytecode
+    through amp regions; here the cast becomes part of the trace)."""
     if not _state["enable"]:
-        return primals
-    low = _state["dtype"]
-    name = schema.name
+        return None
     white = (name in WHITE_LIST or name in _state["custom_white"])
     black = (name in BLACK_LIST or name in _state["custom_black"])
     if _state["level"] == "O2":
         cast_low = not black
     else:
         cast_low = white and not black
+    return (_state["dtype"], cast_low, black)
+
+
+def apply_cast_spec(primals, spec):
+    """Pure (traceable) application of a recorded cast_spec."""
+    if spec is None:
+        return primals
+    low, cast_low, black = spec
     out = []
     for p in primals:
         if jnp.issubdtype(p.dtype, jnp.floating):
@@ -67,6 +80,10 @@ def _amp_hook(schema, primals):
                 p = p.astype(jnp.float32)
         out.append(p)
     return out
+
+
+def _amp_hook(schema, primals):
+    return apply_cast_spec(primals, cast_spec(schema.name))
 
 
 dispatcher.set_amp_hook(_amp_hook)

@@ -287,15 +287,20 @@ def backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]]
                 t_cap = cap_slots.get((node.id, idx))
                 if t_cap is not None and g is not None:
                     accumulate_leaf(t_cap, g)
+        # cotangent dtype follows the primal output's dtype: accumulation
+        # across mixed-precision consumers can promote (bf16+f32 -> f32
+        # under AMP), and jax.vjp requires an exact dtype match
         if create_graph:
             ct_tensors = [
-                g if g is not None else Tensor(jnp.zeros(shape, dtype))
+                (g.astype(dtype) if g._data.dtype != dtype else g)
+                if g is not None else Tensor(jnp.zeros(shape, dtype))
                 for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
             ]
             in_grads = _run_vjp_create_graph(node, ct_tensors)
         else:
             cts = tuple(
-                g if g is not None else jnp.zeros(shape, dtype)
+                (g.astype(dtype) if g.dtype != dtype else g)
+                if g is not None else jnp.zeros(shape, dtype)
                 for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
             )
             in_grads = node.vjp_callable(node.primals, cts)

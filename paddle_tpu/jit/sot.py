@@ -26,10 +26,14 @@ TPU-native redesign — no bytecode simulation, same capability:
    segment function), so `backward()` flows through replayed calls
    exactly like the eager chain.
 
-Unsupported constructs poison the trace (AMP auto-cast rewrites kernel
-inputs outside the recorded trace; `_set_data` mutation mid-trace breaks
-symbol identity) — a poisoned entry simply stays eager forever, which is
-SOT's contract: never wrong, compiled where possible.
+AMP autocast is part of the trace (r5): each node records its
+`amp.cast_spec` and replay re-applies the exact pre-kernel casts inside
+the compiled segment, with the full autocast signature guarded in the
+cache key (reference translate.py simulates bytecode through amp
+regions). Genuinely unsupported constructs still poison the trace
+(`_set_data` mutation mid-trace breaks symbol identity) — a poisoned
+entry simply stays eager forever, which is SOT's contract: never wrong,
+compiled where possible.
 """
 
 from __future__ import annotations
@@ -53,15 +57,17 @@ class GuardMismatch(Exception):
 
 class _Node:
     __slots__ = ("kernel", "attrs", "present", "arg_refs", "keyed",
-                 "out_syms")
+                 "out_syms", "amp")
 
-    def __init__(self, kernel, attrs, present, arg_refs, keyed, out_syms):
+    def __init__(self, kernel, attrs, present, arg_refs, keyed, out_syms,
+                 amp=None):
         self.kernel = kernel
         self.attrs = attrs
         self.present = present
         self.arg_refs = arg_refs      # ('s', sym) | ('e', ext_idx)
         self.keyed = keyed
         self.out_syms = out_syms
+        self.amp = amp                # recorded amp.cast_spec (or None)
 
 
 class _Break:
@@ -105,11 +111,10 @@ class _Recorder:
         if self.poisoned:
             return
         from .. import amp as amp_mod
-        if amp_mod._state.get("enable"):
-            # auto_cast entered INSIDE the traced fn: the dispatcher casts
-            # primals before the kernel, which replay would not reproduce
-            self.poison("amp auto_cast active during trace")
-            return
+        # autocast is part of the trace: record the per-op cast decision
+        # so replay reproduces the dispatcher's pre-kernel casts exactly
+        # (reference translate.py:91-99 — r4 poison removed)
+        amp_spec = amp_mod.cast_spec(schema.name)
         ins = list(in_tensors)
         pres = list(present)
         keyed = bool(schema.key)
@@ -131,7 +136,7 @@ class _Recorder:
             self.pins.append(o)
             out_syms.append(s)
         self.nodes.append(_Node(schema.kernel, dict(attrs), tuple(pres),
-                                arg_refs, keyed, out_syms))
+                                arg_refs, keyed, out_syms, amp_spec))
 
     def on_break(self, kind, t: Tensor, value):
         if self.poisoned:
@@ -156,12 +161,14 @@ class _Segment:
         env: Dict[int, Any] = dict(zip(self.in_syms, arrays))
         ext = dict(zip(self.ext_idxs, ext_arrays))  # global idx -> array
         ki = 0
+        from .. import amp as amp_mod
         for n in self.nodes:
             prim = []
             for r in n.arg_refs:
                 if r is None:
                     continue
                 prim.append(env[r[1]] if r[0] == "s" else ext[r[1]])
+            prim = amp_mod.apply_cast_spec(prim, n.amp)
             pres = n.present
             if n.keyed:
                 prim.append(keys[ki])
@@ -360,9 +367,6 @@ def _tracing(recorder: _Recorder):
         return orig_set(self, arr)
 
     Tensor._set_data = poisoning_set
-    from .. import amp as amp_mod
-    if amp_mod._state.get("enable"):
-        recorder.poison("amp auto_cast active")
     prev_recorder = dispatcher._SOT_RECORDER
     dispatcher._SOT_RECORDER = recorder
     try:
@@ -394,9 +398,15 @@ class SOTFunction:
         from .. import amp as amp_mod
         from .. import flags
         from ..core import dtype as dtype_mod
+        amp_state = amp_mod._state
         return (dtype_mod.get_default_dtype(),
                 engine.is_grad_enabled(),
-                bool(amp_mod._state.get("enable")),
+                # full autocast signature: an O1<->O2 or dtype/list change
+                # must retrace, not replay stale cast decisions
+                (bool(amp_state.get("enable")),
+                 str(amp_state.get("dtype")), amp_state.get("level"),
+                 frozenset(amp_state.get("custom_white") or ()),
+                 frozenset(amp_state.get("custom_black") or ())),
                 flags.get_flag("use_pallas_kernels"),
                 flags.get_flag("check_nan_inf"),
                 flags.get_flag("eager_op_jit"))
