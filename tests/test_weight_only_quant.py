@@ -169,3 +169,36 @@ class TestQuantizedServing:
         x = paddle.to_tensor(rnd(2, 16, seed=11))
         np.testing.assert_allclose(wol(x).numpy(), wol2(x).numpy(),
                                    rtol=1e-6)
+
+
+import jax.numpy as jnp  # noqa: E402
+
+
+class TestPallasInt4Kernel:
+    def test_single_read_kernel_matches_split_nibble(self):
+        # the Pallas decode kernel (one HBM read of the packed bytes,
+        # in-VMEM unpack, two MXU dots) must agree with the XLA
+        # split-nibble formulation; interpret mode on CPU
+        from paddle_tpu.ops.kernels.pallas import weight_only_gemm as wog
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(512, 640) * 0.02, jnp.bfloat16)
+        x = jnp.asarray(rng.randn(16, 512), jnp.bfloat16)
+        q4, s4 = wog.quantize(w, "int4")
+        ref = wog.weight_only_matmul(x, q4, s4, "int4")
+        out = wog._pallas_int4_matmul(x, q4, s4, bn=128, bk2=128)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=3e-2, atol=3e-1)
+
+    def test_odd_m_padding(self):
+        from paddle_tpu.ops.kernels.pallas import weight_only_gemm as wog
+        rng = np.random.RandomState(1)
+        w = jnp.asarray(rng.randn(256, 256) * 0.02, jnp.bfloat16)
+        x = jnp.asarray(rng.randn(5, 256), jnp.bfloat16)
+        q4, s4 = wog.quantize(w, "int4")
+        out = wog._pallas_int4_matmul(x, q4, s4, bn=128, bk2=128)
+        ref = wog.weight_only_matmul(x, q4, s4, "int4")
+        assert out.shape == (5, 256)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=3e-2, atol=3e-1)
