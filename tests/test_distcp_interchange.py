@@ -1,0 +1,148 @@
+""".distcp checkpoint interchange (VERDICT r4 Missing#5 / Next#8).
+
+The reference's distributed checkpoint is a directory of per-rank
+paddle.save pickles plus a pickled Metadata
+(python/paddle/distributed/checkpoint/save_state_dict.py:104-241).
+Fixtures here are built two ways: through save_reference_distcp AND
+through raw pickle bytes that mimic a genuine reference process
+(GLOBAL records pointing at paddle.distributed.checkpoint.metadata,
+reduce_varbase (name, ndarray) tuples) — so the reader is proven
+against the wire form, not just our own writer.
+"""
+import os
+import pickle
+import pickletools
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint import distcp_compat as dc
+
+
+def _reference_style_fixture(path):
+    """Two ranks, w1 row-sharded across them, w2 replicated (saved by
+    rank 0 only after dedup) — the exact save_state_dict layout."""
+    w1 = np.arange(32, dtype=np.float32).reshape(4, 8)
+    w2 = np.linspace(0, 1, 6).astype(np.float32).reshape(2, 3)
+    os.makedirs(path, exist_ok=True)
+
+    M, LTM, LTI = (dc.RefMetadata, dc.RefLocalTensorMetadata,
+                   dc.RefLocalTensorIndex)
+    meta = M(
+        state_dict_metadata={
+            "w1": [LTM((0, 0), (2, 8)), LTM((2, 0), (2, 8))],
+            "w2": [LTM((0, 0), (2, 3))],
+        },
+        storage_metadata={
+            LTI("w1", (0, 0)): "0_0.distcp",
+            LTI("w1", (2, 0)): "1_0.distcp",
+            LTI("w2", (0, 0)): "0_0.distcp",
+        },
+        flat_mapping={},
+    )
+    with dc._install_ref_module_stubs():
+        with open(os.path.join(path, "0.metadata"), "wb") as f:
+            pickle.dump(meta, f, protocol=4)
+        with open(os.path.join(path, "0_0.distcp"), "wb") as f:
+            pickle.dump({"w1": ("w1", w1[:2]), "w2": ("w2", w2)}, f,
+                        protocol=4)
+        with open(os.path.join(path, "1_0.distcp"), "wb") as f:
+            pickle.dump({"w1": ("w1", w1[2:])}, f, protocol=4)
+    return w1, w2
+
+
+class TestPickleWireFormat:
+    def test_metadata_pickle_carries_reference_module_path(self):
+        md = dc.RefMetadata(state_dict_metadata={}, storage_metadata={},
+                            flat_mapping={})
+        with dc._install_ref_module_stubs():
+            blob = pickle.dumps(md, protocol=4)
+        ops = [(op.name, arg) for op, arg, _pos
+               in pickletools.genops(blob)]
+        import sys
+        assert "paddle" not in sys.modules  # stub must not leak
+        texts = " ".join(str(a) for _n, a in ops if a is not None)
+        # a genuine reference process resolves these with ITS classes
+        assert "paddle.distributed.checkpoint.metadata" in texts
+        assert "Metadata" in texts
+        assert "paddle_tpu" not in texts
+
+    def test_reader_rejects_arbitrary_globals(self, tmp_path):
+        class Evil:
+            pass
+
+        p = tmp_path / "x.metadata"
+        Evil.__module__ = "os"
+        Evil.__qualname__ = "system"
+        with open(p, "wb") as f:
+            pickle.dump({"k": os.getcwd}, f)
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            dc._unpickle(str(p))
+
+
+class TestReadReference:
+    def test_assemble_sharded_global(self, tmp_path):
+        w1, w2 = _reference_style_fixture(str(tmp_path))
+        out = dc.load_reference_distcp(str(tmp_path))
+        np.testing.assert_array_equal(out["w1"], w1)
+        np.testing.assert_array_equal(out["w2"], w2)
+
+    def test_missing_storage_entry_raises(self, tmp_path):
+        _reference_style_fixture(str(tmp_path))
+        # corrupt: drop a storage record
+        md = dc._unpickle(str(tmp_path / "0.metadata"))
+        md.storage_metadata.pop(dc.RefLocalTensorIndex("w1", (2, 0)))
+        with dc._install_ref_module_stubs():
+            with open(tmp_path / "0.metadata", "wb") as f:
+                pickle.dump(md, f)
+        with pytest.raises(KeyError, match="w1"):
+            dc.load_reference_distcp(str(tmp_path))
+
+
+class TestRoundTrip:
+    def test_write_then_read(self, tmp_path):
+        state = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+                 "b": np.asarray([1.5, 2.5], np.float32)}
+        dc.save_reference_distcp(state, str(tmp_path))
+        back = dc.load_reference_distcp(str(tmp_path))
+        for k in state:
+            np.testing.assert_array_equal(back[k], state[k])
+
+    def test_multi_writer_boxes(self, tmp_path):
+        full = np.arange(24, dtype=np.float32).reshape(6, 4)
+        dc.save_reference_distcp(
+            {"w": full[:3]}, str(tmp_path), rank=0,
+            shards={"w": ((0, 0), full[:3])})
+        # second writer appends its own metadata file (uid 1)
+        dc.save_reference_distcp(
+            {"w": full[3:]}, str(tmp_path), rank=1, unique_id=1,
+            shards={"w": ((3, 0), full[3:])})
+        back = dc.load_reference_distcp(str(tmp_path))
+        np.testing.assert_array_equal(back["w"], full)
+
+
+class TestConverters:
+    def test_reference_to_native_loads_with_reshard(self, tmp_path):
+        import jax.numpy as jnp
+        w1, w2 = _reference_style_fixture(str(tmp_path / "ref"))
+        dc.convert_from_reference(str(tmp_path / "ref"),
+                                  str(tmp_path / "native"))
+        target = {"w1": jnp.zeros_like(jnp.asarray(w1)),
+                  "w2": jnp.zeros_like(jnp.asarray(w2))}
+        ckpt.load_state_dict(target, str(tmp_path / "native"))
+        np.testing.assert_array_equal(np.asarray(target["w1"]), w1)
+        np.testing.assert_array_equal(np.asarray(target["w2"]), w2)
+
+    def test_native_to_reference(self, tmp_path):
+        import jax.numpy as jnp
+        state = {"p": jnp.asarray(np.random.RandomState(0)
+                                  .randn(4, 4).astype(np.float32))}
+        ckpt.save_state_dict(state, str(tmp_path / "native"))
+        dc.convert_to_reference(str(tmp_path / "native"),
+                                str(tmp_path / "ref"))
+        back = dc.load_reference_distcp(str(tmp_path / "ref"))
+        np.testing.assert_array_equal(back["p"], np.asarray(state["p"]))
+
+
+pytestmark = pytest.mark.smoke
