@@ -206,7 +206,6 @@ def grouped_gemm(x, w, counts=None, groups_per_expert=1, use_pallas=None):
     """Ragged grouped matmul y[g] = x[g] @ w[g // groups_per_expert]
     (kernels/pallas/grouped_gemm.py; rows past counts[g] are zero and
     C-tiles past counts[g] are skipped on the MXU)."""
-    if use_pallas is None:
-        use_pallas = flags.get_flag("use_pallas_kernels")
+    # None = auto: flag + shape heuristic in grouped_matmul
     return grouped_matmul(x, w, counts, int(groups_per_expert),
-                          bool(use_pallas))
+                          use_pallas)
