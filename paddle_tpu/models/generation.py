@@ -73,6 +73,13 @@ class PagedKVCache:
         self._allocated = np.zeros((batch,), np.int32)
         self._slot_cache_key = None   # memoized update() slot map key
         self._prefill_kv: dict = {}   # per-layer prompt K/V, prefill only
+        # continuous-batching hook (models/serving.py): when set, s==1
+        # updates write to these precomputed per-row slots and skip the
+        # allocator/length bookkeeping (the engine owns both)
+        self._decode_override: Optional[Tensor] = None
+
+    def set_decode_override(self, slots: Optional[Tensor]):
+        self._decode_override = slots
 
     # -- host-side allocator -------------------------------------------------
     def _ensure_block(self, seq: int, pos: int) -> int:
@@ -123,6 +130,12 @@ class PagedKVCache:
     # reference block_multi_head serving flow) ------------------------------
     def update(self, layer: int, k_new: Tensor, v_new: Tensor, pos):
         b, s = k_new.shape[0], k_new.shape[1]
+        if self._decode_override is not None and s == 1:
+            self.k[layer] = call_op("paged_cache_write", self.k[layer],
+                                    k_new, self._decode_override)
+            self.v[layer] = call_op("paged_cache_write", self.v[layer],
+                                    v_new, self._decode_override)
+            return self.k[layer], self.v[layer]
         p0 = int(np.asarray(pos._data)) if isinstance(pos, Tensor) \
             else int(pos)
         if s == 1 and self._prefill_kv:

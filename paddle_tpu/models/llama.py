@@ -157,9 +157,16 @@ class LlamaAttention(Layer):
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         if cache is not None:
             # decode path: rope at absolute positions, write into the cache,
-            # attend against everything written so far (serving kernels)
-            pos_ids = (call_op("arange", end=s, dtype="int32") + start_pos
-                       ).reshape([1, s]).broadcast_to([b, s])
+            # attend against everything written so far (serving kernels).
+            # start_pos may be a PER-ROW vector (continuous batching:
+            # every slot decodes at its own depth, models/serving.py)
+            if getattr(start_pos, "ndim", 0) == 1:
+                pos_ids = (start_pos.reshape([b, 1])
+                           + call_op("arange", end=s, dtype="int32")
+                           .reshape([1, s]))
+            else:
+                pos_ids = (call_op("arange", end=s, dtype="int32")
+                           + start_pos).reshape([1, s]).broadcast_to([b, s])
             cos, sin = self.rotary(self.config.max_position_embeddings)
             q, k = call_op("rope", q, k, cos=cos, sin=sin,
                            position_ids=pos_ids)

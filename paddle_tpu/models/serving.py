@@ -1,0 +1,238 @@
+"""Continuous batching over the paged-KV cache — a real serving loop.
+
+Reference counterpart: the block_multi_head_attention serving flow
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)
+driven by an insert/evict scheduler. TPU-native realisation: ONE compiled
+decode step over a fixed max_batch of slots (static shapes — XLA compiles
+once), with the scheduler purely host-side:
+
+- requests queue until a slot AND enough pool blocks for their worst case
+  (prompt + max_new_tokens) are free — vLLM-style admission reservation,
+  so decode never hits pool exhaustion mid-flight;
+- admitted requests prefill alone (batch-1 causal pass writing their
+  slot's blocks), then join the next decode step;
+- finished sequences (eos / max_new_tokens) release their blocks
+  immediately, and the freed slot admits the next queued request at the
+  very next step — the continuous part: slots refill while other
+  sequences keep decoding, so stragglers never hold a whole batch
+  hostage the way static batching does;
+- inactive slots ride along masked: their write lands in one reserved
+  trash block and their sampled token is discarded.
+
+Per-row decode positions require a vector start_pos; LlamaAttention
+builds rope position ids from it and PagedKVCache.update consumes the
+engine's precomputed slot vector (set_decode_override).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatcher import call_op
+from .generation import PagedKVCache
+
+__all__ = ["Request", "ContinuousBatchingEngine"]
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray                 # [L] int32
+    max_new_tokens: int
+    out_tokens: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    done: bool = False
+
+
+class _SlotView:
+    """Batch-1 cache facade targeting ONE slot of the shared pool: the
+    model's prefill pass (update + causal attend) runs unchanged, but
+    writes land in the slot's block table."""
+
+    def __init__(self, cache: PagedKVCache, slot: int):
+        self._c = cache
+        self._slot = slot
+        self._stash: Dict[int, tuple] = {}
+
+    def update(self, layer: int, k_new: Tensor, v_new: Tensor, pos):
+        c, slot = self._c, self._slot
+        p0 = int(np.asarray(pos._data)) if isinstance(pos, Tensor) \
+            else int(pos)
+        s = k_new.shape[1]
+        slots = np.empty((s,), np.int64)
+        for i in range(s):
+            blk = c._ensure_block(slot, p0 + i)
+            slots[i] = blk * c.block_size + (p0 + i) % c.block_size
+        sl = Tensor(jnp.asarray(slots, jnp.int32))
+        c.k[layer] = call_op("paged_cache_write", c.k[layer], k_new, sl)
+        c.v[layer] = call_op("paged_cache_write", c.v[layer], v_new, sl)
+        self._stash[layer] = (k_new, v_new)
+        return c.k[layer], c.v[layer]
+
+    def attend(self, layer: int, q: Tensor, pos=None, attn_mask=None):
+        k_new, v_new = self._stash[layer]
+        return call_op("scaled_dot_product_attention", q, k_new, v_new,
+                       attn_mask=attn_mask, is_causal=True)
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, model, max_batch: int, num_blocks: int,
+                 block_size: int = 64,
+                 max_blocks_per_seq: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
+        cfg = model.config
+        self.model = model
+        self.eos = eos_token_id
+        self.sampling = dict(temperature=temperature, top_k=top_k,
+                             top_p=top_p)
+        mb = max_blocks_per_seq or (
+            -(-cfg.max_position_embeddings // block_size))
+        self.cache = PagedKVCache(
+            cfg.num_hidden_layers, max_batch, num_blocks=num_blocks,
+            block_size=block_size, num_kv_heads=cfg.num_key_value_heads,
+            head_dim=cfg.hidden_size // cfg.num_attention_heads,
+            max_blocks_per_seq=mb, dtype=getattr(cfg, "dtype", "float32"))
+        self.block_size = block_size
+        self.max_batch = max_batch
+        # one reserved block absorbs the masked writes of inactive slots
+        self._trash_slot = self.cache._free.pop() * block_size
+        self.slots: List[Optional[Request]] = [None] * max_batch
+        self.pending: deque[Request] = deque()
+        self.results: Dict[int, Request] = {}
+        self.tok = np.zeros((max_batch, 1), np.int32)
+        self.pos = np.zeros((max_batch,), np.int32)
+        self._next_rid = 0
+        self.steps = 0
+
+    # -- request intake ------------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int = 32) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid, np.asarray(prompt, np.int32).reshape(-1),
+                      max_new_tokens)
+        total_pool = (len(self.cache._free)
+                      + int(self.cache._allocated.sum()))
+        if self._blocks_needed(req) > total_pool:
+            raise ValueError(
+                f"request needs {self._blocks_needed(req)} blocks but the "
+                f"pool only has {total_pool}: it could never be admitted")
+        self.pending.append(req)
+        self.results[rid] = req
+        return rid
+
+    def _blocks_needed(self, req: Request) -> int:
+        return -(-(len(req.prompt) + req.max_new_tokens)
+                 // self.block_size)
+
+    def _outstanding_reservation(self) -> int:
+        """Blocks the ACTIVE sequences may still claim: their worst case
+        minus what they already hold. Admission must leave room for this,
+        or decode could exhaust the pool mid-flight."""
+        return sum(self._blocks_needed(r)
+                   - int(self.cache._allocated[r.slot])
+                   for r in self.slots if r is not None)
+
+    def _admit(self):
+        from ..autograd.engine import no_grad
+        for i in range(self.max_batch):
+            if not self.pending:
+                return
+            if self.slots[i] is not None:
+                continue
+            req = self.pending[0]
+            if (self._blocks_needed(req)
+                    > len(self.cache._free)
+                    - self._outstanding_reservation()):
+                return                 # reservation: wait for reclaims
+            self.pending.popleft()
+            req.slot = i
+            self.slots[i] = req
+            view = _SlotView(self.cache, i)
+            ids = Tensor(jnp.asarray(req.prompt.reshape(1, -1)))
+            with no_grad():
+                logits = self.model(ids, cache=view,
+                                    start_pos=Tensor(
+                                        jnp.asarray(0, jnp.int32)))
+                nxt = call_op("sample_logits", logits[:, -1, :],
+                              **self.sampling)
+            first = int(np.asarray(nxt._data).reshape(-1)[0])
+            req.out_tokens.append(first)
+            self.cache.context_lens[i] = len(req.prompt)
+            self.pos[i] = len(req.prompt)
+            self.tok[i, 0] = first
+            self._finish_if_done(req)
+
+    def _finish_if_done(self, req: Request) -> bool:
+        if (len(req.out_tokens) >= req.max_new_tokens
+                or (self.eos is not None and req.out_tokens
+                    and req.out_tokens[-1] == self.eos)):
+            req.done = True
+            i = req.slot
+            self.cache.release(i)
+            self.slots[i] = None
+            self.pos[i] = 0
+            self.tok[i, 0] = 0
+            return True
+        return False
+
+    # -- the continuous loop -------------------------------------------------
+    @property
+    def num_active(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    def step(self) -> List[Request]:
+        """Admit + one decode step for every active slot. Returns the
+        requests that finished during this step."""
+        from ..autograd.engine import no_grad
+
+        self._admit()
+        if self.num_active == 0:
+            return []
+        # per-row write slots: active rows append at pos; inactive rows
+        # overwrite the reserved trash block
+        slot_vec = np.full((self.max_batch,), self._trash_slot, np.int64)
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            p = int(self.pos[i])
+            blk = self.cache._ensure_block(i, p)
+            slot_vec[i] = blk * self.block_size + p % self.block_size
+            self.cache.context_lens[i] = p + 1  # visible to the attend
+        self.cache.set_decode_override(
+            Tensor(jnp.asarray(slot_vec, jnp.int32)))
+        try:
+            with no_grad():
+                logits = self.model(
+                    Tensor(jnp.asarray(self.tok)), cache=self.cache,
+                    start_pos=Tensor(jnp.asarray(self.pos, jnp.int32)))
+                nxt = call_op("sample_logits", logits[:, -1, :],
+                              **self.sampling)
+        finally:
+            self.cache.set_decode_override(None)
+        self.steps += 1
+        sampled = np.asarray(nxt._data).reshape(-1)
+        finished = []
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            tok = int(sampled[i])
+            req.out_tokens.append(tok)
+            self.pos[i] += 1
+            self.tok[i, 0] = tok
+            if self._finish_if_done(req):
+                finished.append(req)
+        return finished
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drive until every request (queued + active) completes."""
+        while self.pending or self.num_active:
+            self.step()
+        return {rid: r.out_tokens for rid, r in self.results.items()}

@@ -1,0 +1,110 @@
+"""Continuous batching serving engine (VERDICT r4 Next#10).
+
+Insert/evict mid-decode over the paged-KV block pool: slots refill as
+sequences finish, blocks reclaim immediately, and greedy outputs match
+the static generate() loop token-for-token. Reference serving flow:
+block_multi_head_attention
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=160, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _greedy_reference(model, prompt, n_new):
+    ids = Tensor(jnp.asarray(np.asarray(prompt, np.int32)[None]))
+    out = model.generate(ids, max_new_tokens=n_new, temperature=0.0,
+                         cache_type="paged", block_size=16)
+    return list(np.asarray(out._data)[0, len(prompt):])
+
+
+class TestContinuousBatching:
+    def test_greedy_matches_static_generate(self, model):
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 128, n).tolist() for n in (5, 9, 7)]
+        eng = ContinuousBatchingEngine(model, max_batch=4, num_blocks=64,
+                                       block_size=16, temperature=0.0)
+        rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+        results = eng.run()
+        for rid, p in zip(rids, prompts):
+            assert results[rid] == _greedy_reference(model, p, 6), (
+                f"request {rid} diverged from static generate()")
+
+    def test_slots_refill_midstream(self, model):
+        # 6 requests through 2 slots: finishing sequences must hand their
+        # slot to queued ones while the other slot keeps decoding
+        rng = np.random.RandomState(1)
+        eng = ContinuousBatchingEngine(model, max_batch=2, num_blocks=32,
+                                       block_size=16, temperature=0.0)
+        lens = [2, 9, 3, 8, 4, 6]
+        rids = [eng.add_request(rng.randint(0, 128, 4).tolist(),
+                                max_new_tokens=n) for n in lens]
+        results = eng.run()
+        assert all(len(results[r]) == n for r, n in zip(rids, lens))
+        # mixed lengths through 2 slots: continuous refill needs fewer
+        # steps than ceil-batched static scheduling (batches of 2 run
+        # max(pair) steps each); equality would mean no mid-stream refill
+        static_steps = sum(max(a, b) for a, b in
+                           zip(lens[0::2], lens[1::2]))
+        assert eng.steps < static_steps
+
+    def test_blocks_reclaimed(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch=2, num_blocks=16,
+                                       block_size=16, temperature=0.0)
+        free0 = len(eng.cache._free)
+        for _ in range(4):
+            eng.add_request([1, 2, 3], max_new_tokens=5)
+        eng.run()
+        assert len(eng.cache._free) == free0  # every block returned
+
+    def test_eos_evicts_early(self, model):
+        # force eos as the first sampled token via a crafted prompt? —
+        # instead: eos set to whatever greedy emits first, sequence must
+        # finish after 1 token though max_new_tokens is large
+        first = _greedy_reference(model, [7, 8, 9], 1)[0]
+        eng = ContinuousBatchingEngine(model, max_batch=2, num_blocks=32,
+                                       block_size=16, temperature=0.0,
+                                       eos_token_id=int(first))
+        rid = eng.add_request([7, 8, 9], max_new_tokens=50)
+        results = eng.run()
+        assert results[rid] == [first]
+        assert eng.num_active == 0
+
+    def test_oversized_request_rejected(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch=2, num_blocks=4,
+                                       block_size=16, temperature=0.0)
+        with pytest.raises(ValueError, match="could never be admitted"):
+            eng.add_request(list(range(100)), max_new_tokens=30)
+
+    def test_admission_waits_for_blocks(self, model):
+        # pool fits one long request at a time: the second must wait,
+        # then run to completion after the first releases
+        eng = ContinuousBatchingEngine(model, max_batch=2, num_blocks=5,
+                                       block_size=16, temperature=0.0)
+        a = eng.add_request([1] * 20, max_new_tokens=30)   # needs 4 blocks
+        b = eng.add_request([2] * 20, max_new_tokens=30)
+        eng.step()
+        assert eng.num_active == 1 and len(eng.pending) == 1
+        results = eng.run()
+        assert len(results[a]) == 30 and len(results[b]) == 30
+
+
+pytestmark = pytest.mark.smoke
