@@ -121,6 +121,11 @@ define_flag("comm_timeout_s", 600.0,
             "eager collective / train-step watchdog timeout (seconds); the "
             "FLAGS_nccl_blocking_wait analog for DCN stalls")
 define_flag("low_precision_op_list", 0, "log ops run in low precision under AMP")
+define_flag("eager_loop_warn_ops", 200000,
+            "warn once after this many eagerly-dispatched ops (0 = off): "
+            "a long-running eager loop is launch-bound (~18us/op on "
+            "tunneled devices) and should compile its step via "
+            "jit.TrainStep / to_static")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")
 define_flag("rng_impl", "rbg",
