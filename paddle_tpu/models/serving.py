@@ -48,6 +48,8 @@ class Request:
     out_tokens: List[int] = field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
+    admit_order: int = -1              # LIFO preemption victim choice
+    preemptions: int = 0
 
 
 class _SlotView:
@@ -87,7 +89,7 @@ class ContinuousBatchingEngine:
                  max_blocks_per_seq: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0):
+                 top_p: float = 1.0, preempt_after: Optional[int] = None):
         cfg = model.config
         self.model = model
         self.eos = eos_token_id
@@ -110,7 +112,13 @@ class ContinuousBatchingEngine:
         self.tok = np.zeros((max_batch, 1), np.int32)
         self.pos = np.zeros((max_batch,), np.int32)
         self._next_rid = 0
+        self._admit_seq = 0
         self.steps = 0
+        # head-of-line fairness: preempt the LIFO victim when the queue
+        # head has starved this many steps (None = never preempt)
+        self.preempt_after = preempt_after
+        self._head_waited = 0
+        self.preempt_count = 0
 
     # -- request intake ------------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32) -> int:
@@ -153,21 +161,39 @@ class ContinuousBatchingEngine:
                     - self._outstanding_reservation()):
                 return                 # reservation: wait for reclaims
             self.pending.popleft()
+            self._head_waited = 0
             req.slot = i
+            req.admit_order = self._admit_seq
+            self._admit_seq += 1
             self.slots[i] = req
             view = _SlotView(self.cache, i)
-            ids = Tensor(jnp.asarray(req.prompt.reshape(1, -1)))
+            # a preempted request resumes by re-prefilling prompt + what
+            # it already generated (its blocks were reclaimed — the
+            # recompute-on-resume policy, cheaper than swapping KV host-
+            # side on TPU where prefill is MXU-bound and fast)
+            full = (np.concatenate([req.prompt,
+                                    np.asarray(req.out_tokens[:-1],
+                                               np.int32)])
+                    if req.out_tokens else req.prompt)
+            ids = Tensor(jnp.asarray(full.reshape(1, -1)))
             with no_grad():
                 logits = self.model(ids, cache=view,
                                     start_pos=Tensor(
                                         jnp.asarray(0, jnp.int32)))
-                nxt = call_op("sample_logits", logits[:, -1, :],
-                              **self.sampling)
-            first = int(np.asarray(nxt._data).reshape(-1)[0])
-            req.out_tokens.append(first)
-            self.cache.context_lens[i] = len(req.prompt)
-            self.pos[i] = len(req.prompt)
-            self.tok[i, 0] = first
+                if req.out_tokens:
+                    # resumed after preemption: the next input token was
+                    # already sampled before eviction — keep it and do
+                    # NOT draw (sampling would consume an RNG key and
+                    # make stochastic output schedule-dependent)
+                    self.tok[i, 0] = req.out_tokens[-1]
+                else:
+                    nxt = call_op("sample_logits", logits[:, -1, :],
+                                  **self.sampling)
+                    first = int(np.asarray(nxt._data).reshape(-1)[0])
+                    req.out_tokens.append(first)
+                    self.tok[i, 0] = first
+            self.cache.context_lens[i] = len(full)
+            self.pos[i] = len(full)
             self._finish_if_done(req)
 
     def _finish_if_done(self, req: Request) -> bool:
@@ -175,18 +201,34 @@ class ContinuousBatchingEngine:
                 or (self.eos is not None and req.out_tokens
                     and req.out_tokens[-1] == self.eos)):
             req.done = True
-            i = req.slot
-            self.cache.release(i)
-            self.slots[i] = None
-            self.pos[i] = 0
-            self.tok[i, 0] = 0
+            self._release_slot(req.slot)
             return True
         return False
+
+    def _release_slot(self, i: int):
+        self.cache.release(i)
+        self.slots[i] = None
+        self.pos[i] = 0
+        self.tok[i, 0] = 0
 
     # -- the continuous loop -------------------------------------------------
     @property
     def num_active(self) -> int:
         return sum(1 for r in self.slots if r is not None)
+
+    def _preempt_lifo(self):
+        """Evict the most-recently-admitted sequence (vLLM's default
+        victim): reclaim its blocks now, requeue it right behind the
+        starved head for recompute-on-resume."""
+        victim = max((r for r in self.slots if r is not None),
+                     key=lambda r: r.admit_order, default=None)
+        if victim is None:
+            return
+        self._release_slot(victim.slot)
+        victim.slot = None
+        victim.preemptions += 1
+        self.preempt_count += 1
+        self.pending.insert(1, victim)  # right behind the starved head
 
     def step(self) -> List[Request]:
         """Admit + one decode step for every active slot. Returns the
@@ -194,6 +236,12 @@ class ContinuousBatchingEngine:
         from ..autograd.engine import no_grad
 
         self._admit()
+        if self.pending and self.preempt_after is not None:
+            self._head_waited += 1
+            if self._head_waited > self.preempt_after:
+                self._preempt_lifo()
+                self._head_waited = 0
+                self._admit()
         if self.num_active == 0:
             return []
         # per-row write slots: active rows append at pos; inactive rows

@@ -108,3 +108,31 @@ class TestContinuousBatching:
 
 
 pytestmark = pytest.mark.smoke
+
+
+class TestPreemption:
+    def test_preempted_sequence_resumes_identically(self, model):
+        # tight pool: one long request hogs it; preempt_after forces a
+        # LIFO eviction + recompute-on-resume; greedy tokens must match
+        # an unconstrained run exactly
+        want_a = _greedy_reference(model, [3, 4, 5], 24)
+        want_b = _greedy_reference(model, [9, 8, 7], 24)
+        eng = ContinuousBatchingEngine(model, max_batch=2, num_blocks=4,
+                                       block_size=16, temperature=0.0,
+                                       preempt_after=4)
+        a = eng.add_request([3, 4, 5], max_new_tokens=24)  # needs 2 blocks
+        b = eng.add_request([9, 8, 7], max_new_tokens=24)
+        results = eng.run()
+        assert eng.preempt_count >= 1, "pool pressure should preempt"
+        assert results[a] == want_a
+        assert results[b] == want_b
+
+    def test_no_preemption_when_disabled(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch=2, num_blocks=4,
+                                       block_size=16, temperature=0.0,
+                                       preempt_after=None)
+        a = eng.add_request([3, 4, 5], max_new_tokens=24)
+        b = eng.add_request([9, 8, 7], max_new_tokens=24)
+        results = eng.run()
+        assert eng.preempt_count == 0  # b just waits for a to finish
+        assert len(results[a]) == 24 and len(results[b]) == 24
