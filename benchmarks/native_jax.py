@@ -158,16 +158,23 @@ def _bert_init(key, vocab, hidden, layers, heads, ffn, max_pos,
     return p
 
 
-def _ln(x, s, b):
-    m = x.mean(-1, keepdims=True)
-    v = x.var(-1, keepdims=True)
-    return (x - m) * lax.rsqrt(v + 1e-12) * s + b
+def _ln(x, s, b, f32_stats=False):
+    # f32_stats = the AMP-black-list regime: norm statistics in f32 (the
+    # framework's dispatcher upcasts layer_norm under autocast, matching
+    # the reference amp lists) — keeps the twin like-for-like under O2
+    xf, sf, bf = ((t.astype(jnp.float32) for t in (x, s, b))
+                  if f32_stats else (x, s, b))
+    m = xf.mean(-1, keepdims=True)
+    v = xf.var(-1, keepdims=True)
+    return ((xf - m) * lax.rsqrt(v + 1e-12) * sf + bf).astype(x.dtype)
 
 
-def _bert_fwd(p, ids, layers, heads, dropout=0.0, key=None):
+def _bert_fwd(p, ids, layers, heads, dropout=0.0, key=None,
+              f32_norms=False):
     B, S = ids.shape
+    ln = functools.partial(_ln, f32_stats=f32_norms)
     h = p["tok"][ids] + p["pos"][None, :S]
-    h = _ln(h, p["emb_s"], p["emb_b"])
+    h = ln(h, p["emb_s"], p["emb_b"])
     hd = h.shape[-1] // heads
     keep = 1.0 - dropout
 
@@ -183,13 +190,18 @@ def _bert_fwd(p, ids, layers, heads, dropout=0.0, key=None):
         kk = (h @ p[f"l{i}_kw"] + p[f"l{i}_kb"]).reshape(B, S, heads, hd)
         v = (h @ p[f"l{i}_vw"] + p[f"l{i}_vb"]).reshape(B, S, heads, hd)
         att = jnp.einsum("bshd,bthd->bhst", q, kk) / hd ** 0.5
-        att = drop(jax.nn.softmax(att, axis=-1), 3 * i)
+        if f32_norms:     # softmax is amp-black-listed too
+            att = jax.nn.softmax(att.astype(jnp.float32),
+                                 axis=-1).astype(att.dtype)
+        else:
+            att = jax.nn.softmax(att, axis=-1)
+        att = drop(att, 3 * i)
         ctx = jnp.einsum("bhst,bthd->bshd", att, v).reshape(B, S, -1)
         ctx = drop(ctx @ p[f"l{i}_ow"] + p[f"l{i}_ob"], 3 * i + 1)
-        h = _ln(h + ctx, p[f"l{i}_ln1s"], p[f"l{i}_ln1b"])
+        h = ln(h + ctx, p[f"l{i}_ln1s"], p[f"l{i}_ln1b"])
         f = jax.nn.gelu(h @ p[f"l{i}_f1w"] + p[f"l{i}_f1b"])
         f = drop(f @ p[f"l{i}_f2w"] + p[f"l{i}_f2b"], 3 * i + 2)
-        h = _ln(h + f, p[f"l{i}_ln2s"], p[f"l{i}_ln2b"])
+        h = ln(h + f, p[f"l{i}_ln2s"], p[f"l{i}_ln2b"])
     return h @ p["qa_w"] + p["qa_b"]  # [B, S, 2] start/end logits
 
 
@@ -217,7 +229,7 @@ def make_bert_step(batch: int, seq: int, vocab: int = 30522,
                 lambda a: a.astype(jnp.bfloat16)
                 if jnp.issubdtype(a.dtype, jnp.floating) else a, p_)
         logits = _bert_fwd(p_, ids, layers, heads, dropout,
-                           key).astype(jnp.float32)
+                           key, f32_norms=amp_o2).astype(jnp.float32)
         ls = jax.nn.log_softmax(logits[..., 0], -1)
         le = jax.nn.log_softmax(logits[..., 1], -1)
         return -(jnp.take_along_axis(ls, starts[:, None], 1).mean()
