@@ -10,3 +10,4 @@ from .llama import (  # noqa: F401
 from .bert import BertConfig, BertModel, BertForQuestionAnswering  # noqa: F401
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
 from .ocr import DBNet, DBLoss, CRNN, CTCHeadLoss  # noqa: E402,F401
+from .serving import ContinuousBatchingEngine  # noqa: F401

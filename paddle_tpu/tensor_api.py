@@ -322,7 +322,8 @@ def set_printoptions(precision: Optional[int] = None,
                 else np.get_printoptions()["precision"])
         kw["formatter"] = {"float_kind":
                            lambda v, _p=prec:
-                           np.format_float_scientific(v, precision=_p)}
+                           np.format_float_scientific(v, precision=_p,
+                                                      unique=False)}
     elif sci_mode is not None:
         kw["formatter"] = None
     np.set_printoptions(**kw)
