@@ -1083,7 +1083,10 @@ def bench_dispatch(on_tpu: bool):
 
     return {
         "metric": "eager_dispatch_overhead_us_per_op",
-        "value": round(overhead, 2),
+        # launch-latency variance on tunneled chips can push the
+        # subtraction below zero; clamp the headline value, keep the raw
+        # reading in detail
+        "value": round(max(overhead, 0.0), 2),
         "unit": "us/op",
         # VERDICT r2 Next#3 waiver criterion: Python dispatch must stay
         # within ~2x of the reference's C++ per-op budget (~5us); ratio
@@ -1092,6 +1095,7 @@ def bench_dispatch(on_tpu: bool):
         # and the subtraction can go ~0/negative; clamp to [0.1us, ...]
         "vs_baseline": round(min(10.0 / max(overhead, 0.1), 100.0), 4),
         "detail": {
+            "raw_overhead_us": round(overhead, 2),
             "eager_us_per_op": round(eager_us_per_op, 2),
             "direct_executable_launch_us": round(direct_us, 2),
             "jit_us_per_op": round(jit_us_per_op, 2),
