@@ -791,6 +791,52 @@ def _make_adapters(call):
     def assign(env, op):
         _bind(env, op, "Out", _in(env, op, "X"))
 
+    def arg_min(env, op):
+        if op.attrs.get("flatten"):
+            raise NotImplementedError("arg_min with flatten=True")
+        # legacy default output dtype is int64; this framework runs with
+        # x64 disabled (int64 is int32 everywhere — MIGRATION.md), so the
+        # index dtype follows the kernel's int32
+        _bind(env, op, "Out", call(
+            "argmin", _in(env, op, "X"), op.attrs.get("axis", -1),
+            op.attrs.get("keepdims", False)))
+
+    def stack_op(env, op):
+        xs = [env[n] for n in op.inputs.get("X", [])]
+        _bind(env, op, "Y", call("stack", xs, op.attrs.get("axis", 0)))
+
+    def gather_op(env, op):
+        if op.inputs.get("Axis"):
+            raise NotImplementedError("gather with Axis tensor input")
+        idx = _in(env, op, "Index")
+        if len(idx.shape) == 2 and idx.shape[1] == 1:
+            # legacy exports store indices as [N, 1]; jnp.take would
+            # insert both dims
+            idx = call("reshape", idx, [-1])
+        _bind(env, op, "Out", call("gather", _in(env, op, "X"), idx,
+                                   op.attrs.get("axis", 0)))
+
+    def pad3d(env, op):
+        _reject_tensor_attrs(op, "Paddings")
+        _bind(env, op, "Out", call(
+            "pad", _in(env, op, "X"),
+            [int(a) for a in op.attrs["paddings"]],
+            op.attrs.get("mode", "constant"),
+            float(op.attrs.get("value", 0.0)),
+            op.attrs.get("data_format", "NCDHW")))
+
+    def flatten2(env, op):
+        # legacy flatten2: collapse to 2D at `axis` (NOT the
+        # start/stop_axis convention of flatten_contiguous_range)
+        x = _in(env, op, "X")
+        ax = op.attrs.get("axis", 1)
+        if ax == 0:
+            # trailing product would bake the trace-time batch
+            _bind(env, op, "Out", call("reshape", x, [1, -1]))
+            return
+        trail = int(np.prod(list(x.shape)[ax:]))
+        _bind(env, op, "Out", call("reshape", x, [-1, trail]))
+
     def interp(name):
         def f(env, op):
             kw = {}
@@ -833,6 +879,18 @@ def _make_adapters(call):
         "reduce_max": reduce("max"), "reduce_min": reduce("min"),
         "arg_max": arg_max, "fill_constant": fill_constant,
         "expand_v2": expand_v2, "assign": assign,
+        "greater_than": ew("greater_than"), "less_than": ew("less_than"),
+        "greater_equal": ew("greater_equal"),
+        "less_equal": ew("less_equal"), "equal": ew("equal"),
+        "not_equal": ew("not_equal"),
+        "elementwise_mod": ew("remainder"),
+        "elementwise_floordiv": ew("floor_divide"),
+        "arg_min": arg_min, "stack": stack_op, "gather": gather_op,
+        "pad3d": pad3d, "reduce_prod": reduce("prod"),
+        "squeeze": squeeze2, "unsqueeze": unsqueeze2,
+        "mish": unary("mish"), "square": unary("square"),
+        "sin": unary("sin"), "cos": unary("cos"),
+        "flatten2": flatten2,
         "shape": None,                   # resolved statically below
         "nearest_interp_v2": interp("interpolate_nearest"),
         "bilinear_interp_v2": interp("interpolate_bilinear"),

@@ -243,10 +243,14 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
     if len(pad) == 2 * x.ndim:
         widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
     else:
-        # paddle semantics: pad applies to trailing spatial dims, reversed pairs
+        # reference semantics (nn/functional/common.py:1547): pairs run
+        # from the LAST spatial dim inward — (left, right, top, bottom,
+        # front, back) — so the W pair comes first and applies to the
+        # trailing axis (r5 fix: the forward-order application padded D
+        # with the W amounts in asymmetric NCDHW cases)
         n_spatial = len(pad) // 2
-        widths = [(0, 0)] * (x.ndim - n_spatial)
-        spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        spatial = [(pad[2 * i], pad[2 * i + 1])
+                   for i in range(n_spatial)][::-1]
         if data_format in ("NCHW", "NCL", "NCDHW"):
             widths = [(0, 0), (0, 0)] + spatial
         else:

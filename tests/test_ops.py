@@ -137,9 +137,14 @@ class TestManipulation:
                      lambda condition, x, y: np.where(condition, x, y))
 
     def test_pad(self):
+        # reference order (nn/functional/common.py:1548): (left, right,
+        # top, bottom) — the W pair comes FIRST (r5 fix; the old
+        # expectation [1,2,5,7] encoded the forward-order bug)
         x = f32(1, 2, 3, 3)
         out = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 2])
-        assert out.shape == [1, 2, 5, 7]
+        assert out.shape == [1, 2, 7, 5]
+        np.testing.assert_allclose(
+            out.numpy(), np.pad(x, [(0, 0), (0, 0), (2, 2), (1, 1)]))
 
     def test_topk_sort(self):
         x = f32(4, 6)

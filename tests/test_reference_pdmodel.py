@@ -352,3 +352,64 @@ class TestBertInterchange:
 
 
 pytestmark = pytest.mark.smoke
+
+
+class TestAdapterTranche2:
+    def test_mixed_op_program_with_two_fetches(self, tmp_path):
+        # r5 tranche: flatten2 (legacy axis semantics), square, stack,
+        # reduce_prod, comparisons, arg_min, multi-fetch ordering
+        rng = np.random.RandomState(0)
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 4, 6))
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("flatten2", {"X": ["x"]}, {"Out": ["f"]}, {"axis": 1}),
+            O("square", {"X": ["f"]}, {"Out": ["sq"]}, {}),
+            O("reduce_prod", {"X": ["sq"]}, {"Out": ["p"]},
+              {"dim": [1], "keep_dim": True}),
+            O("greater_equal", {"X": ["sq"], "Y": ["p"]}, {"Out": ["ge"]},
+              {}),
+            O("cast", {"X": ["ge"]}, {"Out": ["gef"]}, {"out_dtype": 5}),
+            O("stack", {"X": ["gef", "gef"]}, {"Y": ["st"]}, {"axis": 1}),
+            O("arg_min", {"X": ["sq"]}, {"Out": ["am"]},
+              {"axis": 1, "keepdims": False}),
+            O("cast", {"X": ["am"]}, {"Out": ["amf"]}, {"out_dtype": 5}),
+            O("fetch", {"X": ["st"]}, {"Out": ["fetch"]}, {"col": 0}),
+            O("fetch", {"X": ["amf"]}, {"Out": ["fetch"]}, {"col": 1}),
+        ]
+        prefix = _write_model(tmp_path, "tranche2", blk, {})
+        from paddle_tpu import inference as I
+        pred = I.create_predictor(I.Config(prefix))
+        x = rng.randn(3, 4, 6).astype(np.float32)
+        outs = pred.run([x])
+        sq = (x.reshape(3, -1)) ** 2
+        pr = sq.prod(axis=1, keepdims=True)
+        np.testing.assert_allclose(
+            outs[0], np.stack([(sq >= pr).astype(np.float32)] * 2, 1))
+        np.testing.assert_array_equal(
+            outs[1], sq.argmin(axis=1).astype(np.float32))
+
+    def test_pad3d_and_gather(self, tmp_path):
+        rng = np.random.RandomState(1)
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 2, 3, 4, 4))
+        idx = np.asarray([1, 0], np.int64)
+        blk.vars["idx"] = M.VarDescLite("idx", np.dtype("int64"), (2,),
+                                        persistable=True)
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("pad3d", {"X": ["x"]}, {"Out": ["pd"]},
+              {"paddings": [1, 1, 0, 0, 0, 0], "mode": "constant",
+               "value": 0.0, "data_format": "NCDHW"}),
+            O("gather", {"X": ["pd"], "Index": ["idx"]}, {"Out": ["g"]},
+              {"axis": 1}),
+            O("fetch", {"X": ["g"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "pad", blk, {"idx": idx})
+        from paddle_tpu import inference as I
+        pred = I.create_predictor(I.Config(prefix))
+        x = rng.randn(2, 2, 3, 4, 4).astype(np.float32)
+        out = pred.run([x])[0]
+        want = np.pad(x, [(0, 0), (0, 0), (0, 0), (0, 0), (1, 1)])
+        want = want[:, [1, 0]]
+        np.testing.assert_allclose(out, want)
