@@ -122,14 +122,30 @@ class _DistcpUnpickler(pickle.Unpickler):
              "LocalTensorIndex": RefLocalTensorIndex,
              "Metadata": RefMetadata}
 
+    # exactly the callables ndarray/dtype reconstruction needs — a
+    # module-level allowlist would also expose e.g. numpy.load (pickle
+    # GLOBALs can reach any module attribute, including dotted paths)
+    _NP_MODULES = frozenset((
+        "numpy", "numpy.core.multiarray", "numpy._core.multiarray",
+        "numpy.core.numeric", "numpy._core.numeric", "numpy.dtypes",
+        "ml_dtypes"))
+    _NP_NAMES = frozenset((
+        "_reconstruct", "_frombuffer", "scalar",   # ndarray reducers
+        "ndarray", "dtype",                        # their type arguments
+        # the ml_dtypes scalar family: dtype classes, not callables with
+        # side effects — narrow-precision checkpoints keep loading
+        "bfloat16", "float8_e3m4", "float8_e4m3", "float8_e4m3b11fnuz",
+        "float8_e4m3fn", "float8_e4m3fnuz", "float8_e5m2",
+        "float8_e5m2fnuz", "float8_e8m0fnu", "float6_e2m3fn",
+        "float6_e3m2fn", "float4_e2m1fn", "int2", "int4", "uint2",
+        "uint4"))
+
     def find_class(self, module, name):
         if module == _REF_MODULE and name in self._META:
             return self._META[name]
         from ...framework import _ALLOWED_GLOBALS
-        if module in ("numpy", "numpy.core.multiarray",
-                      "numpy._core.multiarray", "numpy.core.numeric",
-                      "numpy._core.numeric", "numpy.dtypes",
-                      "ml_dtypes"):     # bf16 ndarrays pickle via ml_dtypes
+        if (module in self._NP_MODULES and name in self._NP_NAMES
+                and "." not in name):   # dotted names walk attributes
             return super().find_class(module, name)
         hit = _ALLOWED_GLOBALS.get((module, name))
         if hit is not None:

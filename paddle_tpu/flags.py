@@ -115,6 +115,12 @@ def set_flags(flags: Dict[str, Any]) -> None:
 # -- Core flags (subset mirroring paddle/common/flags.cc) ---------------------
 define_flag("check_nan_inf", False, "check every op output for NaN/Inf (eager)")
 define_flag("eager_op_jit", True, "jit-compile each eager op (per-op XLA cache)")
+define_flag("fused_backward", True,
+            "structure-cached fused backward: compile each stable tape "
+            "structure's whole reverse walk into ONE XLA executable "
+            "(autograd/engine.py). First sight of a structure, and walks "
+            "with tensor hooks / create_graph / capture, use the per-node "
+            "walk; the signature cache is bounded")
 define_flag("use_pallas_kernels", True, "route hot ops to Pallas hand kernels")
 define_flag("benchmark", False, "block on every op for accurate timing")
 define_flag("comm_timeout_s", 600.0,

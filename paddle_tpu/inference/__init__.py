@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import static as static_mod
-from ..static.executor import _replay
+from ..static.executor import Scope, _replay
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor"]
 
@@ -93,6 +93,10 @@ class Predictor:
     def __init__(self, config: Config):
         self.config = config
         exe = static_mod.Executor()
+        # AnalysisPredictor owns its scope (analysis_predictor.h): loading
+        # into the process-global scope would let model params shadow
+        # same-named parameters of later static programs
+        exe.scope = Scope()
         program, feeds, fetches = static_mod.load_inference_model(
             config.model_prefix, exe)
         self._program = program

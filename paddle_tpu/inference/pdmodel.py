@@ -544,8 +544,46 @@ def _bcast_y(call, y, x_ndim, y_ndim, axis):
     return call("reshape", y, shape)
 
 
-def _make_adapters(call):
+def _same_pads(spatial, ksize, strides):
+    """padding_algorithm='SAME' (reference conv_util.h
+    UpdatePaddingAndDilation / pooling.cc UpdatePadding): out =
+    ceil(in/stride), pad_sum = max((out-1)*stride + k - in, 0), split
+    low/high. Returns [(before, after), ...] per spatial dim."""
+    pads = []
+    for sz, k, s in zip(spatial, ksize, strides):
+        if not isinstance(sz, int) or sz <= 0:
+            raise NotImplementedError(
+                f"padding_algorithm='SAME' needs static spatial dims, "
+                f"got size {sz!r}")
+        total = max((-(-sz // s) - 1) * s + int(k) - sz, 0)
+        pads.append((total // 2, total - total // 2))
+    return pads
+
+
+def _spatial_dims(x, data_format):
+    shape = list(x.shape)
+    return shape[2:4] if data_format.startswith("NC") else shape[1:3]
+
+
+def _make_adapters(call, dyn=None):
+    """`dyn` is the translate-time dynamic-shape state:
+    feeds      — feed names with a dynamic (-1) dim,
+    tainted    — every var name derived from such a feed (the driver loop
+                 propagates this op by op),
+    sp_tainted — the subset derived from a feed with a dynamic NON-batch
+                 dim (its spatial sizes were recorded as placeholder 1s).
+    Adapters only guard tensors that actually descend from a dynamic
+    feed — static tensors with size-1 dims keep translating, and a
+    spatially-dynamic feed elsewhere in the graph doesn't poison
+    tensors whose own spatial dims are static."""
     import numpy as np
+
+    if dyn is None:
+        dyn = {"feeds": set(), "tainted": set(), "sp_tainted": set()}
+
+    def _tainted(op, key, idx=0, which="tainted"):
+        args = op.inputs.get(key) or []
+        return len(args) > idx and args[idx] in dyn[which]
 
     def unary(name):
         def f(env, op):
@@ -562,15 +600,30 @@ def _make_adapters(call):
 
     def conv(env, op):
         x, w = _in(env, op, "Input"), _in(env, op, "Filter")
+        algo = op.attrs.get("padding_algorithm", "EXPLICIT")
+        strides = op.attrs.get("strides", [1, 1])
+        dilations = op.attrs.get("dilations", [1, 1])
         pads = op.attrs.get("paddings", [0, 0])
-        if op.attrs.get("padding_algorithm", "EXPLICIT") == "VALID":
+        df = op.attrs.get("data_format", "NCHW").replace("AnyLayout",
+                                                         "NCHW")
+        if algo == "VALID":
             pads = [0, 0]
-        out = call("conv2d", x, w, None,
-                   op.attrs.get("strides", [1, 1]), pads,
-                   op.attrs.get("dilations", [1, 1]),
-                   op.attrs.get("groups", 1),
-                   op.attrs.get("data_format", "NCHW")
-                   .replace("AnyLayout", "NCHW"))
+        elif algo == "SAME":
+            if _tainted(op, "Input", which="sp_tainted"):
+                # spatial dims were recorded as placeholder 1s: pads
+                # computed from them would be silently wrong
+                raise NotImplementedError(
+                    "conv2d padding_algorithm='SAME' on an input derived "
+                    "from a feed with dynamic spatial dims — export with "
+                    "static H/W")
+            # reference UpdatePaddingAndDilation resets dilation to 1
+            # under SAME and computes pads on the raw filter dims
+            pp = _same_pads(_spatial_dims(x, df), list(w.shape)[2:4],
+                            strides)
+            pads = [p for pair in pp for p in pair]   # [h0,h1,w0,w1]
+            dilations = [1, 1]
+        out = call("conv2d", x, w, None, strides, pads, dilations,
+                   op.attrs.get("groups", 1), df)
         _bind(env, op, "Output", out)
 
     def batch_norm(env, op):
@@ -582,15 +635,37 @@ def _make_adapters(call):
         _bind(env, op, "Y", out)
 
     def pool2d(env, op):
-        out = call("pool2d", _in(env, op, "X"),
-                   op.attrs.get("ksize", []), op.attrs.get("strides", []),
-                   op.attrs.get("paddings", [0, 0]),
+        x = _in(env, op, "X")
+        algo = op.attrs.get("padding_algorithm", "EXPLICIT")
+        ksize = op.attrs.get("ksize", [])
+        strides = op.attrs.get("strides", [])
+        pads = op.attrs.get("paddings", [0, 0])
+        df = op.attrs.get("data_format", "NCHW").replace("AnyLayout",
+                                                         "NCHW")
+        whole = (op.attrs.get("global_pooling", False)
+                 or op.attrs.get("adaptive", False))
+        if algo == "VALID" and not whole:
+            pads = [0, 0]
+        elif algo == "SAME" and not whole:
+            if _tainted(op, "X", which="sp_tainted"):
+                raise NotImplementedError(
+                    "pool2d padding_algorithm='SAME' on an input derived "
+                    "from a feed with dynamic spatial dims — export with "
+                    "static H/W")
+            pp = _same_pads(_spatial_dims(x, df), ksize,
+                            strides or ksize)
+            if any(lo != hi for lo, hi in pp):
+                raise NotImplementedError(
+                    f"pool2d padding_algorithm='SAME' needs asymmetric "
+                    f"padding {pp} here; the pool kernel only takes "
+                    f"symmetric per-dim pads")
+            pads = [lo for lo, _hi in pp]
+        out = call("pool2d", x, ksize, strides, pads,
                    op.attrs.get("pooling_type", "max"),
                    op.attrs.get("ceil_mode", False),
                    op.attrs.get("exclusive", True),
                    op.attrs.get("adaptive", False),
-                   op.attrs.get("global_pooling", False),
-                   op.attrs.get("data_format", "NCHW"))
+                   op.attrs.get("global_pooling", False), df)
         _bind(env, op, "Out", out)
 
     def matmul_v2(env, op):
@@ -661,6 +736,25 @@ def _make_adapters(call):
     def squeeze2(env, op):
         x = _in(env, op, "X")
         axes = [int(a) for a in op.attrs.get("axes", [])]
+        if _tainted(op, "X", which="sp_tainted"):
+            # non-batch dynamic dims record as placeholder 1s: the baked
+            # reshape would freeze them (and axes=[] would squeeze them)
+            raise NotImplementedError(
+                f"squeeze2 on a tensor derived from a feed with dynamic "
+                f"non-batch dims ({sorted(dyn['feeds'])}): placeholder "
+                f"size-1 dims would be baked — export with static shapes")
+        if (x.shape and x.shape[0] == 1 and _tainted(op, "X")
+                and (not axes or 0 in axes or -len(x.shape) in axes)):
+            # the dynamic batch records as size 1, so axes=[] (or axes
+            # naming dim 0) would squeeze it away and bake a batch-of-1
+            # reshape into the replayed program — wrong at every other
+            # batch size (static tensors with size-1 dims squeeze fine;
+            # reference squeeze2 leaves a non-1 runtime dim untouched)
+            raise NotImplementedError(
+                f"squeeze2 of the batch dim on a tensor derived from "
+                f"dynamic feed dims ({sorted(dyn['feeds'])}): the "
+                f"recorded size-1 batch would be squeezed and baked — "
+                f"export with axes sparing dim 0 or static shapes")
         shape = [d for i, d in enumerate(x.shape)
                  if not (d == 1 and (not axes or i in axes
                                      or i - len(x.shape) in axes))]
@@ -674,8 +768,28 @@ def _make_adapters(call):
     def unsqueeze2(env, op):
         x = _in(env, op, "X")
         shape = list(x.shape)
-        for a in sorted(int(a) for a in op.attrs.get("axes", [])):
-            shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+        axes = [int(a) for a in op.attrs.get("axes", [])]
+        if _tainted(op, "X", which="sp_tainted"):
+            raise NotImplementedError(
+                f"unsqueeze2 on a tensor derived from a feed with "
+                f"dynamic non-batch dims ({sorted(dyn['feeds'])}): the "
+                f"baked shape would freeze placeholder size-1 dims — "
+                f"export with static shapes")
+        # reference GetUnsqueezeShape (phi funcs/unsqueeze.h): axes apply
+        # in GIVEN order, each negative axis resolved against the
+        # already-grown rank — len(shape) tracks cur_output_size
+        dyn_batch = bool(shape) and shape[0] == 1 and _tainted(op, "X")
+        for a in axes:
+            pos = a if a >= 0 else a + len(shape) + 1
+            if dyn_batch and pos == 0:
+                # inserting at axis 0 moves the (dynamic, recorded-as-1)
+                # batch to axis 1 where it is baked as a literal 1
+                raise NotImplementedError(
+                    f"unsqueeze2 at axis 0 on a tensor derived from "
+                    f"dynamic feed dims ({sorted(dyn['feeds'])}): the "
+                    f"size-1 batch moves off axis 0 and is baked as "
+                    f"literal 1 — export with static shapes")
+            shape.insert(pos, 1)
         if shape and shape[0] == x.shape[0] and x.shape:
             shape[0] = -1          # batch dim stays dynamic
         _bind(env, op, "Out", call("reshape", x, shape))
@@ -919,9 +1033,11 @@ def translate_program(prog_pb: ProgramDescLite,
     def call(name, *args, **kw):
         return call_op(name, *args, **kw)
 
-    adapters = _make_adapters(call)
     env: Dict[str, Any] = {}
-    dynamic_feeds: set = set()
+    # dynamic-shape state, mutated as feed ops are seen and taint is
+    # propagated op by op; adapters read it at call time
+    dyn = {"feeds": set(), "tainted": set(), "sp_tainted": set()}
+    adapters = _make_adapters(call, dyn)
 
     with G.program_guard(program):
         gb = program.global_block
@@ -944,7 +1060,10 @@ def translate_program(prog_pb: ProgramDescLite,
                     raise ValueError(f"feed target {out_name} has no "
                                      f"TensorDesc")
                 if any(d < 0 for d in var.dims):
-                    dynamic_feeds.add(out_name)
+                    dyn["feeds"].add(out_name)
+                    dyn["tainted"].add(out_name)
+                    if any(d < 0 for d in var.dims[1:]):
+                        dyn["sp_tainted"].add(out_name)
                 dims = tuple(1 if d < 0 else int(d) for d in var.dims)
                 dt = (jnp.bfloat16 if var.dtype == "bfloat16"
                       else np.dtype(var.dtype))
@@ -956,10 +1075,10 @@ def translate_program(prog_pb: ProgramDescLite,
                 fetch_names.append(op.inputs["X"][0])
                 continue
             if op.type == "shape":
-                if dynamic_feeds:
+                if dyn["feeds"]:
                     raise NotImplementedError(
                         "upstream 'shape' op with a dynamic feed dim "
-                        f"({sorted(dynamic_feeds)}): the recorded program "
+                        f"({sorted(dyn['feeds'])}): the recorded program "
                         "would bake the trace-time batch — export with "
                         "static shapes or add a symbolic-shape adapter")
                 x = _in(env, op, "Input") or _in(env, op, "X")
@@ -973,6 +1092,16 @@ def translate_program(prog_pb: ProgramDescLite,
                     f"adapter in inference/pdmodel.py (op_compat maps the "
                     f"name; the adapter owns the calling convention)")
             fn(env, op)
+            # propagate dynamic-feed taint: any op consuming a tainted
+            # var produces tainted vars (guards above read these sets);
+            # spatial taint flows separately so a spatially-dynamic feed
+            # elsewhere doesn't poison statically-shaped branches
+            for which in ("tainted", "sp_tainted"):
+                if dyn[which] and any(
+                        nm in dyn[which]
+                        for args in op.inputs.values() for nm in args):
+                    for args in op.outputs.values():
+                        dyn[which].update(args)
             # rebind recorder tmp names to the upstream var names so
             # fetch targets resolve in the executor replay
             for args in op.outputs.values():

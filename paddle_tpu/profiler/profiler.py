@@ -122,7 +122,13 @@ class RecordEvent:
 
 
 def _op_span_hook(op_name: str):
-    return RecordEvent(op_name, TracerEventType.Operator)
+    # the autograd engine surfaces its walk here too: per-node vjp calls
+    # as "grad::<op>" and the structure-cached single-executable walk as
+    # "fused_backward" — both typed Backward so summaries split fwd/bwd
+    et = (TracerEventType.Backward
+          if op_name.startswith("grad::") or op_name == "fused_backward"
+          else TracerEventType.Operator)
+    return RecordEvent(op_name, et)
 
 
 # -- scheduler ----------------------------------------------------------------

@@ -1,0 +1,304 @@
+"""Structure-cached fused backward (autograd/engine.py): the single-
+executable walk must match the per-node walk exactly, fall back on
+anything it can't express, and keep its signature cache bounded."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import engine
+
+
+def f32(*shape):
+    return np.random.RandomState(7).randn(*shape).astype(np.float32)
+
+
+def set_fused(on: bool):
+    paddle.set_flags({"FLAGS_fused_backward": on})
+
+
+@pytest.fixture(autouse=True)
+def _fused_on():
+    set_fused(True)
+    yield
+    set_fused(True)
+
+
+def run_both(build, n_runs=3):
+    """Run `build` (fresh tape -> list of grad arrays) once with the
+    per-node walk and `n_runs` times with the fused path (prime,
+    compile+hit, cached hit). Returns (walk_grads, fused_runs)."""
+    set_fused(False)
+    ref = build()
+    set_fused(True)
+    engine._miss_streak = 0   # suite-order independence: breaker off
+    before = dict(engine.fused_counters)
+    runs = [build() for _ in range(n_runs)]
+    after = dict(engine.fused_counters)
+    assert after["hit"] > before["hit"], \
+        "fused path never executed — test is vacuous"
+    return ref, runs
+
+
+def assert_grads_match(ref, got):
+    assert len(ref) == len(got)
+    for r, g in zip(ref, got):
+        if r is None:
+            assert g is None
+            continue
+        assert g is not None
+        assert g.dtype == r.dtype          # exact dtype, not just values
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestFusedMatchesWalk:
+    def test_shared_subexpression(self):
+        def build():
+            x = paddle.to_tensor(f32(4, 3), stop_gradient=False)
+            w = paddle.to_tensor(f32(4, 3), stop_gradient=False)
+            s = x * w                      # shared by three consumers
+            y = (s * s + s - s.exp()).sum()
+            y.backward()
+            return [x.grad.numpy(), w.grad.numpy()]
+
+        ref, runs = run_both(build)
+        for got in runs:
+            assert_grads_match(ref, got)
+
+    def test_mixed_stop_gradient(self):
+        def build():
+            x = paddle.to_tensor(f32(5), stop_gradient=False)
+            frozen = paddle.to_tensor(f32(5), stop_gradient=True)
+            y = (x * frozen + frozen).sum()
+            y.backward()
+            return [x.grad.numpy(), frozen.grad]
+
+        ref, runs = run_both(build)
+        for got in runs:
+            assert ref[1] is None and got[1] is None
+            assert_grads_match(ref[:1], got[:1])
+
+    def test_mixed_dtype_cotangent_cast(self):
+        # bf16 consumer of an f32 primal: the fused walk must reproduce
+        # the per-node walk's cotangent dtype promotion exactly
+        def build():
+            x = paddle.to_tensor(f32(8), stop_gradient=False)
+            h = x.astype("bfloat16")
+            y = (h * h).sum().astype("float32") + (x * 2.0).sum()
+            y.backward()
+            return [x.grad._data]
+
+        ref, runs = run_both(build)
+        for got in runs:
+            assert_grads_match(ref, got)
+
+    def test_accumulate_into_existing_grad(self):
+        def build():
+            x = paddle.to_tensor(f32(6), stop_gradient=False)
+            (x * 3.0).sum().backward()     # first tape: .grad created
+            (x * x).sum().backward()       # second: accumulates into it
+            return [x.grad.numpy()]
+
+        ref, runs = run_both(build)
+        for got in runs:
+            assert_grads_match(ref, got)
+
+    def test_retain_graph_rewalk_same_tape(self):
+        def build():
+            x = paddle.to_tensor([2.0], stop_gradient=False)
+            y = (x * x).sum()
+            y.backward(retain_graph=True)  # primes the structure
+            y.backward()                   # same signature: fused hit
+            return [x.grad.numpy()]
+
+        ref, runs = run_both(build)
+        for got in runs:
+            assert_grads_match(ref, got)
+        np.testing.assert_allclose(ref[0], [8.0])
+
+    def test_non_scalar_seed_and_multi_root(self):
+        def build():
+            x = paddle.to_tensor(f32(3), stop_gradient=False)
+            a = x * 2.0
+            b = x.exp()
+            engine.backward([a, b], [paddle.to_tensor(f32(3) * 0.5),
+                                     paddle.to_tensor(
+                                         np.ones(3, np.float32))])
+            return [x.grad.numpy()]
+
+        ref, runs = run_both(build)
+        for got in runs:
+            assert_grads_match(ref, got)
+
+    def test_functional_grad_leaf_inputs(self):
+        # paddle.grad with leaf inputs takes the fused path (capture is
+        # empty) and must not touch other leaves' .grad
+        def build():
+            x = paddle.to_tensor(f32(4), stop_gradient=False)
+            w = paddle.to_tensor(f32(4), stop_gradient=False)
+            y = (x * w).sum()
+            (g,) = paddle.grad(y, x)
+            assert x.grad is None and w.grad is None
+            return [g.numpy()]
+
+        ref, runs = run_both(build)
+        for got in runs:
+            assert_grads_match(ref, got)
+
+
+class TestFusedFallbacks:
+    def test_tensor_hook_falls_back(self):
+        before = dict(engine.fused_counters)
+
+        def build():
+            x = paddle.to_tensor([1.0], stop_gradient=False)
+            y = x * 2.0
+            x.register_hook(lambda g: g * 10.0)
+            y.sum().backward()
+            return x.grad.numpy()
+
+        for _ in range(3):
+            np.testing.assert_allclose(build(), [20.0])
+        after = dict(engine.fused_counters)
+        assert after["hit"] == before["hit"]
+        assert after["fallback"] > before["fallback"]
+
+    def test_intermediate_hook_falls_back(self):
+        before = dict(engine.fused_counters)
+        for _ in range(3):
+            x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+            y = x * 2.0
+            y.register_hook(lambda g: g * 3.0)
+            (y * 1.0).sum().backward()
+            np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+        after = dict(engine.fused_counters)
+        assert after["hit"] == before["hit"]
+
+    def test_create_graph_keeps_per_node_walk(self):
+        # double grad runs through the per-node walk (create_graph) and
+        # stays correct with the fused cache warm
+        for _ in range(3):
+            x = paddle.to_tensor([3.0], stop_gradient=False)
+            y = (x * x * x).sum()
+            (g,) = paddle.grad(y, x, create_graph=True)
+            (g2,) = paddle.grad(g.sum(), x)
+            np.testing.assert_allclose(g2.numpy(), [18.0], rtol=1e-6)
+
+    def test_flag_off_means_no_fused_runs(self):
+        set_fused(False)
+        before = dict(engine.fused_counters)
+        for _ in range(3):
+            x = paddle.to_tensor([1.0], stop_gradient=False)
+            (x * 2.0).sum().backward()
+            np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        after = dict(engine.fused_counters)
+        assert after == before
+
+
+class TestSignatureCacheBounded:
+    def test_cache_stays_bounded(self, monkeypatch):
+        # regression guard: distinct structures must never grow the
+        # signature cache past its bound (_CONST_CACHE discipline)
+        monkeypatch.setattr(engine, "_FUSED_CACHE_MAX", 8)
+        engine._FUSED_CACHE.clear()
+        for n in range(1, 25):             # 24 distinct chain lengths
+            x = paddle.to_tensor(f32(3), stop_gradient=False)
+            y = x
+            for _ in range(n):
+                y = y * 1.5
+            y.sum().backward()
+        assert len(engine._FUSED_CACHE) <= 8
+
+    def test_thrash_breaker_bypasses_then_recovers(self, monkeypatch):
+        # a workload whose structure never repeats must stop paying the
+        # planner after _MISS_STREAK_MAX consecutive misses; a stable
+        # structure afterwards regains the fused path via the probe
+        monkeypatch.setattr(engine, "_MISS_STREAK_MAX", 4)
+        monkeypatch.setattr(engine, "_PROBE_EVERY", 3)
+        monkeypatch.setattr(engine, "_miss_streak", 0)
+        monkeypatch.setattr(engine, "_probe_tick", 0)
+        engine._FUSED_CACHE.clear()
+
+        def one_chain(n):
+            x = paddle.to_tensor(f32(3), stop_gradient=False)
+            y = x
+            for _ in range(n):
+                y = y * 1.5
+            y.sum().backward()
+            return x.grad.numpy()
+
+        before = dict(engine.fused_counters)
+        for n in range(1, 9):              # 8 never-repeating structures
+            one_chain(n)
+        after = dict(engine.fused_counters)
+        assert after["bypass"] > before["bypass"], \
+            "breaker never bypassed planning"
+        # now a stable structure: probe walks re-prime it, then it hits
+        hits0 = engine.fused_counters["hit"]
+        for _ in range(12):
+            g = one_chain(30)
+        assert engine.fused_counters["hit"] > hits0, \
+            "stable structure never recovered the fused path"
+        np.testing.assert_allclose(g, np.full(3, 1.5 ** 30, np.float32),
+                                   rtol=1e-5)
+
+    def test_overflow_evicts_fifo_not_clear(self, monkeypatch):
+        monkeypatch.setattr(engine, "_FUSED_CACHE_MAX", 4)
+        monkeypatch.setattr(engine, "_miss_streak", 0)
+        engine._FUSED_CACHE.clear()
+        for n in range(1, 6):              # 5 structures through a 4-cap
+            x = paddle.to_tensor(f32(3), stop_gradient=False)
+            y = x
+            for _ in range(n):
+                y = y * 1.5
+            y.sum().backward()
+        # only the oldest entry was evicted, not the whole cache
+        assert len(engine._FUSED_CACHE) == 4
+
+    def test_flag_registered_default_on(self):
+        assert paddle.get_flags(["FLAGS_fused_backward"])[
+            "FLAGS_fused_backward"] is True
+
+
+class TestDispatchBinder:
+    """The precompiled per-schema argument binder must bind like
+    inspect.Signature.bind did — including its TypeErrors."""
+
+    def test_positional_and_kwargs(self):
+        x = paddle.to_tensor(f32(2, 3))
+        np.testing.assert_allclose(
+            paddle.concat([x, x], axis=1).numpy(),
+            np.concatenate([x.numpy(), x.numpy()], axis=1))
+        np.testing.assert_allclose(
+            paddle.full(shape=[2, 2], fill_value=3.0).numpy(),
+            np.full((2, 2), 3.0, np.float32))
+
+    def test_name_kwarg_accepted_and_ignored(self):
+        x = paddle.to_tensor(f32(3))
+        y = paddle.add(x, x, name="whatever")
+        np.testing.assert_allclose(y.numpy(), x.numpy() * 2)
+
+    def test_unknown_kwarg_raises_typeerror(self):
+        x = paddle.to_tensor(f32(3))
+        with pytest.raises(TypeError):
+            paddle.add(x, x, bogus_kwarg=1)
+
+    def test_duplicate_arg_raises_typeerror(self):
+        x = paddle.to_tensor(f32(3))
+        with pytest.raises(TypeError):
+            paddle.add(x, x, x=x)
+
+    def test_missing_required_raises_typeerror(self):
+        x = paddle.to_tensor(f32(3))
+        with pytest.raises(TypeError):
+            paddle.add(x)
+
+    def test_too_many_positional_raises_typeerror(self):
+        x = paddle.to_tensor(f32(3))
+        with pytest.raises(TypeError):
+            paddle.exp(x, x, x, x, x, x)
+
+
+# fast subset for `pytest -m smoke` pre-commit runs
+pytestmark = pytest.mark.smoke

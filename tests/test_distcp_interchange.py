@@ -146,3 +146,59 @@ class TestConverters:
 
 
 pytestmark = pytest.mark.smoke
+
+
+class TestNumpyGlobalRestriction:
+    """The numpy/ml_dtypes escape hatch is name-scoped: only the ndarray/
+    dtype reconstruction callables resolve, never arbitrary module
+    attributes or dotted attribute walks (ADVICE r5)."""
+
+    def _raw_global(self, module, name):
+        return (b"\x80\x02c" + module.encode() + b"\n" + name.encode()
+                + b"\n.")
+
+    def test_rejects_numpy_module_attributes(self, tmp_path):
+        for mod, name in (("numpy", "load"),
+                          ("numpy.core.multiarray", "frombuffer"),
+                          ("numpy._core.multiarray", "concatenate"),
+                          ("ml_dtypes", "finfo")):
+            p = tmp_path / "m.metadata"
+            p.write_bytes(self._raw_global(mod, name))
+            with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+                dc._unpickle(str(p))
+
+    def test_rejects_dotted_names(self, tmp_path):
+        p = tmp_path / "m.metadata"
+        p.write_bytes(self._raw_global("numpy", "ndarray.tobytes"))
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            dc._unpickle(str(p))
+
+    def test_reconstruction_callables_still_resolve(self, tmp_path):
+        # a normal float32 + bf16 round trip exercises _reconstruct /
+        # ndarray / dtype / (ml_dtypes) bfloat16 through the restricted
+        # reader
+        import ml_dtypes
+        state = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+                 "b": np.ones((2,), ml_dtypes.bfloat16)}
+        dc.save_reference_distcp({"a": state["a"]}, str(tmp_path / "c"))
+        out = dc.load_reference_distcp(str(tmp_path / "c"))
+        np.testing.assert_array_equal(out["a"], state["a"])
+        p = tmp_path / "bf.pkl"
+        with open(p, "wb") as f:
+            pickle.dump(state["b"], f, protocol=4)
+        back = dc._unpickle(str(p))
+        np.testing.assert_array_equal(back.astype(np.float32),
+                                      np.ones(2, np.float32))
+
+    def test_narrow_float_dtypes_still_load(self, tmp_path):
+        # the name-scoped allowlist covers the whole ml_dtypes scalar
+        # family, not just bfloat16 — fp8 checkpoints keep loading
+        import ml_dtypes
+        arr = np.array([0.5, -1.0, 2.0], ml_dtypes.float8_e4m3fn)
+        p = tmp_path / "f8.pkl"
+        with open(p, "wb") as f:
+            pickle.dump(arr, f, protocol=4)
+        back = dc._unpickle(str(p))
+        assert back.dtype == arr.dtype
+        np.testing.assert_array_equal(back.astype(np.float32),
+                                      arr.astype(np.float32))

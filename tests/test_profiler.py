@@ -139,3 +139,31 @@ class TestSchedulerValidation:
         out = gen_summary(evs, row_limit=2)
         # each op is 25% of the total even though only 2 rows display
         assert "25.00" in out
+
+
+class TestBackwardSpans:
+    def test_walk_and_fused_spans_recorded(self, tmp_path):
+        """Both backward paths surface in the profiler: per-node vjp
+        calls as grad::<op> spans, the structure-cached walk as one
+        fused_backward span — all typed Backward."""
+        from paddle_tpu.autograd import engine
+        engine._FUSED_CACHE.clear()   # force priming inside the window
+        engine._miss_streak = 0       # breaker off: suite-order independence
+        got = {}
+        p = Profiler(targets=[ProfilerTarget.CPU],
+                     on_trace_ready=lambda prof: got.update(
+                         result=prof.get_profiler_result()),
+                     trace_dir=str(tmp_path))
+        p.start()
+        for _ in range(3):   # 1st primes (per-node walk), 3rd hits fused
+            x = paddle.to_tensor(np.ones(4, np.float32))
+            x.stop_gradient = False
+            (x * 2.0).sum().backward()
+        p.stop()
+        events = got["result"].events
+        walk = [e for e in events if e.name.startswith("grad::")]
+        fused = [e for e in events if e.name == "fused_backward"]
+        assert walk, "per-node walk produced no grad:: spans"
+        assert fused, "fused walk produced no fused_backward span"
+        for e in walk + fused:
+            assert e.event_type is TracerEventType.Backward

@@ -413,3 +413,346 @@ class TestAdapterTranche2:
         want = np.pad(x, [(0, 0), (0, 0), (0, 0), (0, 0), (1, 1)])
         want = want[:, [1, 0]]
         np.testing.assert_allclose(out, want)
+
+
+class TestSamePaddingAdapters:
+    """padding_algorithm='SAME' must compute pads from input/stride
+    (reference UpdatePaddingAndDilation) instead of silently replaying
+    the explicit [0,0] paddings attr."""
+
+    def _conv_model(self, tmp_path, in_hw, stride, algo, dilations=(1, 1)):
+        rng = np.random.RandomState(3)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.1
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 3) + tuple(in_hw))
+        # weights live in the shared executor scope: a bare "w" would
+        # collide with other suites' parameters (test_static)
+        _var(blk, "same_w", w.dtype, w.shape, persistable=True)
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("conv2d", {"Input": ["x"], "Filter": ["same_w"]},
+              {"Output": ["c"]},
+              {"strides": list(stride), "paddings": [0, 0],
+               "dilations": list(dilations), "groups": 1,
+               "data_format": "NCHW", "padding_algorithm": algo}),
+            O("fetch", {"X": ["c"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        return _write_model(tmp_path, "same_conv", blk, {"same_w": w}), w
+
+    def test_conv_same_symmetric(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix, w = self._conv_model(tmp_path, (7, 7), (2, 2), "SAME")
+        pred = I.create_predictor(I.Config(prefix))
+        x = np.random.RandomState(5).randn(2, 3, 7, 7).astype(np.float32)
+        out = pred.run([x])[0]
+        # out = ceil(in/stride): total pad 2 -> (1,1) per dim
+        want = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (2, 2), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        assert out.shape == (2, 4, 4, 4)
+        np.testing.assert_allclose(out, np.asarray(want), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_conv_same_asymmetric_and_dilation_reset(self, tmp_path):
+        from paddle_tpu import inference as I
+        # in 8, k 3, s 2 -> out 4, total pad 1 -> (0,1); a dilations attr
+        # is reset to 1 under SAME (reference UpdatePaddingAndDilation)
+        prefix, w = self._conv_model(tmp_path, (8, 8), (2, 2), "SAME",
+                                     dilations=(2, 2))
+        pred = I.create_predictor(I.Config(prefix))
+        x = np.random.RandomState(6).randn(1, 3, 8, 8).astype(np.float32)
+        out = pred.run([x])[0]
+        want = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (2, 2), [(0, 1), (0, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        assert out.shape == (1, 4, 4, 4)
+        np.testing.assert_allclose(out, np.asarray(want), rtol=1e-4,
+                                   atol=1e-5)
+
+    def _pool_model(self, tmp_path, in_hw, ksize, stride):
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 2) + tuple(in_hw))
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("pool2d", {"X": ["x"]}, {"Out": ["p"]},
+              {"ksize": list(ksize), "strides": list(stride),
+               "paddings": [0, 0], "pooling_type": "max",
+               "ceil_mode": False, "exclusive": True, "adaptive": False,
+               "global_pooling": False, "data_format": "NCHW",
+               "padding_algorithm": "SAME"}),
+            O("fetch", {"X": ["p"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        return _write_model(tmp_path, "same_pool", blk, {})
+
+    def test_pool_same_symmetric(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix = self._pool_model(tmp_path, (7, 7), (3, 3), (2, 2))
+        pred = I.create_predictor(I.Config(prefix))
+        x = np.random.RandomState(8).randn(2, 2, 7, 7).astype(np.float32)
+        out = pred.run([x])[0]
+        want = jax.lax.reduce_window(
+            jnp.asarray(x), -jnp.inf, jax.lax.max, (1, 1, 3, 3),
+            (1, 1, 2, 2), [(0, 0), (0, 0), (1, 1), (1, 1)])
+        assert out.shape == (2, 2, 4, 4)
+        np.testing.assert_allclose(out, np.asarray(want), rtol=1e-5)
+
+    def test_pool_same_asymmetric_raises(self, tmp_path):
+        from paddle_tpu import inference as I
+        # in 8, k 3, s 2 -> total pad 1 -> (0,1): the pool kernel only
+        # takes symmetric pads, so this must fail loudly
+        prefix = self._pool_model(tmp_path, (8, 8), (3, 3), (2, 2))
+        with pytest.raises(NotImplementedError, match="asymmetric"):
+            I.create_predictor(I.Config(prefix))
+
+
+class TestDynamicFeedReshapeGuards:
+    """squeeze2 axes=[] / unsqueeze2 at axis 0 under a dynamic feed dim
+    must raise instead of baking a batch-of-1 reshape (ADVICE r5)."""
+
+    def _model(self, tmp_path, op, dynamic=True, **attrs):
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", ((-1 if dynamic else 1), 1, 4))
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O(op, {"X": ["x"]}, {"Out": ["y"]}, attrs),
+            O("fetch", {"X": ["y"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        return _write_model(tmp_path, op, blk, {})
+
+    def test_squeeze_empty_axes_dynamic_raises(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix = self._model(tmp_path, "squeeze2", axes=[])
+        with pytest.raises(NotImplementedError, match="axes"):
+            I.create_predictor(I.Config(prefix))
+
+    def test_squeeze_explicit_axes_dynamic_ok(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix = self._model(tmp_path, "squeeze2", axes=[1])
+        pred = I.create_predictor(I.Config(prefix))
+        for b in (1, 3):
+            x = np.random.RandomState(b).randn(b, 1, 4).astype(np.float32)
+            out = pred.run([x])[0]
+            np.testing.assert_allclose(out, x[:, 0, :])
+
+    def test_squeeze_empty_axes_static_ok(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix = self._model(tmp_path, "squeeze2", dynamic=False, axes=[])
+        pred = I.create_predictor(I.Config(prefix))
+        x = np.random.RandomState(0).randn(1, 1, 4).astype(np.float32)
+        np.testing.assert_allclose(pred.run([x])[0], x[0, 0, :])
+
+    def test_unsqueeze_axis0_dynamic_raises(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix = self._model(tmp_path, "unsqueeze2", axes=[0])
+        with pytest.raises(NotImplementedError, match="axis 0"):
+            I.create_predictor(I.Config(prefix))
+
+    def test_unsqueeze_inner_axis_dynamic_ok(self, tmp_path):
+        from paddle_tpu import inference as I
+        prefix = self._model(tmp_path, "unsqueeze2", axes=[2])
+        pred = I.create_predictor(I.Config(prefix))
+        for b in (1, 2):
+            x = np.random.RandomState(b).randn(b, 1, 4).astype(np.float32)
+            out = pred.run([x])[0]
+            np.testing.assert_allclose(out, x[:, :, None, :])
+
+    def test_unsqueeze_negative_axes_given_order(self, tmp_path):
+        # review regression: reference GetUnsqueezeShape applies axes in
+        # GIVEN order, each negative axis resolved against the grown
+        # rank — axes=[1, -5] on rank 3 means insert at 1, then at 0
+        # (-5 + 4 + 1); a sorted-order adapter resolves -5 to the end
+        from paddle_tpu import inference as I
+        prefix = self._model(tmp_path, "unsqueeze2", dynamic=False,
+                             axes=[1, -5])
+        pred = I.create_predictor(I.Config(prefix))
+        x = np.random.RandomState(0).randn(1, 1, 4).astype(np.float32)
+        out = pred.run([x])[0]
+        assert out.shape == (1, 1, 1, 1, 4)
+        np.testing.assert_allclose(out, x[None, :, None, :, :])
+
+    def test_unsqueeze_negative_axis0_dynamic_raises(self, tmp_path):
+        # the axis-0 bake guard must catch negative axes that RESOLVE to
+        # 0 mid-list, not just literal 0 / -(ndim+1)
+        from paddle_tpu import inference as I
+        prefix = self._model(tmp_path, "unsqueeze2", axes=[1, -5])
+        with pytest.raises(NotImplementedError, match="axis 0"):
+            I.create_predictor(I.Config(prefix))
+
+    def test_squeeze_static_tensor_with_dynamic_feed_elsewhere_ok(
+            self, tmp_path):
+        # review regression: the guard must key on the SQUEEZED tensor
+        # deriving from a dynamic feed, not on any dynamic feed existing
+        from paddle_tpu import inference as I
+        w = np.random.RandomState(0).randn(1, 1, 4).astype(np.float32)
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 4))          # dynamic feed, unused
+        _var(blk, "w", w.dtype, w.shape, persistable=True)
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("squeeze2", {"X": ["w"]}, {"Out": ["sq"]}, {"axes": []}),
+            O("elementwise_add", {"X": ["x"], "Y": ["sq"]},
+              {"Out": ["y"]}, {"axis": -1}),
+            O("fetch", {"X": ["y"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "sq_static", blk, {"w": w})
+        pred = I.create_predictor(I.Config(prefix))
+        for b in (1, 3):
+            x = np.random.RandomState(b).randn(b, 4).astype(np.float32)
+            np.testing.assert_allclose(pred.run([x])[0], x + w[0, 0],
+                                       rtol=1e-6)
+
+    def test_taint_propagates_through_ops(self, tmp_path):
+        # squeeze2 axes=[] two ops downstream of the dynamic feed must
+        # still raise: taint follows dataflow, not just direct inputs
+        from paddle_tpu import inference as I
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 1, 4))
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("relu", {"X": ["x"]}, {"Out": ["r"]}, {}),
+            O("squeeze2", {"X": ["r"]}, {"Out": ["y"]}, {"axes": []}),
+            O("fetch", {"X": ["y"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "sq_taint", blk, {})
+        with pytest.raises(NotImplementedError, match="axes"):
+            I.create_predictor(I.Config(prefix))
+
+
+class TestSameWithDynamicSpatial:
+    def test_conv_same_dynamic_spatial_raises(self, tmp_path):
+        # review regression: SAME pads computed from placeholder-1
+        # spatial dims would be silently wrong — must raise instead
+        from paddle_tpu import inference as I
+        w = np.random.RandomState(0).randn(4, 3, 3, 3).astype(np.float32)
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 3, -1, -1))
+        _var(blk, "w", w.dtype, w.shape, persistable=True)
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("conv2d", {"Input": ["x"], "Filter": ["w"]},
+              {"Output": ["c"]},
+              {"strides": [2, 2], "paddings": [0, 0],
+               "dilations": [1, 1], "groups": 1, "data_format": "NCHW",
+               "padding_algorithm": "SAME"}),
+            O("fetch", {"X": ["c"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "same_dyn", blk, {"w": w})
+        with pytest.raises(NotImplementedError, match="dynamic spatial"):
+            I.create_predictor(I.Config(prefix))
+
+    def test_conv_same_dynamic_batch_only_ok(self, tmp_path):
+        # a dynamic BATCH dim leaves spatial sizes static: SAME stays
+        # translatable and replays at any batch
+        from paddle_tpu import inference as I
+        w = np.random.RandomState(0).randn(4, 3, 3, 3).astype(np.float32)
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 3, 7, 7))
+        _var(blk, "w", w.dtype, w.shape, persistable=True)
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("conv2d", {"Input": ["x"], "Filter": ["w"]},
+              {"Output": ["c"]},
+              {"strides": [2, 2], "paddings": [0, 0],
+               "dilations": [1, 1], "groups": 1, "data_format": "NCHW",
+               "padding_algorithm": "SAME"}),
+            O("fetch", {"X": ["c"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "same_dynb", blk, {"w": w})
+        pred = I.create_predictor(I.Config(prefix))
+        for b in (1, 2):
+            x = np.random.RandomState(b).randn(b, 3, 7, 7).astype(
+                np.float32)
+            want = jax.lax.conv_general_dilated(
+                jnp.asarray(x), jnp.asarray(w), (2, 2), [(1, 1), (1, 1)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            np.testing.assert_allclose(pred.run([x])[0],
+                                       np.asarray(want), rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_spatially_dynamic_feed_elsewhere_does_not_poison(
+            self, tmp_path):
+        # review regression: the dynamic-spatial guard keys on the conv
+        # input's OWN provenance — an unrelated feed with dynamic H/W
+        # must not block SAME on a branch whose spatial dims are static
+        from paddle_tpu import inference as I
+        w = np.random.RandomState(0).randn(4, 3, 3, 3).astype(np.float32)
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 3, 7, 7))     # dynamic batch only
+        _var(blk, "z", "float32", (-1, 3, -1, -1))   # dynamic spatial
+        _var(blk, "w", w.dtype, w.shape, persistable=True)
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("feed", {"X": ["feed"]}, {"Out": ["z"]}, {"col": 1}),
+            O("conv2d", {"Input": ["x"], "Filter": ["w"]},
+              {"Output": ["c"]},
+              {"strides": [2, 2], "paddings": [0, 0],
+               "dilations": [1, 1], "groups": 1, "data_format": "NCHW",
+               "padding_algorithm": "SAME"}),
+            O("relu", {"X": ["z"]}, {"Out": ["zr"]}),
+            O("fetch", {"X": ["c"]}, {"Out": ["fetch"]}, {"col": 0}),
+            O("fetch", {"X": ["zr"]}, {"Out": ["fetch"]}, {"col": 1}),
+        ]
+        prefix = _write_model(tmp_path, "same_poison", blk, {"w": w})
+        pred = I.create_predictor(I.Config(prefix))
+        x = np.random.RandomState(1).randn(2, 3, 7, 7).astype(np.float32)
+        z = np.random.RandomState(2).randn(2, 3, 5, 5).astype(np.float32)
+        want = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (2, 2), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        c, zr = pred.run([x, z])
+        np.testing.assert_allclose(c, np.asarray(want), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(zr, np.maximum(z, 0.0))
+
+    def test_squeeze_dynamic_nonbatch_dim_raises(self, tmp_path):
+        # review regression: a dynamic NON-batch dim records as a
+        # placeholder 1 that axes=[] would squeeze (and any baked
+        # reshape would freeze) — must raise at translate time, not
+        # TypeError at run time
+        from paddle_tpu import inference as I
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (3, -1, 4))
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("squeeze2", {"X": ["x"]}, {"Out": ["sq"]}, {"axes": []}),
+            O("fetch", {"X": ["sq"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "sq_dynmid", blk, {})
+        with pytest.raises(NotImplementedError, match="non-batch"):
+            I.create_predictor(I.Config(prefix))
+
+    def test_squeeze_explicit_axis0_dynamic_batch_raises(self, tmp_path):
+        # review regression: axes=[0] names the recorded-as-1 dynamic
+        # batch explicitly — same bake hazard as axes=[]
+        from paddle_tpu import inference as I
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (-1, 1, 4))
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("squeeze2", {"X": ["x"]}, {"Out": ["sq"]}, {"axes": [0]}),
+            O("fetch", {"X": ["sq"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "sq_ax0", blk, {})
+        with pytest.raises(NotImplementedError, match="batch"):
+            I.create_predictor(I.Config(prefix))
+
+    def test_pool_same_anylayout_normalized(self, tmp_path):
+        # review regression: pool2d must normalize AnyLayout -> NCHW
+        # like conv does, or SAME pads compute from channel dims
+        from paddle_tpu import inference as I
+        blk = M.BlockDescLite()
+        _var(blk, "x", "float32", (1, 2, 6, 6))
+        blk.ops = [
+            O("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0}),
+            O("pool2d", {"X": ["x"]}, {"Out": ["p"]},
+              {"ksize": [3, 3], "strides": [3, 3], "paddings": [0, 0],
+               "pooling_type": "max", "data_format": "AnyLayout",
+               "padding_algorithm": "SAME"}),
+            O("fetch", {"X": ["p"]}, {"Out": ["fetch"]}, {"col": 0}),
+        ]
+        prefix = _write_model(tmp_path, "pool_anyl", blk, {})
+        pred = I.create_predictor(I.Config(prefix))
+        x = np.random.RandomState(0).randn(1, 2, 6, 6).astype(np.float32)
+        # 6/3 = 2 exactly: SAME pads are zero, NCHW max-pool 3x3/3
+        want = x.reshape(1, 2, 2, 3, 2, 3).max(axis=(3, 5))
+        np.testing.assert_allclose(pred.run([x])[0], want, rtol=1e-6)
