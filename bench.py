@@ -1207,6 +1207,80 @@ def bench_dispatch(on_tpu: bool):
     }, backward_metric]
 
 
+def bench_observability(on_tpu: bool):
+    """Disabled-path cost of the always-on instrumentation (ISSUE 3
+    acceptance: dispatch overhead from observability with the flight
+    recorder off and no Profiler open must stay <= 1us/op), plus the
+    enabled-path (flight recorder on) cost for the record."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+
+    x = Tensor(jnp.asarray(np.ones((8, 8), np.float32)))
+    chain, reps, rounds = 50, 20, 5
+
+    def run():
+        y = x
+        for _ in range(chain):
+            y = y * 1.0001 + 0.0
+        return y._data
+
+    def one_pass():
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = run()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / (reps * chain * 2) * 1e6
+
+    # the three settings, measured INTERLEAVED round-robin with best-of-N
+    # per setting: the deltas are sub-us while host load drifts by whole
+    # us over seconds, so consecutive blocks would measure the drift, not
+    # the instrumentation (observed: flight-recorder-on reading FASTER
+    # than off in sequential blocks)
+    settings = [
+        # all instrumentation short-circuited: the no-op fast path
+        {"FLAGS_metrics": False, "FLAGS_flight_recorder": False},
+        # production default: always-on counters, flight recorder off
+        {"FLAGS_metrics": True, "FLAGS_flight_recorder": False},
+        # full post-mortem record: counters + ring writes per dispatch
+        {"FLAGS_metrics": True, "FLAGS_flight_recorder": True},
+    ]
+    saved = paddle.get_flags(["FLAGS_metrics", "FLAGS_flight_recorder"])
+    best = [float("inf")] * len(settings)
+    try:
+        jax.block_until_ready(run())   # warm per-op exec caches
+        import gc
+        for _ in range(rounds):
+            for i, flags_ in enumerate(settings):
+                paddle.set_flags(flags_)
+                gc.collect()
+                best[i] = min(best[i], one_pass())
+    finally:
+        paddle.set_flags(saved)
+    t_off, t_counters, t_full = best
+
+    disabled_us = max(t_counters - t_off, 0.0)
+    enabled_us = max(t_full - t_off, 0.0)
+    return {
+        "metric": "observability_overhead_us_per_op",
+        "value": round(disabled_us, 3),
+        "unit": "us/op",
+        # >= 1.0 means the counters cost <= the 1us/op budget
+        "vs_baseline": round(min(1.0 / max(disabled_us, 0.001), 100.0), 4),
+        "detail": {
+            "disabled_path_ns_per_op": round(disabled_us * 1e3, 1),
+            "enabled_path_us_per_op": round(enabled_us, 3),
+            "eager_us_per_op_no_instrumentation": round(t_off, 2),
+            "eager_us_per_op_counters": round(t_counters, 2),
+            "eager_us_per_op_flight_recorder": round(t_full, 2),
+            "baseline": "1us/op instrumentation budget with "
+                        "FLAGS_flight_recorder off (ISSUE 3 acceptance); "
+                        "disabled = FLAGS_metrics off too, i.e. the flag-"
+                        "read-only fast path",
+        },
+    }
+
+
 def _rescue_headline(headline, merged_cfgs):
     """Never report 0.0 while a companion MFU geometry succeeded
     (VERDICT r4 Weak#1): promote the best successful llama companion."""
@@ -1329,7 +1403,7 @@ def main():
     which = os.environ.get(
         "PTPU_BENCH_CONFIGS",
         "llama,llamapeak,llama4k,llamalong,resnet,bert,ocr,moe,serving,"
-        "cbatch,aot,micro,dispatch")
+        "cbatch,aot,micro,dispatch,observability")
     which = [w.strip() for w in which.split(",") if w.strip()]
     if (on_tpu and len(which) > 1
             and os.environ.get("PTPU_BENCH_ISOLATED", "1") != "0"):
@@ -1426,6 +1500,9 @@ def main():
         configs.extend(disp)
     elif disp:
         configs.append(disp)
+    obs = guard("observability", bench_observability, on_tpu)
+    if obs:
+        configs.append(obs)
 
     mfu = llama["mfu"] if llama else 0.0
     print(json.dumps({

@@ -121,6 +121,7 @@ from .hapi import Model, summary  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401
 from .nn.layer_base import Parameter  # noqa: E402,F401
 from . import ops  # noqa: E402,F401
+from . import observability  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from .static import enable_static, disable_static  # noqa: E402,F401

@@ -24,12 +24,14 @@ import contextlib
 import functools
 import heapq
 import sys
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _obs_metrics
 
 # -- grad mode ----------------------------------------------------------------
 
@@ -269,6 +271,27 @@ _probe_tick = 0
 fused_counters = {"primed": 0, "hit": 0, "fallback": 0, "compile": 0,
                   "bypass": 0}
 
+# observability (observability/): the fused counters above stay the
+# authoritative store (tests snapshot the dict) and are PUBLISHED as
+# callback gauges — zero extra hot-path writes; plan/exec wall time go
+# to always-on histograms read by Prometheus dumps and the profiler's
+# Metrics section.
+_M_BACKWARD = _obs_metrics.registry().counter(
+    "autograd.backward.count", "backward() reverse walks")
+_H_FUSED_PLAN = _obs_metrics.registry().histogram(
+    "autograd.fused.plan_seconds",
+    "fused-backward structural planning wall time")
+_H_FUSED_EXEC = _obs_metrics.registry().histogram(
+    "autograd.fused.exec_seconds",
+    "fused-backward executable host dispatch time (async backends "
+    "return before the device finishes; device time needs the profiler)")
+for _k in ("primed", "hit", "fallback", "compile", "bypass"):
+    _obs_metrics.registry().gauge(
+        "autograd.fused." + _k,
+        fn=lambda _k=_k: float(fused_counters[_k]),
+        help=f"fused-backward '{_k}' events (engine.fused_counters)")
+del _k
+
 
 def _fused_enabled() -> bool:
     global _F_FUSED
@@ -488,7 +511,9 @@ def _fused_backward(tensors, grad_tensors, retain_graph,
         if _probe_tick % _PROBE_EVERY:
             fused_counters["bypass"] += 1
             return False
+    t_plan = time.perf_counter()
     plan = _plan_fused(tensors, grad_tensors)
+    _H_FUSED_PLAN.observe(time.perf_counter() - t_plan)
     if plan is None:
         # permanently-unfusable tapes (leaf hooks, sot/to_static nodes
         # recorded without a vjp_key) must feed the breaker too, or a
@@ -529,11 +554,13 @@ def _fused_backward(tensors, grad_tensors, retain_graph,
     # primals as a tuple — no per-node re-tupling needed
     prims = tuple([n.primals for n in plan.nodes])
     hook = _op_span_hook_ref()
+    t_exec = time.perf_counter()
     if hook is not None:
         with hook("fused_backward"):
             results = entry(prims, plan.ext_seeds)
     else:
         results = entry(prims, plan.ext_seeds)
+    _H_FUSED_EXEC.observe(time.perf_counter() - t_exec)
     for t, g in zip(plan.leaf_tensors, results):
         if accumulate_ids is not None and id(t) not in accumulate_ids:
             continue
@@ -558,6 +585,7 @@ def backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]]
     `capture`: non-leaf tensors whose fully-accumulated cotangent should be
     deposited into their .grad too (functional grad() with intermediate
     inputs — the walk normally flows THROUGH non-leaves without storing)."""
+    _M_BACKWARD.inc()
     if not create_graph and not capture and _fused_enabled():
         if _fused_backward(tensors, grad_tensors, retain_graph,
                            accumulate_ids):

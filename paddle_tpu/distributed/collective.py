@@ -21,9 +21,15 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
+from ..jax_compat import is_distributed_initialized, shard_map
 from .. import flags
+from ..observability import metrics as _obs_metrics
 from . import env
 from .topology import get_hybrid_communicate_group
+
+_M_COLLECTIVES = _obs_metrics.registry().counter(
+    "distributed.collective_calls",
+    "eager collective API calls (watchdog-bracketed)")
 
 
 def _watched(fn):
@@ -34,6 +40,7 @@ def _watched(fn):
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         from .watchdog import comm_watchdog
+        _M_COLLECTIVES.inc()
         mgr = comm_watchdog()
         with mgr.start_task(f"eager:{fn.__name__}",
                             timeout_s=float(flags.get_flag("comm_timeout_s")),
@@ -105,7 +112,7 @@ def init_parallel_env() -> ParallelEnv:
     import os
 
     world = env.get_world_size()
-    if world > 1 and not jax.distributed.is_initialized():
+    if world > 1 and not is_distributed_initialized():
         coordinator = os.environ.get("PADDLE_DIST_COORDINATOR") \
             or os.environ.get("PADDLE_MASTER")
         if not coordinator:
@@ -196,7 +203,7 @@ def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group=None,
     in_spec = sh.spec
     out_spec = PartitionSpec(*[
         _strip_axis(e, target) for e in _pad_spec(in_spec, tensor.ndim)])
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda x: reducer(x, target), mesh=mesh,
         in_specs=(in_spec,), out_specs=out_spec))
     out = fn(tensor._data)
@@ -346,7 +353,7 @@ _P2P_EXEC_CACHE: dict = {}
 
 
 def _cross_host_active() -> bool:
-    return jax.distributed.is_initialized() and jax.process_count() > 1
+    return is_distributed_initialized() and jax.process_count() > 1
 
 
 def _pair_permute(arr, my_rank: int, src: int, dst: int):
@@ -369,7 +376,7 @@ def _pair_permute(arr, my_rank: int, src: int, dst: int):
         def shift(x):
             return jax.lax.ppermute(x, "p2p", [(0, 1)])
 
-        fn = jax.jit(jax.shard_map(shift, mesh=mesh, in_specs=P("p2p"),
+        fn = jax.jit(shard_map(shift, mesh=mesh, in_specs=P("p2p"),
                                    out_specs=P("p2p")))
         _P2P_EXEC_CACHE[key] = fn
     local = jnp.asarray(arr)[None]

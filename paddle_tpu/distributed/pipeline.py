@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..jax_compat import shard_map
+
 
 _ENGINE_CACHE: dict = {}
 
@@ -133,7 +135,7 @@ def _build_engine(block_apply, mesh, S, M, remat):
             jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), "pp")
         return outs
 
-    smapped = jax.shard_map(
+    smapped = shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(P("pp"), P(), P(), P()),
@@ -335,7 +337,7 @@ def _build_vpp_engine(block_apply, mesh, S, M, v, remat):
             jnp.where(stage == last, outs, jnp.zeros_like(outs)), "pp")
         return outs
 
-    smapped = jax.shard_map(
+    smapped = shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(P(None, "pp"), P(), P(), P()),

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..jax_compat import shard_map
+
 IDLE, FWD, BWD = 0, 1, 2
 SCHEDULES = ("FThenB", "1F1B", "Eager1F1B")
 
@@ -312,7 +314,7 @@ def pipeline_train_tables(block_apply: Callable,
         loss = jax.lax.psum(jnp.where(stage == S - 1, loss, 0.0), "pp") / M
         return (loss,) + grads
 
-    smapped = jax.shard_map(
+    smapped = shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(P("pp"), P(), P(), P()),

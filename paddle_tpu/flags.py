@@ -29,6 +29,16 @@ class _Flag:
 
 _REGISTRY: Dict[str, _Flag] = {}
 _NATIVE = None  # ctypes lib once paddle_tpu.native loads
+# per-flag mutation callbacks: fn(new_value) after set_flags commits —
+# for components that materialize a flag's value at import time (e.g.
+# the flight recorder ring sized by FLAGS_flight_recorder_size)
+_ON_SET: Dict[str, list] = {}
+
+
+def on_set(name: str, fn: Callable[[Any], None]) -> None:
+    """Register a callback invoked with the new value whenever `name`
+    is mutated via set_flags."""
+    _ON_SET.setdefault(name.removeprefix("FLAGS_"), []).append(fn)
 
 
 def _mirror_one(lib, f: "_Flag") -> None:
@@ -109,6 +119,8 @@ def set_flags(flags: Dict[str, Any]) -> None:
             f.value = f.ctype(v)
         if _NATIVE is not None:
             _NATIVE.PT_SetFlag(k.encode(), str(f.value).encode())
+        for cb in _ON_SET.get(k, ()):
+            cb(f.value)
     _refingerprint()
 
 
@@ -132,6 +144,20 @@ define_flag("eager_loop_warn_ops", 200000,
             "a long-running eager loop is launch-bound (~18us/op on "
             "tunneled devices) and should compile its step via "
             "jit.TrainStep / to_static")
+define_flag("metrics", True,
+            "process-wide metrics registry (observability/): always-on "
+            "counters/gauges/histograms on the dispatch, autograd, executor "
+            "and collective hot paths; False short-circuits every "
+            "increment to a flag read")
+define_flag("flight_recorder", True,
+            "always-on flight recorder: bounded ring buffer of the last N "
+            "op dispatches (op, shapes/dtypes, exec-cache key, thread), "
+            "dumped to stderr/file on uncaught exception or explicit "
+            "observability.dump_flight_recorder()")
+define_flag("flight_recorder_size", 256,
+            "flight recorder ring capacity (op dispatches)")
+define_flag("flight_recorder_path", "",
+            "crash-dump destination for the flight recorder; empty = stderr")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")
 define_flag("rng_impl", "rbg",

@@ -1,0 +1,107 @@
+"""paddle_tpu.observability — always-on runtime metrics + flight recorder.
+
+The opt-in span tracing in ``paddle_tpu.profiler`` answers "how long did
+this step take?" when a Profiler is open; this package answers "what has
+the process been doing?" at ALL times, at near-zero cost:
+
+* a process-wide **metrics registry** (:mod:`.metrics`) of counters,
+  gauges and timing histograms — thread-safe, <1µs per increment, one
+  flag read when disabled (``FLAGS_metrics=False``) — with JSON
+  (:func:`dump_json`) and Prometheus-text (:func:`dump_prometheus`)
+  dumpers;
+* an **always-on flight recorder** (:mod:`.flight_recorder`) — a bounded
+  ring of the last N op dispatches (op name, input shapes/dtypes,
+  exec-cache key, thread) that dumps on uncaught exception or explicit
+  :func:`dump_flight_recorder`, gated by ``FLAGS_flight_recorder``.
+
+Instrumented layers and their STABLE metric names (tests pin these):
+
+====================================  =========  ==============================
+name                                  type       source
+====================================  =========  ==============================
+``dispatch.count``                    counter    every eager op dispatch
+                                                 (ops/dispatcher.py, incl. the
+                                                 dunder binary fast path)
+``dispatch.bind_fast``                counter    precompiled-binder bindings
+``dispatch.bind_slow``                counter    inspect.Signature.bind
+                                                 fallbacks
+``dispatch.exec_cache.hits``          gauge      per-op XLA exec cache
+``dispatch.exec_cache.misses``        gauge      (``_get_exec.cache_info()``,
+``dispatch.exec_cache.size``          gauge      read at snapshot time)
+``autograd.backward.count``           counter    backward() walks
+``autograd.fused.primed``             gauge      structure-cache first sights
+``autograd.fused.hit``                gauge      fused single-executable walks
+``autograd.fused.fallback``           gauge      walks refused by the planner
+``autograd.fused.compile``            gauge      fused-runner jit builds
+``autograd.fused.bypass``             gauge      miss-streak-breaker bypasses
+``autograd.fused.plan_seconds``       histogram  fused-walk planning wall time
+``autograd.fused.exec_seconds``       histogram  fused executable host
+                                                 dispatch time (async launch)
+``executor.runs``                     counter    static Executor.run calls
+``executor.compiles``                 counter    executor cache misses
+``executor.scope_vars``               gauge      global scope size
+``distributed.collective_calls``      counter    eager collective API calls
+``jit.compiles``                      counter    XLA backend compiles
+``jit.compile_seconds``               histogram  (via jax.monitoring hooks)
+``device.live_array_bytes``           gauge      ``jax.live_arrays()`` bytes
+``device.live_arrays``                gauge      live array count
+``device.count``                      gauge      visible devices
+====================================  =========  ==============================
+
+Profiler integration: when a ``paddle_tpu.profiler.Profiler`` window
+closes, a registry snapshot is attached to the result — exported into
+the chrome trace as ``"ph": "C"`` counter events and rendered as a
+``Metrics`` section by ``Profiler.summary()``.
+
+Typical use::
+
+    import paddle_tpu.observability as obs
+
+    obs.registry().counter("my.counter").inc()
+    print(obs.dump_prometheus())          # scrape-able text
+    obs.dump_flight_recorder()            # last-N dispatches to stderr
+"""
+
+from __future__ import annotations
+
+from . import flight_recorder, metrics  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    dump as dump_flight_recorder,
+    install_excepthook,
+    recorder as flight_recorder_instance,
+)
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    format_metrics,
+    registry,
+)
+
+
+def snapshot():
+    """Point-in-time dict view of every registered metric."""
+    return metrics.registry().snapshot()
+
+
+def dump_json(indent=None) -> str:
+    """Registry snapshot as a JSON string."""
+    return metrics.registry().dump_json(indent=indent)
+
+
+def dump_prometheus() -> str:
+    """Registry snapshot in Prometheus text exposition format."""
+    return metrics.registry().dump_prometheus()
+
+
+# the crash dump must work without any user setup: chain it now
+install_excepthook()
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "FlightRecorder",
+    "registry", "snapshot", "dump_json", "dump_prometheus",
+    "format_metrics", "flight_recorder_instance", "dump_flight_recorder",
+    "install_excepthook", "metrics", "flight_recorder",
+]

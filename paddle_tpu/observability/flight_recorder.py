@@ -1,0 +1,225 @@
+"""Always-on flight recorder: post-mortem record of recent op dispatches.
+
+A bounded ring buffer holds the last N eager dispatches — op name, input
+shapes/dtypes, exec-cache key and recording thread — so a crash report
+answers "what was the process doing?" without a profiler attached (the
+HostEventRecorder-as-black-box role the reference's C++ recorder plays,
+paddle/fluid/platform/profiler/host_event_recorder.h).
+
+Recording is gated by ``FLAGS_flight_recorder`` (default ON) and costs
+one ring-slot assignment per dispatch; the gate itself is a single flag
+read, keeping the disabled path inside the 1µs/op instrumentation
+budget. The ring dumps
+
+* automatically on an uncaught exception (a chained ``sys.excepthook``
+  installed at import, writing to ``FLAGS_flight_recorder_path`` or
+  stderr), and
+* explicitly via :meth:`FlightRecorder.dump`.
+
+Entries are recorded BEFORE the kernel runs, so the op that raised is
+the newest entry in the dump.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, IO, List, Optional, Tuple
+
+from .. import flags as _flags
+
+_F_ENABLED = _flags._REGISTRY["flight_recorder"]
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of dispatch records.
+
+    The hot-path :meth:`record` is intentionally lock-free: under the
+    GIL a slot assignment is atomic, and a (rare) racing pair of
+    threads can at worst interleave sequence numbers — acceptable for a
+    post-mortem aid, and ~3x cheaper than taking a lock per dispatch.
+    """
+
+    __slots__ = ("_ring", "_cap", "_i")
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"flight recorder capacity must be >=1, "
+                             f"got {capacity}")
+        self._ring: List[Optional[tuple]] = [None] * capacity
+        self._cap = capacity
+        self._i = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def total_recorded(self) -> int:
+        return self._i
+
+    def record(self, op_name: str, args_info: tuple,
+               cache_key: Any = None) -> None:
+        """Hot path: one tuple build + one ring-slot assignment.
+
+        Indexes a LOCAL snapshot of the ring by its own length (not
+        ``self._cap``) so a concurrent :meth:`resize` — which swaps
+        ``_ring`` and ``_cap`` in two steps — can never produce an
+        out-of-bounds slot."""
+        i = self._i
+        self._i = i + 1
+        ring = self._ring
+        ring[i % len(ring)] = (
+            i, time.time(), threading.get_ident(), op_name, args_info,
+            cache_key)
+
+    def entries(self) -> List[tuple]:
+        """Recorded entries, oldest (lowest sequence number) first.
+
+        Entry: ``(seq, unix_time, thread_ident, op_name, args_info,
+        cache_key)`` where ``args_info`` is a tuple of per-input
+        ``(shape, dtype)`` pairs (or a bare marker for non-array args).
+        Sorting by the per-entry sequence number (instead of inferring
+        order from the write index) stays correct across :meth:`resize`
+        and racing writer threads.
+        """
+        return sorted((e for e in self._ring if e is not None),
+                      key=lambda e: e[0])
+
+    def clear(self) -> None:
+        self._ring = [None] * self._cap
+        self._i = 0
+
+    def resize(self, capacity: int) -> None:
+        """Re-pack the newest entries into a ring of the new capacity.
+
+        Kept entries retain their sequence numbers; the write index is
+        advanced to the first value past the newest kept sequence whose
+        ring slot lands just after the kept block, so future writes
+        evict oldest-first (sequence numbers may skip, never repeat)."""
+        if capacity < 1:
+            raise ValueError(f"flight recorder capacity must be >=1, "
+                             f"got {capacity}")
+        kept = self.entries()[-capacity:]
+        ring: List[Optional[tuple]] = [None] * capacity
+        ring[:len(kept)] = kept
+        base = (kept[-1][0] + 1) if kept else 0
+        self._ring = ring
+        self._cap = capacity
+        self._i = base + (len(kept) - base) % capacity
+
+    def dump(self, file: Optional[IO[str]] = None) -> List[tuple]:
+        """Write a human-readable dump (stderr by default); returns the
+        entries so callers can post-process."""
+        f = file if file is not None else sys.stderr
+        ents = self.entries()
+        n = len(ents)
+        f.write(f"[paddle_tpu flight recorder] last {n} of "
+                f"{self._i} op dispatches (newest last):\n")
+        for seq, ts, tid, op, args_info, key in ents:
+            args_s = ", ".join(_fmt_arg(a) for a in args_info) \
+                if args_info else "-"
+            key_s = "" if key is None else f" key={_fmt_key(key)}"
+            f.write(f"  #{seq} t={ts:.6f} thread={tid} op={op} "
+                    f"args=({args_s}){key_s}\n")
+        f.flush()
+        return ents
+
+
+def _fmt_arg(a) -> str:
+    if isinstance(a, tuple) and len(a) == 2:
+        shape, dtype = a
+        if isinstance(shape, tuple):
+            dims = "x".join(map(str, shape)) if shape else "scalar"
+            return f"{dims}:{dtype}"
+    return str(a)
+
+
+def _fmt_key(key, limit: int = 120) -> str:
+    s = repr(key)
+    return s if len(s) <= limit else s[:limit - 3] + "..."
+
+
+# -- process-wide recorder ----------------------------------------------------
+
+_RECORDER: Optional[FlightRecorder] = None
+_LOCK = threading.Lock()
+
+
+def recorder() -> FlightRecorder:
+    """The process-wide ring, created on first use with
+    ``FLAGS_flight_recorder_size`` slots (a later ``set_flags`` on that
+    flag resizes the live ring in place)."""
+    global _RECORDER
+    r = _RECORDER
+    if r is None:
+        with _LOCK:
+            r = _RECORDER
+            if r is None:
+                cap = max(1, int(_flags.get_flag("flight_recorder_size")))
+                r = _RECORDER = FlightRecorder(cap)
+    return r
+
+
+def _on_size_flag(value) -> None:
+    # the dispatcher holds a direct reference to the singleton, so the
+    # ring must be resized IN PLACE for the new capacity to take effect
+    rec = _RECORDER
+    if rec is not None and rec.capacity != max(1, int(value)):
+        rec.resize(max(1, int(value)))
+
+
+_flags.on_set("flight_recorder_size", _on_size_flag)
+
+
+def enabled() -> bool:
+    return bool(_F_ENABLED.value)
+
+
+def dump(file: Optional[IO[str]] = None) -> List[tuple]:
+    """Dump the process-wide recorder (explicit ``dump()`` API)."""
+    return recorder().dump(file)
+
+
+# -- crash dump hook ----------------------------------------------------------
+
+_prev_excepthook = None
+_installed = False
+
+
+def _crash_dump() -> None:
+    rec = _RECORDER
+    if rec is None or not _F_ENABLED.value or rec.total_recorded == 0:
+        return
+    path = str(_flags.get_flag("flight_recorder_path") or "")
+    if path:
+        with open(path, "a") as f:
+            rec.dump(f)
+        sys.stderr.write(
+            f"[paddle_tpu flight recorder] dumped {min(rec.total_recorded, rec.capacity)} "
+            f"dispatches to {path}\n")
+    else:
+        rec.dump(sys.stderr)
+
+
+def _excepthook(exc_type, exc_value, exc_tb) -> None:
+    # Ctrl-C / sys.exit are deliberate, not crashes: dumping 256 dispatch
+    # records over the traceback would bury the one line that matters
+    if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+        try:
+            _crash_dump()
+        except Exception:
+            pass  # the original traceback must always still print
+    (_prev_excepthook or sys.__excepthook__)(exc_type, exc_value, exc_tb)
+
+
+def install_excepthook() -> None:
+    """Chain the crash dump in front of the current sys.excepthook
+    (idempotent)."""
+    global _prev_excepthook, _installed
+    if _installed:
+        return
+    _installed = True
+    _prev_excepthook = sys.excepthook
+    sys.excepthook = _excepthook

@@ -1,0 +1,357 @@
+"""Process-wide metrics registry: counters, gauges, timing histograms.
+
+The TPU-native analog of the reference's always-on runtime stats
+(paddle/fluid/platform/profiler/host_event_recorder.h feeding
+summaries, plus the monitoring counters in paddle/phi/core/flags):
+instruments stay registered for the life of the process, increments are
+sub-microsecond, and a disabled registry (``FLAGS_metrics=False``)
+reduces every increment to one flag read.
+
+Design notes:
+
+* Every instrument guards its mutation with a per-instrument
+  ``threading.Lock`` — uncontended acquire/release in CPython is ~100ns,
+  which keeps ``Counter.inc`` well under the 1µs/op budget while staying
+  exact under threads (a bare ``self._n += n`` loses updates when the
+  bytecode interleaves).
+* Gauges may wrap a callback (``fn=...``) evaluated only at snapshot
+  time — how the expensive readings (``jax.live_arrays`` bytes, the
+  dispatcher's exec-cache ``cache_info``) publish with ZERO hot-path
+  cost.
+* Snapshots are plain dicts; :func:`dump_json` and
+  :func:`dump_prometheus` render them. Prometheus names are the metric
+  names with non-``[a-zA-Z0-9_:]`` characters mapped to ``_`` and a
+  ``paddle_`` prefix.
+
+jit-compile visibility rides ``jax.monitoring``: a listener registered
+at import observes ``backend_compile_duration`` events into
+``jit.compiles`` / ``jit.compile_seconds`` — every XLA compile in the
+process is counted, whichever layer triggered it.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import flags as _flags
+
+# the authoritative on/off switch; resolving the _Flag object once makes
+# the disabled fast path a single attribute read
+_F_METRICS = _flags._REGISTRY["metrics"]
+
+
+# default histogram bounds: geometric, 1µs .. ~67s — sized for wall-time
+# observations in seconds (compile times, backward plan/exec times)
+_TIMING_BOUNDS = tuple(1e-6 * 2 ** i for i in range(27))
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is the hot-path API."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "_n", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        if _F_METRICS.value:
+            with self._lock:
+                self._n += n
+
+    @property
+    def value(self) -> int:
+        return self._n
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._n = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self._n}
+
+
+class Gauge:
+    """Point-in-time value: ``set()`` it, or construct with ``fn=`` to
+    evaluate lazily at snapshot time (zero hot-path cost)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "help", "_v", "_fn", "_lock")
+
+    def __init__(self, name: str, help: str = "",
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help
+        self._v = 0.0
+        self._fn = fn
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        if _F_METRICS.value:
+            with self._lock:
+                self._v = v
+
+    @property
+    def value(self) -> Optional[float]:
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:
+                return None  # callback gauges must never break a dump
+        return self._v
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._v = 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Fixed-bound histogram with count/sum/min/max, tuned for timing
+    observations in seconds (geometric 1µs..67s default bounds)."""
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "_bounds", "_buckets", "_count", "_sum",
+                 "_min", "_max", "_lock")
+
+    def __init__(self, name: str, help: str = "",
+                 bounds: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.help = help
+        self._bounds = tuple(bounds) if bounds is not None else _TIMING_BOUNDS
+        self._buckets = [0] * (len(self._bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        if not _F_METRICS.value:
+            return
+        i = bisect.bisect_left(self._bounds, v)
+        with self._lock:
+            self._buckets[i] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._buckets = [0] * (len(self._bounds) + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._min = None
+            self._max = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            nonzero = [(le, n) for le, n in zip(
+                self._bounds + (float("inf"),), self._buckets) if n]
+            return {"type": "histogram", "count": self._count,
+                    "sum": self._sum, "min": self._min, "max": self._max,
+                    "avg": (self._sum / self._count) if self._count else None,
+                    "buckets": nonzero}
+
+
+class MetricsRegistry:
+    """Name -> instrument map. get-or-create semantics: registering the
+    same name twice returns the existing instrument (kind-checked)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Any] = {}
+
+    def _get_or_create(self, cls, name, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise TypeError(
+                        f"metric '{name}' already registered as {m.kind}")
+                return m
+            m = cls(name, **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help=help)
+
+    def gauge(self, name: str, help: str = "",
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help=help, fn=fn)
+
+    def histogram(self, name: str, help: str = "",
+                  bounds: Optional[Tuple[float, ...]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help=help, bounds=bounds)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        return list(self._metrics)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Point-in-time plain-dict view of every instrument (callback
+        gauges are evaluated here)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in items}
+
+    def reset(self) -> None:
+        """Zero every instrument's VALUE (definitions stay registered).
+        Test/bench hygiene only — production counters are monotonic."""
+        with self._lock:
+            items = list(self._metrics.values())
+        for m in items:
+            m._reset()
+
+    # -- dumpers --------------------------------------------------------------
+
+    def dump_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, default=str)
+
+    def dump_prometheus(self) -> str:
+        """Prometheus text exposition format (0.0.4)."""
+        lines: List[str] = []
+        snap = self.snapshot()
+        with self._lock:
+            metas = {n: m for n, m in self._metrics.items()}
+        for name, s in snap.items():
+            m = metas.get(name)
+            pname = "paddle_" + _prom_name(name)
+            if m is not None and m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            if s["type"] == "counter":
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {s['value']}")
+            elif s["type"] == "gauge":
+                lines.append(f"# TYPE {pname} gauge")
+                if s["value"] is not None:
+                    lines.append(f"{pname} {_prom_num(s['value'])}")
+            else:  # histogram: cumulative le buckets + _sum/_count
+                lines.append(f"# TYPE {pname} histogram")
+                cum = 0
+                for le, n in s["buckets"]:
+                    cum += n
+                    le_s = "+Inf" if le == float("inf") else _prom_num(le)
+                    lines.append(f'{pname}_bucket{{le="{le_s}"}} {cum}')
+                # the snapshot elides zero buckets, so a zero-count inf
+                # bucket needs an explicit +Inf close
+                if not any(le == float("inf") for le, _ in s["buckets"]):
+                    lines.append(f'{pname}_bucket{{le="+Inf"}} {s["count"]}')
+                lines.append(f"{pname}_sum {_prom_num(s['sum'])}")
+                lines.append(f"{pname}_count {s['count']}")
+        return "\n".join(lines) + "\n"
+
+
+def _prom_name(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _prom_num(v) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def format_metrics(snapshot: Dict[str, Dict[str, Any]],
+                   title: str = "Metrics") -> str:
+    """Human table for Profiler.summary()'s Metrics section."""
+    rows = []
+    for name in sorted(snapshot):
+        s = snapshot[name]
+        if s["type"] == "histogram":
+            avg = s["avg"]
+            val = (f"count={s['count']} sum={s['sum']:.6f}s"
+                   + (f" avg={avg * 1e6:.1f}us" if avg is not None else ""))
+        else:
+            v = s["value"]
+            val = "-" if v is None else (
+                f"{v:.4g}" if isinstance(v, float) else str(v))
+        rows.append((name, s["type"], val))
+    name_w = max([len("Name")] + [len(r[0]) for r in rows]) + 2
+    hdr = f"{'Name':<{name_w}}{'Type':<12}Value"
+    width = max(len(hdr), *(name_w + 12 + len(r[2]) for r in rows)) \
+        if rows else len(hdr)
+    lines = ["-" * width, title, "-" * width, hdr, "-" * width]
+    for n, t, v in rows:
+        lines.append(f"{n:<{name_w}}{t:<12}{v}")
+    lines.append("-" * width)
+    return "\n".join(lines)
+
+
+# -- process-wide registry -----------------------------------------------------
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+# -- ambient gauges: device/memory + jit compile activity ---------------------
+
+def _live_arrays():
+    import jax
+    return jax.live_arrays()
+
+
+_REGISTRY.gauge(
+    "device.live_array_bytes",
+    help="total bytes of live jax arrays on this host's devices",
+    fn=lambda: float(sum(getattr(a, "nbytes", 0) or 0
+                         for a in _live_arrays())))
+_REGISTRY.gauge(
+    "device.live_arrays", help="number of live jax arrays",
+    fn=lambda: float(len(_live_arrays())))
+
+
+def _device_count():
+    import jax
+    return float(jax.device_count())
+
+
+_REGISTRY.gauge("device.count", help="visible accelerator devices",
+                fn=_device_count)
+
+_JIT_COMPILES = _REGISTRY.counter(
+    "jit.compiles", help="XLA backend compiles observed via jax.monitoring")
+_JIT_COMPILE_SECONDS = _REGISTRY.histogram(
+    "jit.compile_seconds", help="XLA backend compile wall time (seconds)")
+
+
+def _on_jax_event(event: str, duration_secs: float, **kwargs) -> None:
+    if event.endswith("backend_compile_duration"):
+        _JIT_COMPILES.inc()
+        _JIT_COMPILE_SECONDS.observe(duration_secs)
+
+
+def _install_jax_compile_listener() -> None:
+    try:  # jax.monitoring is present across the versions we target, but
+        from jax import monitoring  # a missing API must never break import
+        monitoring.register_event_duration_secs_listener(_on_jax_event)
+    except Exception:
+        pass
+
+
+_install_jax_compile_listener()

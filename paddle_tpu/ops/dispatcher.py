@@ -39,6 +39,21 @@ from ..autograd import engine
 from ..core import dtype as dtype_mod
 from ..core import generator
 from ..core.tensor import Tensor
+from ..observability import flight_recorder as _flight_mod
+from ..observability import metrics as _metrics_mod
+
+# -- always-on observability (observability/): one counter inc per dispatch
+# plus a flag-gated flight-recorder ring write; both stay inside the 1us/op
+# instrumentation budget (bench.py observability_overhead micro).
+
+_M_DISPATCH = _metrics_mod.registry().counter(
+    "dispatch.count", "eager op dispatches (incl. dunder fast path)")
+_M_BIND_FAST = _metrics_mod.registry().counter(
+    "dispatch.bind_fast", "precompiled-binder argument bindings")
+_M_BIND_SLOW = _metrics_mod.registry().counter(
+    "dispatch.bind_slow", "inspect.Signature.bind fallback bindings")
+_F_FLIGHT = flags._REGISTRY["flight_recorder"]
+_FLIGHT = _flight_mod.recorder()
 
 # -- kernel registry ----------------------------------------------------------
 
@@ -209,6 +224,23 @@ def _get_exec(op_name: str, attrs_key: Tuple, present_mask: Tuple[bool, ...],
 
     vjp_j = jax.jit(vjp_run) if use_jit else vjp_run
     return fwd, vjp_j
+
+
+# exec-cache visibility rides lru_cache's own bookkeeping, read only at
+# snapshot time — callback gauges add ZERO cost to the dispatch hot path.
+# (The dunder fast path's per-schema no-grad memo bypasses _get_exec, so
+# `hits` undercounts that regime; dispatch.count still covers it.)
+_metrics_mod.registry().gauge(
+    "dispatch.exec_cache.hits", fn=lambda: float(_get_exec.cache_info().hits),
+    help="per-op XLA executable cache hits")
+_metrics_mod.registry().gauge(
+    "dispatch.exec_cache.misses",
+    fn=lambda: float(_get_exec.cache_info().misses),
+    help="per-op XLA executable cache misses (new executables built)")
+_metrics_mod.registry().gauge(
+    "dispatch.exec_cache.size",
+    fn=lambda: float(_get_exec.cache_info().currsize),
+    help="per-op XLA executable cache entries")
 
 
 # -- dispatch core ------------------------------------------------------------
@@ -413,6 +445,16 @@ def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
     except TypeError:
         hashable = False
 
+    # observability: count the dispatch and (flag-gated) ring-record it
+    # BEFORE the kernel runs, so a raising op is the newest dump entry
+    _M_DISPATCH.inc()
+    if _F_FLIGHT.value:
+        _FLIGHT.record(
+            schema.name,
+            tuple((getattr(p, "shape", None), getattr(p, "dtype", None))
+                  for p in primals),
+            (schema.kernel, attrs_key if hashable else None))
+
     use_jit = schema.jit and flags.get_flag("eager_op_jit") and hashable
 
     if hashable:
@@ -549,6 +591,7 @@ def make_op_fn(schema: OpSchema) -> Callable:
     required = tuple(required)
 
     def bind_slow(args, kwargs):
+        _M_BIND_SLOW.inc()
         ba = sig.bind(*args, **kwargs)   # raises the canonical TypeError
         ba.apply_defaults()
         ba.arguments.pop("name", None)
@@ -574,6 +617,7 @@ def make_op_fn(schema: OpSchema) -> Callable:
         for r in required:
             if r not in arguments:
                 return bind_slow(args, kwargs)
+        _M_BIND_FAST.inc()
         return _dispatch(schema, arguments)
 
     op_fn.__name__ = schema.name
@@ -754,6 +798,12 @@ def _dispatch_binary_fast(schema, attrs_key, a: Tensor, b):
             return None
         b = _const_tensor(b)
     p0, p1 = a._data, b._data
+
+    _M_DISPATCH.inc()
+    if _F_FLIGHT.value:
+        _FLIGHT.record(schema.name,
+                       ((p0.shape, p0.dtype), (p1.shape, p1.dtype)),
+                       (schema.kernel, attrs_key))
 
     if (schema.differentiable and engine._grad_enabled
             and (not a._stop_gradient or not b._stop_gradient)):

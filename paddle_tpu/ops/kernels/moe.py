@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ... import flags
+from ...jax_compat import shard_map
 from ..dispatcher import register_kernel
 from .pallas.grouped_gemm import grouped_matmul
 
@@ -191,7 +192,7 @@ def moe_ffn(x, gate_weight, gate_proj, up_proj, down_proj,
             return _moe_ep_body(x, gw, gp, up, dp, expert_axis, n,
                                 int(top_k), float(capacity_factor),
                                 use_pallas)
-        fn = jax.shard_map(
+        fn = shard_map(
             body, mesh=mesh,
             in_specs=(P(expert_axis), P(), P(expert_axis), P(expert_axis),
                       P(expert_axis)),

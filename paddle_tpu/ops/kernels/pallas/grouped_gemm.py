@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ....jax_compat import tpu_compiler_params
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -132,7 +134,7 @@ def _gmm_impl(x, w, counts, gpe: int):
             functools.partial(_gmm_wide_kernel, bc=bc, bn=Np),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((G, Cp, Np), out_dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "arbitrary"),
                 vmem_limit_bytes=110 * 1024 * 1024),
             interpret=_interpret(),

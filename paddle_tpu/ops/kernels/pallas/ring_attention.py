@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ....jax_compat import shard_map
+
 _NEG = -1e30
 
 
@@ -202,7 +204,7 @@ def ring_attention(query, key, value, mesh, axis_name: str = "sep",
         local = lambda q, k, v: body(q, k, v, axis_name, num,
                                      causal, float(scale))
         spec = P(None, axis_name)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             axis_names=frozenset({axis_name}), check_vma=False))
         _RING_CACHE[ck] = fn

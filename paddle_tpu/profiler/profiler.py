@@ -11,6 +11,7 @@ the jax/XLA profiler (XPlane) instead of CUPTI
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -166,6 +167,11 @@ def _default_state_scheduler(step: int) -> ProfilerState:
     return ProfilerState.RECORD
 
 
+# per-process monotonic export sequence: two exports landing in the same
+# wall-clock millisecond must not overwrite each other's trace file
+_EXPORT_SEQ = itertools.count()
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
                           ) -> Callable:
     """on_trace_ready callback writing chrome://tracing JSON."""
@@ -174,7 +180,9 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_pid{os.getpid()}"
         path = os.path.join(
-            dir_name, f"{name}_time_{int(time.time()*1000)}.paddle_trace.json")
+            dir_name,
+            f"{name}_time_{int(time.time()*1000)}"
+            f"_{next(_EXPORT_SEQ)}.paddle_trace.json")
         prof.export(path, format="json")
 
     return handle
@@ -189,22 +197,48 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
 
 class ProfilerResult:
     def __init__(self, events: List[_HostEvent],
-                 device_trace_dir: Optional[str] = None):
+                 device_trace_dir: Optional[str] = None,
+                 metrics: Optional[Dict[str, Any]] = None,
+                 metrics_ts_ns: Optional[int] = None):
         self.events = events
         self.device_trace_dir = device_trace_dir
+        # observability registry snapshot taken when the record window
+        # closed (emitted as chrome "ph":"C" counter events + the
+        # summary()'s Metrics section)
+        self.metrics = metrics
+        self.metrics_ts_ns = metrics_ts_ns
 
     def to_chrome_json(self) -> Dict[str, Any]:
+        pid = os.getpid()
         trace = []
         for ev in self.events:
             trace.append({
-                "name": ev.name, "ph": "X", "pid": os.getpid(),
+                "name": ev.name, "ph": "X", "pid": pid,
                 "tid": ev.tid, "ts": ev.start_ns / 1e3,
                 "dur": (ev.end_ns - ev.start_ns) / 1e3,
                 "cat": ev.event_type.name,
             })
+        if self.metrics:
+            # counter events: one "C" sample per metric at window close;
+            # histograms surface as count/sum, skipping empty callbacks
+            ts = (self.metrics_ts_ns if self.metrics_ts_ns is not None
+                  else max((ev.end_ns for ev in self.events),
+                           default=0)) / 1e3
+            for name, s in self.metrics.items():
+                if s.get("type") == "histogram":
+                    args = {"count": s.get("count", 0),
+                            "sum": s.get("sum", 0.0)}
+                else:
+                    if s.get("value") is None:
+                        continue
+                    args = {"value": s["value"]}
+                trace.append({"name": name, "ph": "C", "pid": pid,
+                              "tid": 0, "ts": ts, "cat": "Metric",
+                              "args": args})
         return {"traceEvents": trace,
                 "displayTimeUnit": "ms",
-                "deviceTraceDir": self.device_trace_dir or ""}
+                "deviceTraceDir": self.device_trace_dir or "",
+                **({"metrics": self.metrics} if self.metrics else {})}
 
     def save(self, path: str, format: str = "json"):
         with open(path, "w") as f:
@@ -216,13 +250,16 @@ def load_profiler_result(filename: str) -> ProfilerResult:
         payload = json.load(f)
     events = []
     for e in payload.get("traceEvents", []):
+        if e.get("ph", "X") != "X":
+            continue  # counter samples are not host spans
         start = int(e["ts"] * 1e3)
         events.append(_HostEvent(
             e["name"], start, start + int(e.get("dur", 0) * 1e3),
             e.get("tid", 0),
             getattr(TracerEventType, e.get("cat", "UserDefined"),
                     TracerEventType.UserDefined)))
-    return ProfilerResult(events, payload.get("deviceTraceDir") or None)
+    return ProfilerResult(events, payload.get("deviceTraceDir") or None,
+                          metrics=payload.get("metrics"))
 
 
 # -- profiler -----------------------------------------------------------------
@@ -354,8 +391,14 @@ class Profiler:
             except Exception:
                 pass
             self._device_tracing = False
+        try:  # observability snapshot rides along with the host spans
+            from .. import observability
+            metrics = observability.snapshot()
+        except Exception:
+            metrics = None
         self._result = ProfilerResult(
-            events, self.trace_dir if had_device_trace else None)
+            events, self.trace_dir if had_device_trace else None,
+            metrics=metrics, metrics_ts_ns=time.perf_counter_ns())
 
     def _begin_step_span(self):
         self._step_span = RecordEvent(
@@ -379,7 +422,10 @@ class Profiler:
             print("[paddle_tpu.profiler] no recorded data")
             return
         print(gen_summary(self._result.events, sorted_by=sorted_by,
-                          time_unit=time_unit))
+                          time_unit=time_unit, thread_sep=thread_sep))
+        if self._result.metrics:
+            from ..observability import format_metrics
+            print(format_metrics(self._result.metrics))
 
     def get_profiler_result(self) -> Optional[ProfilerResult]:
         return self._result

@@ -56,8 +56,27 @@ def collect(events) -> Dict[str, EventSummary]:
         s.add(ev.end_ns - ev.start_ns)
     return table
 
+
 def gen_summary(events, sorted_by=None, time_unit: str = "ms",
-                row_limit: int = 100) -> str:
+                row_limit: int = 100, thread_sep: bool = False) -> str:
+    """Aggregate table over host spans; with ``thread_sep`` the combined
+    table is followed by one sub-table per recording thread (reference
+    profiler_statistic's thread_sep view)."""
+    out = _gen_one_table(events, sorted_by, time_unit, row_limit)
+    if not thread_sep:
+        return out
+    by_tid: Dict[int, list] = {}
+    for ev in events:
+        by_tid.setdefault(ev.tid, []).append(ev)
+    parts = [out]
+    for tid in sorted(by_tid):
+        parts.append(f"\nThread {tid}:")
+        parts.append(_gen_one_table(by_tid[tid], sorted_by, time_unit,
+                                    row_limit))
+    return "\n".join(parts)
+
+
+def _gen_one_table(events, sorted_by, time_unit, row_limit) -> str:
     div = _UNIT.get(time_unit, 1e6)
     table = collect(events)
     key = {

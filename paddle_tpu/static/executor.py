@@ -18,7 +18,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _obs_metrics
 from .graph import Operator, Program, Variable
+
+_M_EXEC_RUNS = _obs_metrics.registry().counter(
+    "executor.runs", "static Executor.run program executions")
+_M_EXEC_COMPILES = _obs_metrics.registry().counter(
+    "executor.compiles",
+    "executor cache misses (new (program, shapes) executables jitted)")
 
 
 class GradOp(Operator):
@@ -66,6 +73,10 @@ class Scope:
 
 _global_scope = Scope()
 
+_obs_metrics.registry().gauge(
+    "executor.scope_vars", fn=lambda: float(len(_global_scope.vars)),
+    help="variables materialized in the global executor scope")
+
 
 def global_scope() -> Scope:
     return _global_scope
@@ -112,6 +123,7 @@ class Executor:
             fetch_list: Optional[Sequence] = None,
             return_numpy: bool = True):
         from .graph import default_main_program
+        _M_EXEC_RUNS.inc()
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
@@ -139,6 +151,8 @@ class Executor:
                      tuple(fetch_names))
         compiled = self._cache.get(cache_key)
         if compiled is None:
+            _M_EXEC_COMPILES.inc()
+
             def fn(feed_vals, param_vals, seed):
                 env = dict(zip(feed_names, feed_vals))
                 env.update(zip(param_names, param_vals))

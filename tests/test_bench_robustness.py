@@ -138,4 +138,25 @@ class TestCompactTail:
                    for c in last["detail"]["configs"])
 
 
+class TestObservabilityMicro:
+    def test_micro_runs_and_reports(self):
+        """bench.py observability_overhead smoke: the micro must run on
+        CPU and report both the disabled-path and enabled-path costs
+        (ISSUE 3: <=1us/op instrumentation budget with the flight
+        recorder off)."""
+        r = bench.bench_observability(False)
+        assert r["metric"] == "observability_overhead_us_per_op"
+        assert r["unit"] == "us/op"
+        assert r["value"] >= 0.0
+        d = r["detail"]
+        assert "disabled_path_ns_per_op" in d
+        assert "enabled_path_us_per_op" in d
+        assert d["eager_us_per_op_no_instrumentation"] > 0
+        # the flags the micro toggles must be restored afterwards
+        import paddle_tpu as paddle
+        got = paddle.get_flags(["FLAGS_metrics", "FLAGS_flight_recorder"])
+        assert got["FLAGS_metrics"] is True
+        assert got["FLAGS_flight_recorder"] is True
+
+
 pytestmark = pytest.mark.smoke

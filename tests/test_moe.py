@@ -159,6 +159,8 @@ class TestMoEFFN:
                                              False)[0])
             c = f.lower(jax.ShapeDtypeStruct((t, h), jnp.float32)) \
                  .compile().cost_analysis()
+            if isinstance(c, (list, tuple)):  # old jax: one dict per program
+                c = c[0]
             return c["flops"]
 
         f1, f2 = flops(256), flops(512)

@@ -37,7 +37,8 @@ def allreduce_script(tmp_path):
 
         penv = dist.init_parallel_env()
         rank, world = penv.rank, penv.world_size
-        assert jax.distributed.is_initialized()
+        from paddle_tpu.jax_compat import is_distributed_initialized
+        assert is_distributed_initialized()
         assert jax.device_count() == world, (jax.device_count(), world)
         assert jax.local_device_count() == 1
 
@@ -151,7 +152,8 @@ class TestSingleProcessNoop:
         import paddle_tpu.distributed as dist
         penv = dist.init_parallel_env()
         assert penv.world_size == 1
-        assert not jax.distributed.is_initialized()
+        from paddle_tpu.jax_compat import is_distributed_initialized
+        assert not is_distributed_initialized()
 
 # multi-device / subprocess / long-compile module (`-m "not heavy"` skips)
 import pytest as _pytest_mark  # noqa: E402
