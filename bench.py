@@ -733,6 +733,80 @@ def bench_micro(on_tpu: bool):
 
 
 # --------------------------------------------------------------------------
+# tp_attention: shard_map'd Pallas flash vs GSPMD composite under a tp>=2
+# mesh (ISSUE 4 acceptance micro). On TPU the ratio is the real device-
+# clock win; on CPU it runs the same code path over a forced multi-device
+# host mesh (interpret-mode Pallas — a smoke ratio, not a perf claim).
+# --------------------------------------------------------------------------
+
+def bench_tp_attention(on_tpu: bool):
+    import subprocess
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if jax.device_count() < 2:
+        if on_tpu:
+            return None  # single-chip TPU: no tp mesh to measure
+        # re-exec under a forced multi-device host mesh (the XLA_FLAGS
+        # must be set before jax initializes, hence the subprocess)
+        flags_env = os.environ.get("XLA_FLAGS", "")
+        env = dict(os.environ,
+                   XLA_FLAGS=flags_env
+                   + " --xla_force_host_platform_device_count=8",
+                   JAX_PLATFORMS="cpu",
+                   PTPU_BENCH_CONFIGS="tp_attention",
+                   PTPU_BENCH_ISOLATED="0")
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, env=env)
+        d = json.loads(r.stdout.strip().splitlines()[-1])
+        cfgs = d["detail"].get("configs", [])
+        return next((c for c in cfgs
+                     if c.get("metric") == "tp_attention_us"), None)
+
+    from paddle_tpu.ops.kernels.nn import scaled_dot_product_attention
+    from paddle_tpu.ops.kernels.pallas import tp_attention as tpa
+
+    tp = min(4, jax.device_count())
+    mesh = jax.make_mesh((tp,), ("mp",))
+    rng = np.random.RandomState(0)
+    if on_tpu:
+        b, s, hq, hk, d, dtype, steps = 2, 2048, 32, 8, 128, jnp.bfloat16, 10
+    else:
+        b, s, hq, hk, d, dtype, steps = 1, 256, 8, 4, 32, jnp.float32, 3
+    shard = NamedSharding(mesh, P(None, None, "mp", None))
+    q = jax.device_put(jnp.asarray(rng.randn(b, s, hq, d), dtype), shard)
+    k = jax.device_put(jnp.asarray(rng.randn(b, s, hk, d), dtype), shard)
+    v = jax.device_put(jnp.asarray(rng.randn(b, s, hk, d), dtype), shard)
+
+    def pallas_fn(q_, k_, v_):
+        return tpa.sharded_flash_attention(q_, k_, v_, mesh, "mp", None,
+                                           causal=True)
+
+    composite = jax.jit(lambda q_, k_, v_: scaled_dot_product_attention(
+        q_, k_, v_, is_causal=True))
+
+    t_pal = _time_steps(pallas_fn, steps, q, k, v) * 1e6
+    t_xla = _time_steps(composite, steps, q, k, v) * 1e6
+    return {
+        "metric": "tp_attention_us",
+        "value": round(t_pal, 1),
+        "unit": "us/call",
+        "vs_baseline": round(t_xla / t_pal, 4),
+        "detail": {
+            "shape": f"b{b} s{s} hq{hq} kv{hk} d{d} causal tp{tp}",
+            "mesh": f"mp={tp} of {jax.device_count()} devices",
+            "xla_composite_us": round(t_xla, 1),
+            "baseline": "GSPMD-partitioned XLA SDPA composite on the "
+                        "same tp-sharded inputs"
+                        + ("" if on_tpu else
+                           " (CPU smoke: Pallas runs interpreted — "
+                           "code-path check, not a perf claim)"),
+        },
+    }
+
+
+# --------------------------------------------------------------------------
 # serving: paged-KV decode throughput, Pallas vs composite attention
 # --------------------------------------------------------------------------
 
@@ -1403,7 +1477,7 @@ def main():
     which = os.environ.get(
         "PTPU_BENCH_CONFIGS",
         "llama,llamapeak,llama4k,llamalong,resnet,bert,ocr,moe,serving,"
-        "cbatch,aot,micro,dispatch,observability")
+        "cbatch,aot,tp_attention,micro,dispatch,observability")
     which = [w.strip() for w in which.split(",") if w.strip()]
     if (on_tpu and len(which) > 1
             and os.environ.get("PTPU_BENCH_ISOLATED", "1") != "0"):
@@ -1486,7 +1560,8 @@ def main():
     for name, fn in (("resnet", bench_resnet), ("bert", bench_bert),
                      ("ocr", bench_ocr), ("moe", bench_moe),
                      ("serving", bench_serving), ("cbatch", bench_cbatch),
-                     ("aot", bench_aot)):
+                     ("aot", bench_aot),
+                     ("tp_attention", bench_tp_attention)):
         r = guard(name, fn, on_tpu)
         if isinstance(r, list):
             configs.extend(r)

@@ -136,6 +136,9 @@ from . import audio  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
+from . import dataset  # noqa: E402,F401
 
 __version__ = "0.1.0"
 from .hapi.flops import flops  # noqa: E402,F401

@@ -1,0 +1,31 @@
+"""Legacy `paddle.dataset` namespace (reference python/paddle/dataset/,
+removed upstream after 2.x but still imported by old training scripts).
+
+Each submodule exposes the legacy reader-creator API — ``train()`` /
+``test()`` return a zero-arg callable yielding samples — implemented as
+thin adapters over this framework's map-style datasets (`vision/
+datasets.py`, `text/`). The local-file contract is the same as
+everywhere in this stack (utils/download.require_local_file): there is
+no network egress, so a missing file raises the shared clear error
+instead of half-downloading. Stance recorded in PARITY.md ("surface
+long tail").
+"""
+
+from __future__ import annotations
+
+
+def _reader_creator(make_dataset):
+    """Legacy reader-creator: train()/test() return a callable returning
+    a fresh sample generator (reference dataset/common.py convention)."""
+    def reader():
+        ds = make_dataset()
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader
+
+
+from . import (cifar, common, conll05, imdb, imikolov, mnist,  # noqa: E402
+               movielens, uci_housing, wmt14, wmt16)
+
+__all__ = ["cifar", "common", "conll05", "imdb", "imikolov", "mnist",
+           "movielens", "uci_housing", "wmt14", "wmt16"]

@@ -1,0 +1,28 @@
+"""Legacy dataset.cifar readers (cifar10/cifar100 archives)."""
+
+from __future__ import annotations
+
+from . import _reader_creator
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _make(cls_name, mode):
+    from ..vision import datasets as vd
+    return getattr(vd, cls_name)(mode=mode)
+
+
+def train10():
+    return _reader_creator(lambda: _make("Cifar10", "train"))
+
+
+def test10():
+    return _reader_creator(lambda: _make("Cifar10", "test"))
+
+
+def train100():
+    return _reader_creator(lambda: _make("Cifar100", "train"))
+
+
+def test100():
+    return _reader_creator(lambda: _make("Cifar100", "test"))

@@ -1,0 +1,38 @@
+"""Legacy dataset.common: the local-file contract shared by every
+legacy reader (reference dataset/common.py md5/download helpers)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from ..utils.download import require_local_file
+
+__all__ = ["DATA_HOME", "md5file", "download"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str = None,
+             save_name: str = None):
+    """No network egress: resolves to the expected cache path if the
+    file is already there (verifying md5sum when given, preserving the
+    legacy raise-on-mismatch contract), else raises the shared clear
+    error."""
+    fname = save_name or url.split("/")[-1]
+    path = os.path.join(DATA_HOME, module_name, fname)
+    require_local_file(path, f"dataset.{module_name}", arg=fname)
+    if md5sum and md5file(path) != md5sum:
+        raise RuntimeError(
+            f"dataset.{module_name}: {path} fails its md5 check "
+            f"(expected {md5sum}); replace the file — re-downloading is "
+            f"unavailable in this environment")
+    return path

@@ -1,0 +1,17 @@
+"""Legacy dataset.conll05 reader over text.datasets.Conll05st."""
+
+from __future__ import annotations
+
+import os
+
+from . import _reader_creator
+from .common import DATA_HOME
+
+__all__ = ["test"]
+
+_DEFAULT = os.path.join(DATA_HOME, "conll05st", "conll05st-tests.tar.gz")
+
+
+def test(data_file=None):
+    from ..text.datasets import Conll05st
+    return _reader_creator(lambda: Conll05st(data_file or _DEFAULT))

@@ -1,0 +1,25 @@
+"""Legacy dataset.imdb readers over text.Imdb (aclImdb archive)."""
+
+from __future__ import annotations
+
+import os
+
+from . import _reader_creator
+from .common import DATA_HOME
+
+__all__ = ["train", "test"]
+
+_DEFAULT = os.path.join(DATA_HOME, "imdb", "aclImdb_v1.tar.gz")
+
+
+def _make(mode, data_file=None):
+    from ..text import Imdb
+    return Imdb(data_file or _DEFAULT, mode=mode)
+
+
+def train(word_idx=None, data_file=None):
+    return _reader_creator(lambda: _make("train", data_file))
+
+
+def test(word_idx=None, data_file=None):
+    return _reader_creator(lambda: _make("test", data_file))

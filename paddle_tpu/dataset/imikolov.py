@@ -1,0 +1,26 @@
+"""Legacy dataset.imikolov readers over text.datasets.Imikolov."""
+
+from __future__ import annotations
+
+import os
+
+from . import _reader_creator
+from .common import DATA_HOME
+
+__all__ = ["train", "test"]
+
+_DEFAULT = os.path.join(DATA_HOME, "imikolov", "simple-examples.tgz")
+
+
+def _make(mode, n, data_file=None):
+    from ..text.datasets import Imikolov
+    return Imikolov(data_file or _DEFAULT, data_type="NGRAM", window_size=n,
+                    mode=mode)
+
+
+def train(word_idx=None, n=5, data_file=None):
+    return _reader_creator(lambda: _make("train", n, data_file))
+
+
+def test(word_idx=None, n=5, data_file=None):
+    return _reader_creator(lambda: _make("test", n, data_file))

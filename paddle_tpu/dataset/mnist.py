@@ -1,0 +1,21 @@
+"""Legacy dataset.mnist readers over vision.datasets.MNIST idx files."""
+
+from __future__ import annotations
+
+from . import _reader_creator
+
+__all__ = ["train", "test"]
+
+
+def _make(mode):
+    from ..vision.datasets import MNIST
+    return MNIST(mode=mode)
+
+
+def train():
+    """Reader over the train split: yields (image [28,28,1], label)."""
+    return _reader_creator(lambda: _make("train"))
+
+
+def test():
+    return _reader_creator(lambda: _make("test"))

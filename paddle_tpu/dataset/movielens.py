@@ -1,0 +1,25 @@
+"""Legacy dataset.movielens readers over text.datasets.Movielens."""
+
+from __future__ import annotations
+
+import os
+
+from . import _reader_creator
+from .common import DATA_HOME
+
+__all__ = ["train", "test"]
+
+_DEFAULT = os.path.join(DATA_HOME, "movielens", "ml-1m.zip")
+
+
+def _make(mode, data_file=None):
+    from ..text.datasets import Movielens
+    return Movielens(data_file or _DEFAULT, mode=mode)
+
+
+def train(data_file=None):
+    return _reader_creator(lambda: _make("train", data_file))
+
+
+def test(data_file=None):
+    return _reader_creator(lambda: _make("test", data_file))

@@ -1,0 +1,26 @@
+"""Legacy dataset.uci_housing readers over text.UCIHousing."""
+
+from __future__ import annotations
+
+import os
+
+from . import _reader_creator
+from .common import DATA_HOME
+
+__all__ = ["train", "test"]
+
+_DEFAULT = os.path.join(DATA_HOME, "uci_housing", "housing.data")
+
+
+def _make(mode, data_file=None):
+    from ..text import UCIHousing
+    return UCIHousing(data_file or _DEFAULT, mode=mode)
+
+
+def train(data_file=None):
+    """Reader yielding (13 normalized features, price)."""
+    return _reader_creator(lambda: _make("train", data_file))
+
+
+def test(data_file=None):
+    return _reader_creator(lambda: _make("test", data_file))

@@ -1,0 +1,25 @@
+"""Legacy dataset.wmt14 readers over text.datasets.WMT14."""
+
+from __future__ import annotations
+
+import os
+
+from . import _reader_creator
+from .common import DATA_HOME
+
+__all__ = ["train", "test"]
+
+_DEFAULT = os.path.join(DATA_HOME, "wmt14", "wmt14.tgz")
+
+
+def _make(mode, dict_size, data_file=None):
+    from ..text.datasets import WMT14
+    return WMT14(data_file or _DEFAULT, mode=mode, dict_size=dict_size)
+
+
+def train(dict_size=-1, data_file=None):
+    return _reader_creator(lambda: _make("train", dict_size, data_file))
+
+
+def test(dict_size=-1, data_file=None):
+    return _reader_creator(lambda: _make("test", dict_size, data_file))

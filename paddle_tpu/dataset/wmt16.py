@@ -1,0 +1,28 @@
+"""Legacy dataset.wmt16 readers over text.datasets.WMT16."""
+
+from __future__ import annotations
+
+import os
+
+from . import _reader_creator
+from .common import DATA_HOME
+
+__all__ = ["train", "test"]
+
+_DEFAULT = os.path.join(DATA_HOME, "wmt16", "wmt16.tar.gz")
+
+
+def _make(mode, src_dict_size, trg_dict_size, data_file=None):
+    from ..text.datasets import WMT16
+    return WMT16(data_file or _DEFAULT, mode=mode,
+                 src_dict_size=src_dict_size, trg_dict_size=trg_dict_size)
+
+
+def train(src_dict_size=-1, trg_dict_size=-1, data_file=None):
+    return _reader_creator(
+        lambda: _make("train", src_dict_size, trg_dict_size, data_file))
+
+
+def test(src_dict_size=-1, trg_dict_size=-1, data_file=None):
+    return _reader_creator(
+        lambda: _make("test", src_dict_size, trg_dict_size, data_file))

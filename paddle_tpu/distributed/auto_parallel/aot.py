@@ -27,6 +27,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Dict, Optional, Tuple
 
@@ -126,11 +127,18 @@ def _shard_like_param(aval_tree, pspec, mesh):
 
 def lower_llama_train_step(model, criterion, optimizer, mesh: Mesh,
                            global_batch: int, seq: int,
-                           dp_axis: str = "dp", zero1: bool = False):
+                           dp_axis: str = "dp", tp_axis: str = "mp",
+                           zero1: bool = False):
     """Lower the FULL TrainStep (fwd+bwd+AdamW, donated state) against
     `mesh`'s (possibly detached-topology) devices. Returns
-    (lowered, param_count)."""
+    (lowered, param_count).
+
+    Tracing runs under `tp_shard_context(mesh, tp_axis, dp_axis)`: no
+    hybrid topology exists in this deviceless path (TP is expressed only
+    as shardings), so the context is how the attention kernel tier knows
+    to emit its shard_map'd Pallas entry instead of tripping GSPMD."""
     from ...jit.api import TrainStep
+    from ...ops.kernels.pallas.tp_attention import tp_shard_context
 
     ts = TrainStep(model, criterion, optimizer)
     ts._abstract_state = True
@@ -178,9 +186,13 @@ def lower_llama_train_step(model, criterion, optimizer, mesh: Mesh,
     lr_aval = _sds((), jnp.float32, mesh, repl)
     step_aval = _sds((), jnp.int32, mesh, repl)
 
-    lowered = ts._compiled.lower(
-        (), tuple(p_avals), tuple(m_avals), tuple(s_avals), buf_avals,
-        frz_avals, key_aval, (ids_aval,), (ids_aval,), lr_aval, step_aval)
+    tp_ctx = (tp_shard_context(mesh, head_axis=tp_axis, batch_axis=dp_axis)
+              if tp_axis in mesh.shape else contextlib.nullcontext())
+    with tp_ctx:
+        lowered = ts._compiled.lower(
+            (), tuple(p_avals), tuple(m_avals), tuple(s_avals), buf_avals,
+            frz_avals, key_aval, (ids_aval,), (ids_aval,), lr_aval,
+            step_aval)
     n_params = sum(int(np.prod(p._data.shape)) for p in params)
     return lowered, n_params
 
@@ -222,11 +234,13 @@ def plan_llama3_8b_v5p64(tp: int = 8, dp: int = 8,
         num_attention_heads=32, num_key_value_heads=8,
         max_position_embeddings=seq, rope_theta=500000.0,
         dtype="bfloat16", use_scan_layers=True, recompute=True,
-        # the XLA composite attention partitions under GSPMD (heads ride
-        # the mp axis); the Pallas flash kernel would need an explicit
-        # shard_map wrap, which topology lowering does not do — and on a
-        # TPU-attached process the kernel router would otherwise pick it
-        use_flash_attention=False)
+        # the Pallas flash kernel runs per head-shard under a mesh-aware
+        # shard_map (ops/kernels/pallas/tp_attention.py): lowering enters
+        # tp_shard_context below, heads ride the mp axis (32 q / 8 kv
+        # divide tp=8), and the kernel composes with GSPMD instead of
+        # aborting the SPMD partitioner — the composite is only the
+        # recorded fallback for non-divisible geometries
+        use_flash_attention=True)
 
     mesh = topology_mesh(topology, {"dp": dp, "mp": tp})
     prev_dtype = paddle.get_default_dtype()
@@ -240,15 +254,39 @@ def plan_llama3_8b_v5p64(tp: int = 8, dp: int = 8,
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
                                  parameters=model.parameters())
 
+    # importing the TP dispatcher registers its counters (get-or-create
+    # semantics keep this idempotent). Counter.inc is gated on
+    # FLAGS_metrics, so the flag is forced on for the duration of the
+    # trace — the plan's sharded/fallback evidence must not read 0/0
+    # just because observability was switched off.
+    from ... import flags as _flags
+    from ...observability import metrics as _obs
+    from ...ops.kernels.pallas import tp_attention as _tpa  # noqa: F401
+    m_sharded = _obs.registry().counter("tp_attention.sharded")
+    m_fallback = _obs.registry().counter("tp_attention.fallback")
+    s0, f0 = m_sharded.value, m_fallback.value
+    prev_metrics = _flags.get_flag("metrics")
+    if not prev_metrics:
+        _flags.set_flags({"metrics": True})
+
     t0 = time.perf_counter()
-    lowered, n_params = lower_llama_train_step(
-        model, lambda logits, labels: crit(logits, labels), opt, mesh,
-        global_batch=batch_per_dp * dp, seq=seq, zero1=zero1)
+    try:
+        lowered, n_params = lower_llama_train_step(
+            model, lambda logits, labels: crit(logits, labels), opt, mesh,
+            global_batch=batch_per_dp * dp, seq=seq, zero1=zero1)
+    finally:
+        if not prev_metrics:
+            _flags.set_flags({"metrics": False})
     lower_s = time.perf_counter() - t0
     out = {"params": n_params, "mesh": {"dp": dp, "mp": tp},
            "topology": topology, "seq": seq, "zero1": zero1,
            "global_batch": batch_per_dp * dp,
-           "lower_seconds": round(lower_s, 1)}
+           "lower_seconds": round(lower_s, 1),
+           # how attention lowered: sharded = shard_map'd Pallas
+           # dispatches during this trace, fallback = recorded composite
+           # fallbacks (0/nonzero would mean a guard tripped)
+           "attention": {"sharded": m_sharded.value - s0,
+                         "fallback": m_fallback.value - f0}}
     if not compile_now:
         out["lowered"] = lowered
         return out
@@ -265,5 +303,9 @@ def plan_llama3_8b_v5p64(tp: int = 8, dp: int = 8,
         # donation aliases outputs onto arguments: live = args + temp
         "live": int(ma.argument_size_in_bytes + ma.temp_size_in_bytes),
     }
-    out["collectives"] = collective_stats(compiled.as_text())
+    hlo = compiled.as_text()
+    out["collectives"] = collective_stats(hlo)
+    # evidence the flash kernel actually lowered as Mosaic custom calls
+    # (0 would mean the shard_map'd Pallas path silently fell back)
+    out["pallas_custom_calls"] = hlo.count("tpu_custom_call")
     return out

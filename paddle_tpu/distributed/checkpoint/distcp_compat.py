@@ -43,6 +43,10 @@ _REF_MODULE = "paddle.distributed.checkpoint.metadata"
 class RefLocalTensorMetadata:
     global_offset: Tuple[int, ...]
     local_shape: Tuple[int, ...]
+    # storage dtype of the box (reference metadata.py records it as the
+    # VarType name, e.g. "float32" / "bfloat16"). None on pickles written
+    # before this field existed — the payload array's own dtype rules then.
+    dtype: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -166,6 +170,36 @@ def _tensor_value(v) -> np.ndarray:
     return np.asarray(v)
 
 
+# -- bf16-native payloads (VarType.BF16) --------------------------------------
+# ml_dtypes.bfloat16 ndarrays don't unpickle in a process without
+# ml_dtypes, and the old f32 round-trip silently widened every bf16-O2
+# checkpoint 2x on disk. Instead the 2-byte payload pickles as a plain
+# numpy VOID view ('V2' — raw bits, no scalar type involved), with the
+# true dtype recorded in the metadata box (the reference's VarType.BF16
+# slot). Readers view the bits back; uint16 payloads (the reference's
+# own numpy spelling of bf16) are accepted too.
+
+def _bf16_to_wire(arr: np.ndarray) -> np.ndarray:
+    return arr.view(np.dtype("V2"))
+
+
+def _wire_to_bf16(arr: np.ndarray) -> np.ndarray:
+    import ml_dtypes
+    if arr.dtype.itemsize != 2:
+        raise ValueError(
+            f"bfloat16 box stored with {arr.dtype.itemsize}-byte payload "
+            f"({arr.dtype}); expected a 2-byte void/uint16 view")
+    return arr.view(ml_dtypes.bfloat16)
+
+
+def _decode_box(arr: np.ndarray, box) -> np.ndarray:
+    """Apply the metadata box dtype to a raw payload array."""
+    want = getattr(box, "dtype", None)
+    if want == "bfloat16" or (want is None and arr.dtype.kind == "V"):
+        return _wire_to_bf16(arr)
+    return arr
+
+
 
 def _assemble_global(pieces) -> np.ndarray:
     """[(offset, extent, array), ...] -> global array (zeros-filled gaps)."""
@@ -222,7 +256,7 @@ def load_reference_distcp(path: str) -> Dict[str, np.ndarray]:
                 raise KeyError(
                     f"metadata has no storage entry for {key} @ "
                     f"{b.global_offset}")
-            arr = _tensor_value(shard(fname)[key])
+            arr = _decode_box(_tensor_value(shard(fname)[key]), b)
             if tuple(arr.shape) != tuple(b.local_shape):
                 raise ValueError(
                     f"shard {key}@{b.global_offset}: file has shape "
@@ -261,14 +295,15 @@ def save_reference_distcp(state_dict: Dict[str, Any], path: str,
             arr = (val.numpy() if isinstance(val, Tensor)
                    else np.asarray(val))
             offset = (0,) * arr.ndim
-        if arr.dtype.name == "bfloat16":
-            # a genuine reference process has no ml_dtypes scalar type;
-            # bf16 interchanges as f32 (lossless upcast, dtype widened —
-            # documented divergence)
-            arr = arr.astype(np.float32)
+        dtype_name = arr.dtype.name
+        if dtype_name == "bfloat16":
+            # bf16-NATIVE payload: pickle the raw bits as a numpy void
+            # view (no ml_dtypes GLOBAL in the stream), dtype recorded in
+            # the metadata box — no f32 widening, byte-exact round trip
+            arr = _bf16_to_wire(arr)
         payload[key] = (key, arr)     # reduce_varbase on-disk form
         sdm[key] = [RefLocalTensorMetadata(tuple(offset),
-                                           tuple(arr.shape))]
+                                           tuple(arr.shape), dtype_name)]
         storage[RefLocalTensorIndex(key, tuple(offset))] = fname
 
     md = RefMetadata(state_dict_metadata=sdm, storage_metadata=storage,

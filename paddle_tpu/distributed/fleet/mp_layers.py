@@ -34,6 +34,24 @@ def _mp_mesh():
     return hcg.mesh
 
 
+def tp_attention_context():
+    """(mesh, head_axis, batch_axis|None) for the shard_map'd Pallas
+    attention tier (ops/kernels/pallas/tp_attention.py), or None outside
+    tensor parallelism.
+
+    This is the fleet's sharding stance made explicit: the column-
+    parallel q/k/v projections leave activations mp-sharded on the
+    fused head dim, so attention heads ride 'mp' and the batch rides
+    'dp' — per-shard attention then needs no collectives at all, and
+    the row-parallel o_proj's psum stays the block's only mp exchange
+    (exactly the reference's Megatron factorization)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.get_model_parallel_world_size() <= 1:
+        return None
+    batch = "dp" if hcg.get_data_parallel_world_size() > 1 else None
+    return (hcg.mesh.mesh, "mp", batch)
+
+
 def _shard_param(p: Tensor, tensor_dim: Optional[int], axis: str = "mp"):
     """Shard param dim `tensor_dim` over mesh axis `axis` (None=replicate)."""
     mesh = _mp_mesh().mesh

@@ -154,10 +154,17 @@ def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
     try:
         from ..ops.kernels.moe import _EP_CACHE
         from ..ops.kernels.pallas.ring_attention import _RING_CACHE
+        from ..ops.kernels.pallas.tp_attention import _TP_CACHE
         _EP_CACHE.clear()
         _RING_CACHE.clear()
+        _TP_CACHE.clear()
     except ImportError:
         pass
+    # kernels read the ambient topology at TRACE time (ring/TP attention,
+    # MoE EP): per-op executables traced under the previous mesh must not
+    # replay under this one — the epoch keys the dispatcher's exec cache
+    from .. import flags as _flags
+    _flags.bump_mesh_epoch()
 
 
 def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:

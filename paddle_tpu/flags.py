@@ -98,11 +98,28 @@ def get_flag(name: str) -> Any:
 # state REUSES its executables and a same-value set_flags is a no-op.
 version = 0
 
+# Mesh/topology epoch folded into the fingerprint: kernels also read the
+# AMBIENT device mesh at trace time (the hybrid topology's hcg, the AOT
+# tp_shard_context) to decide shard_map wrapping — so executables traced
+# under one mesh must not replay under another. Every topology mutation
+# bumps this (distributed/topology.set_hybrid_communicate_group,
+# pallas/tp_attention.tp_shard_context).
+_mesh_epoch = 0
+
+
+def bump_mesh_epoch() -> None:
+    """Invalidate trace-time caches keyed on `version` after an ambient
+    mesh/topology change."""
+    global _mesh_epoch
+    _mesh_epoch += 1
+    _refingerprint()
+
 
 def _refingerprint() -> None:
     global version
-    version = hash(tuple(sorted((k, repr(f.value))
-                                for k, f in _REGISTRY.items())))
+    version = hash((_mesh_epoch,
+                    tuple(sorted((k, repr(f.value))
+                                 for k, f in _REGISTRY.items()))))
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

@@ -1,0 +1,111 @@
+"""`paddle.hub` — hubconf.py entrypoint loader (reference
+python/paddle/hapi/hub.py: list/help/load over local dirs, github and
+gitee repos).
+
+TPU-native stance: the loader mechanics (import a repo's ``hubconf.py``,
+expose its public callables as entrypoints, check ``dependencies``) are
+fully supported for ``source='local'``. The github/gitee formats parse
+to the same cache layout the reference uses
+(``~/.cache/paddle_tpu/hub/<owner>_<repo>_<branch>``) but this stack has
+no network egress, so a cache miss raises a clear error telling the
+user to place the checkout there instead of half-downloading.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import Any, Callable, List, Optional
+
+__all__ = ["list", "help", "load"]
+
+HUB_HOME = os.path.expanduser("~/.cache/paddle_tpu/hub")
+_ENTRY_FILE = "hubconf.py"
+
+
+def _parse_repo(repo: str):
+    """'owner/name[:branch]' -> (owner, name, branch)."""
+    if repo.count("/") != 1:
+        raise ValueError(
+            f"hub repo {repo!r} is not in 'owner/name[:branch]' form")
+    rest, _, branch = repo.partition(":")
+    owner, name = rest.split("/")
+    if not owner or not name:
+        raise ValueError(
+            f"hub repo {repo!r} is not in 'owner/name[:branch]' form")
+    return owner, name, branch or "main"
+
+
+def _repo_dir(repo: str, source: str) -> str:
+    if source == "local":
+        return repo
+    if source not in ("github", "gitee"):
+        raise ValueError(
+            f"hub source must be 'github', 'gitee' or 'local', got "
+            f"{source!r}")
+    owner, name, branch = _parse_repo(repo)
+    cached = os.path.join(HUB_HOME, f"{owner}_{name}_{branch}")
+    if not os.path.isdir(cached):
+        host = "github.com" if source == "github" else "gitee.com"
+        raise RuntimeError(
+            f"hub: {source} repo {repo!r} is not cached and downloading "
+            f"is unavailable in this environment; clone "
+            f"https://{host}/{owner}/{name} (branch {branch}) into "
+            f"{cached}")
+    return cached
+
+
+def _import_hubconf(directory: str):
+    path = os.path.join(directory, _ENTRY_FILE)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"hub: no {_ENTRY_FILE} under {directory}")
+    name = "paddle_tpu_hubconf_" + \
+        "".join(c if c.isalnum() else "_" for c in os.path.abspath(directory))
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, directory)   # hubconf may import repo-local modules
+    try:
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(directory)
+    deps = getattr(mod, "dependencies", None) or []
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(
+            f"hub: {directory} requires missing packages {missing}")
+    return mod
+
+
+def _entrypoints(mod) -> List[str]:
+    return sorted(n for n, v in vars(mod).items()
+                  if callable(v) and not n.startswith("_"))
+
+
+def list(repo_dir: str, source: str = "github",
+         force_reload: bool = False) -> List[str]:   # noqa: A001
+    """Entrypoint names exported by the repo's hubconf.py."""
+    return _entrypoints(_import_hubconf(_repo_dir(repo_dir, source)))
+
+
+def help(repo_dir: str, model: str, source: str = "github",   # noqa: A002
+         force_reload: bool = False) -> Optional[str]:
+    """The docstring of one entrypoint."""
+    return getattr(_get_entry(repo_dir, model, source), "__doc__", None)
+
+
+def _get_entry(repo_dir: str, model: str, source: str) -> Callable:
+    mod = _import_hubconf(_repo_dir(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn) or model.startswith("_"):
+        raise RuntimeError(
+            f"hub: no entrypoint {model!r} in {repo_dir} "
+            f"(available: {_entrypoints(mod)})")
+    return fn
+
+
+def load(repo_dir: str, model: str, *args, source: str = "github",
+         force_reload: bool = False, **kwargs) -> Any:
+    """Call entrypoint `model` of the repo with the given arguments."""
+    return _get_entry(repo_dir, model, source)(*args, **kwargs)

@@ -133,7 +133,15 @@ class LlamaRotaryEmbedding(Layer):
 
 class LlamaAttention(Layer):
     """GQA attention: q/k/v column-parallel, o row-parallel; rope fused op;
-    flash_attention op (Pallas on TPU)."""
+    flash_attention op (Pallas on TPU).
+
+    Under tensor parallelism the op-level dispatcher resolves the fleet
+    topology (mp_layers.tp_attention_context) and runs the Pallas kernel
+    per head-shard inside a mesh-aware shard_map
+    (ops/kernels/pallas/tp_attention.py) — heads ride 'mp', batch rides
+    'dp', and the only mp collective in the block stays o_proj's psum.
+    Non-divisible head counts (e.g. kv_heads < tp) fall back to the XLA
+    composite with the reason in the flight recorder."""
 
     def __init__(self, config: LlamaConfig):
         super().__init__()

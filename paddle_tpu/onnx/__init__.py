@@ -1,0 +1,25 @@
+"""`paddle.onnx` — export stub.
+
+The reference delegates `paddle.onnx.export` to the external paddle2onnx
+wheel (python/paddle/onnx/export.py). An ONNX bridge is explicitly OUT
+of scope for the TPU build (SURVEY §2 / PARITY.md: TensorRT/ONNX
+bridges dropped): the supported deployment artifact is the StableHLO
+AOT bundle (`paddle_tpu.inference` `export_aot` / `export_pjrt_bundle`),
+which is hardware-portable across PJRT plugins and needs no operator
+re-mapping. This module exists so `paddle.onnx.export(...)` fails with
+that stance spelled out instead of an AttributeError.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Unsupported: raises with the supported alternative."""
+    raise NotImplementedError(
+        "paddle.onnx.export is not supported by the TPU build (the "
+        "reference delegates it to the external paddle2onnx package). "
+        "Export a hardware-portable StableHLO AOT artifact instead: "
+        "paddle_tpu.inference.Predictor.export_compiled(...) / "
+        "export_pjrt_bundle(...) — see PARITY.md 'surface long tail'.")

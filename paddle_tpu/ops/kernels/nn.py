@@ -661,15 +661,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, rng_key=None
 def ring_attention(query, key, value, is_causal=False, scale=None):
     """Sequence-parallel attention: q resident, K/V rotated over the `sep`
     ring (kernels/pallas/ring_attention.py). Requires an active hybrid
-    topology with sep_degree > 1; falls back to the composite otherwise."""
+    topology with sep_degree > 1; falls back to the composite otherwise.
+    When the topology ALSO has mp > 1 the heads co-shard over the mp
+    axis inside the same shard_map region (TP x SEP composition)."""
     from ...distributed.topology import get_hybrid_communicate_group
     hcg = get_hybrid_communicate_group()
     if hcg is None or hcg.get_sep_parallel_world_size() <= 1:
         return scaled_dot_product_attention(query, key, value,
                                             is_causal=is_causal, scale=scale)
     from .pallas import ring_attention as ra
+    head_axis = "mp" if hcg.get_model_parallel_world_size() > 1 else None
     return ra.ring_attention(query, key, value, hcg.mesh.mesh, "sep",
-                             causal=is_causal, scale=scale)
+                             causal=is_causal, scale=scale,
+                             head_axis=head_axis)
 
 
 @register_kernel("rope")
@@ -719,18 +723,36 @@ def rope(q, k=None, cos=None, sin=None, position_ids=None, rotate_half_style=Tru
 @register_kernel("flash_attention")
 def flash_attention(query, key, value, attn_mask=None, rng_key=None,
                     dropout_p=0.0, is_causal=False, scale=None):
-    """Routes to the Pallas flash kernel when enabled (ops/kernels/pallas),
-    else the XLA composite above."""
+    """Routes to the Pallas flash kernel when enabled (ops/kernels/pallas):
+    under an ambient TP mesh (fleet mp>1 or tp_shard_context) through the
+    shard_map'd per-head-shard entry — which composes with GSPMD instead
+    of aborting the SPMD partitioner — else the single-chip kernel; the
+    XLA composite otherwise (every fallback under TP records its reason
+    in the flight recorder)."""
     from ... import flags
-    if flags.get_flag("use_pallas_kernels") and attn_mask is None \
-            and dropout_p == 0.0:
+    if attn_mask is None and dropout_p == 0.0:
         try:
             from .pallas import flash_attention as fa
+            from .pallas import tp_attention as tpa
         except ImportError:
-            fa = None
-        if fa is not None and fa.supported(query.shape, key.shape, is_causal):
-            return fa.flash_attention(query, key, value, causal=is_causal,
-                                      scale=scale)
+            fa = tpa = None
+        if tpa is not None:
+            ctx = tpa.current_tp_context()
+            if ctx is not None:
+                if not flags.get_flag("use_pallas_kernels"):
+                    tpa.record_fallback("flash",
+                                        "FLAGS_use_pallas_kernels off")
+                else:
+                    mesh, head_axis, batch_axis = ctx
+                    out = tpa.sharded_flash_attention(
+                        query, key, value, mesh, head_axis, batch_axis,
+                        causal=is_causal, scale=scale)
+                    if out is not None:
+                        return out
+            elif (flags.get_flag("use_pallas_kernels")
+                  and fa.supported(query.shape, key.shape, is_causal)):
+                return fa.flash_attention(query, key, value,
+                                          causal=is_causal, scale=scale)
     return scaled_dot_product_attention(query, key, value, attn_mask=attn_mask,
                                         rng_key=rng_key, dropout_p=dropout_p,
                                         is_causal=is_causal, scale=scale)
@@ -742,10 +764,31 @@ def flash_attn_unpadded_kernel(q, k, v, cu_seqlens_q, cu_seqlens_k,
                                causal=False):
     """Packed varlen flash attention (reference flash_attn_kernel.cu:199).
     Pallas fwd+bwd with segment-id masks + per-block skip
-    (pallas/flash_varlen.py); runs in interpret mode off-TPU."""
-    from .pallas.flash_varlen import flash_attn_unpadded as fa
-    return fa(q, k, v, cu_seqlens_q, cu_seqlens_k,
-              scale=None if scale in (0.0, None) else scale, causal=causal)
+    (pallas/flash_varlen.py); runs in interpret mode off-TPU. Under an
+    ambient TP mesh the heads shard over the mp axis via shard_map
+    (pallas/tp_attention.py); the divisibility/flags fallback edges take
+    the dense segment-masked composite with a recorded reason."""
+    from ... import flags
+    from .pallas import flash_varlen as fv
+    from .pallas import tp_attention as tpa
+    scale = None if scale in (0.0, None) else scale
+    ctx = tpa.current_tp_context()
+    if ctx is not None:
+        mesh, head_axis, _ba = ctx
+        if not flags.get_flag("use_pallas_kernels"):
+            tpa.record_fallback("varlen", "FLAGS_use_pallas_kernels off")
+        else:
+            out = tpa.sharded_flash_varlen(
+                q, k, v, cu_seqlens_q, cu_seqlens_k, mesh, head_axis,
+                causal=causal, scale=scale,
+                tok_skip=bool(causal) and fv.same_cu_layout(cu_seqlens_q,
+                                                            cu_seqlens_k))
+            if out is not None:
+                return out
+        return fv.varlen_composite(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                                   scale=scale, causal=causal)
+    return fv.flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                                  scale=scale, causal=causal)
 
 
 # -- fused next-token CE (round-3 MFU work) ---------------------------------

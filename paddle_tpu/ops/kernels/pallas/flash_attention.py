@@ -35,10 +35,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Trace-time override for interpreter mode (None = decide from the host
+# backend). tp_attention.py sets it from the TARGET mesh platform while
+# tracing a shard_map region: a deviceless AOT lowering for a TPU
+# topology must embed the real Mosaic kernel even though the host
+# default_backend() is cpu (and vice versa for forced CPU meshes).
+_FORCE_INTERPRET = None
+
 
 def _interpret() -> bool:
     # CPU (tests / dev boxes) runs the kernels in interpreter mode so the
     # same code path is exercised without a TPU.
+    if _FORCE_INTERPRET is not None:
+        return _FORCE_INTERPRET
     return jax.default_backend() != "tpu"
 
 
@@ -107,9 +116,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[:, :1]
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
         # lse is stored [bh, 1, sq] (sublane-padded 8x only; a [bh, sq, 1]
-        # layout lane-pads 128x in HBM). (bq,1)->(1,bq) is an order-preserving
-        # vector reshape, once per q block.
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l)).reshape(1, bq)
+        # layout lane-pads 128x in HBM). (bq,1)->(1,bq) once per q block —
+        # spelled as a transpose, NOT a reshape: Mosaic's AOT layout
+        # inference rejects the implicit-dim reshape ("Unsupported
+        # implicit dim change") while the 2-d transpose compiles.
+        lse_ref[0] = jax.lax.transpose(m_scr[:, :1] + jnp.log(l), (1, 0))
 
 
 def _fwd(q, k, v, causal, scale):

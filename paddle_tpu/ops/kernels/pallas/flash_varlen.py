@@ -26,13 +26,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import _block
+from .flash_attention import _block, _interpret  # shared interpret override
 
 _NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _mask(segq, posq, segk, posk, causal):
@@ -93,7 +89,10 @@ def _fwd_kernel(ranges_ref, q_ref, k_ref, v_ref, sq_ref, pq_ref, sk_ref,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)   # fully-masked padding rows
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l_safe)).reshape(1, bq)
+        # transpose, not reshape: see flash_attention._fwd_kernel (Mosaic
+        # AOT rejects the (bq,1)->(1,bq) implicit-dim reshape)
+        lse_ref[0] = jax.lax.transpose(m_scr[:, :1] + jnp.log(l_safe),
+                                       (1, 0))
 
 
 # -- backward (transposed orientation, see flash_attention._dq_kernel) ------
@@ -381,6 +380,52 @@ def _varlen_bwd(causal, scale, tok_skip, carry, dout):
 _varlen.defvjp(_varlen_fwd, _varlen_bwd)
 
 
+def varlen_composite(q, k, v, cu_seqlens_q, cu_seqlens_k, scale=None,
+                     causal: bool = False):
+    """XLA composite over the packed layout (dense [Tq, Tk] scores with
+    segment-id masking) — the GSPMD-partitionable fallback the TP
+    dispatcher takes when the shard_map'd kernel can't (head counts not
+    divisible by the tp degree, FLAGS_use_pallas_kernels off)."""
+    Tq, h, d = q.shape
+    Tk, hk = k.shape[0], k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    segq, posq = _segments(cu_seqlens_q.astype(jnp.int32), Tq, Tq, -1)
+    segk, posk = _segments(cu_seqlens_k.astype(jnp.int32), Tk, Tk, -2)
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=1)
+        v = jnp.repeat(v, h // hk, axis=1)
+    logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    live = segq[:, None] == segk[None, :]
+    if causal:
+        live &= posk[None, :] <= posq[:, None]
+    logits = jnp.where(live[None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(live[None], probs, 0.0)  # fully-masked rows -> 0
+    return jnp.einsum("hqk,khd->qhd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def same_cu_layout(cu_seqlens_q, cu_seqlens_k) -> bool:
+    """Whether q and k share one packing — the precondition for the
+    token-space causal block skip. Valid only for self-attention
+    packing (identical cu layouts): same batch + same total token count
+    does NOT imply identical packing (q lens [1,199] vs k lens [199,1]),
+    so only array identity — which survives tracing — or an equal
+    concrete host-side comparison may enable it; otherwise the mask
+    alone enforces causality (correct, fewer skipped blocks)."""
+    if cu_seqlens_q is cu_seqlens_k:
+        return True
+    if isinstance(cu_seqlens_q, jax.core.Tracer) \
+            or isinstance(cu_seqlens_k, jax.core.Tracer):
+        return False
+    return (cu_seqlens_q.shape == cu_seqlens_k.shape
+            and bool((np.asarray(cu_seqlens_q)
+                      == np.asarray(cu_seqlens_k)).all()))
+
+
 def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q=None, max_seqlen_k=None, scale=None,
                         causal: bool = False):
@@ -391,19 +436,7 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
     d = q.shape[-1]
     if scale is None:
         scale = d ** -0.5
-    # token-space causal block skip is valid only for self-attention
-    # packing (identical cu layouts). Same batch + same total token count
-    # does NOT imply identical packing (q lens [1,199] vs k lens [199,1]),
-    # so only array identity — which survives tracing — or an equal
-    # concrete host-side comparison may enable it; otherwise the mask
-    # alone enforces causality (correct, fewer skipped blocks).
-    same_cu = cu_seqlens_q is cu_seqlens_k
-    if not same_cu and not (isinstance(cu_seqlens_q, jax.core.Tracer)
-                            or isinstance(cu_seqlens_k, jax.core.Tracer)):
-        same_cu = (cu_seqlens_q.shape == cu_seqlens_k.shape
-                   and bool((np.asarray(cu_seqlens_q)
-                             == np.asarray(cu_seqlens_k)).all()))
-    tok_skip = bool(causal) and same_cu
+    tok_skip = bool(causal) and same_cu_layout(cu_seqlens_q, cu_seqlens_k)
     return _varlen(q, k, v, cu_seqlens_q.astype(jnp.int32),
                    cu_seqlens_k.astype(jnp.int32), bool(causal),
                    float(scale), tok_skip)

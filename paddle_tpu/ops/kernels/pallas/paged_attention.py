@@ -29,11 +29,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .flash_attention import _interpret  # shared interpret override
+
 _NEG = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,

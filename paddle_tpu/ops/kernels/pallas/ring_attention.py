@@ -180,7 +180,7 @@ def _pallas_block_supported(q_shape, k_shape) -> bool:
 
 
 def ring_attention(query, key, value, mesh, axis_name: str = "sep",
-                   causal: bool = False, scale=None):
+                   causal: bool = False, scale=None, head_axis=None):
     """[b, s, h, d] attention with the seq dim sharded over `axis_name`.
 
     Same contract as flash_attention/scaled_dot_product_attention; the
@@ -188,24 +188,43 @@ def ring_attention(query, key, value, mesh, axis_name: str = "sep",
     Per-block math runs through the Pallas flash kernel when the local
     shard shape supports it (s/P >= 128, block-aligned), else the XLA
     composite blocks.
-    """
+
+    `head_axis` additionally shards the HEAD dim over a tensor-parallel
+    mesh axis inside the same region (GSPMD TP x SEP composition,
+    tp_attention.py stance): the ring body is head-independent, so each
+    (sep, mp) shard rotates only its local kv-head slice — ppermute
+    payloads shrink by the tp degree. Falls back to head-replicated
+    when the head counts don't divide the tp degree (recorded)."""
     d = query.shape[-1]
     if scale is None:
         scale = d ** -0.5
     num = mesh.shape[axis_name]
     sl = query.shape[1] // num
+    ha = None
+    if head_axis is not None and mesh.shape.get(head_axis, 1) > 1:
+        tp = mesh.shape[head_axis]
+        if query.shape[2] % tp == 0 and key.shape[2] % tp == 0:
+            ha = head_axis
+        else:
+            from .tp_attention import record_fallback
+            record_fallback(
+                "ring", f"heads {query.shape[2]}/{key.shape[2]} not "
+                        f"divisible by tp degree {tp} (head-replicated "
+                        f"ring instead)")
+    hdiv = mesh.shape[ha] if ha else 1
     use_pallas = _pallas_block_supported(
-        (query.shape[0], sl, query.shape[2], d),
-        (key.shape[0], sl, key.shape[2], d))
-    ck = (mesh, axis_name, num, causal, float(scale), use_pallas)
+        (query.shape[0], sl, query.shape[2] // hdiv, d),
+        (key.shape[0], sl, key.shape[2] // hdiv, d))
+    ck = (mesh, axis_name, ha, num, causal, float(scale), use_pallas)
     fn = _RING_CACHE.get(ck)
     if fn is None:
         body = _ring_local_pallas if use_pallas else _ring_local
         local = lambda q, k, v: body(q, k, v, axis_name, num,
                                      causal, float(scale))
-        spec = P(None, axis_name)
+        spec = P(None, axis_name) if ha is None else P(None, axis_name, ha)
         fn = jax.jit(shard_map(
             local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            axis_names=frozenset({axis_name}), check_vma=False))
+            axis_names=frozenset(a for a in (axis_name, ha) if a),
+            check_vma=False))
         _RING_CACHE[ck] = fn
     return fn(query, key, value)

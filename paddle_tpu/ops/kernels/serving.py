@@ -78,15 +78,32 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
     sequence, padded arbitrarily); context_lens[B] valid token counts.
     Routed to the Pallas block-table kernel (pallas/paged_attention.py —
     streams pool blocks into VMEM, no dense HBM gather) when
-    FLAGS_use_pallas_kernels; XLA gather+SDPA composite otherwise.
+    FLAGS_use_pallas_kernels; under an ambient TP mesh the q heads and
+    the pool's kv heads shard over the mp axis via shard_map
+    (pallas/tp_attention.py) so GSPMD-partitioned serving keeps the
+    fast path. XLA gather+SDPA composite otherwise (TP fallbacks record
+    their reason in the flight recorder).
     """
     from ... import flags
-    if (flags.get_flag("use_pallas_kernels")
-            and q.shape[1] == 1 and q.shape[3] == k_pool.shape[3]
-            and q.shape[2] % k_pool.shape[2] == 0):
-        from .pallas import paged_attention as pa
-        return pa.paged_attention(q, k_pool, v_pool, block_tables,
-                                  context_lens, scale)
+    decode_ok = (q.shape[1] == 1 and q.shape[3] == k_pool.shape[3]
+                 and q.shape[2] % k_pool.shape[2] == 0)
+    if decode_ok:
+        from .pallas import tp_attention as tpa
+        ctx = tpa.current_tp_context()
+        if ctx is not None:
+            if not flags.get_flag("use_pallas_kernels"):
+                tpa.record_fallback("paged", "FLAGS_use_pallas_kernels off")
+            else:
+                mesh, head_axis, batch_axis = ctx
+                out = tpa.sharded_paged_attention(
+                    q, k_pool, v_pool, block_tables, context_lens,
+                    mesh, head_axis, batch_axis, scale)
+                if out is not None:
+                    return out
+        elif flags.get_flag("use_pallas_kernels"):
+            from .pallas import paged_attention as pa
+            return pa.paged_attention(q, k_pool, v_pool, block_tables,
+                                      context_lens, scale)
     B = q.shape[0]
     nb, bs = k_pool.shape[0], k_pool.shape[1]
     mb = block_tables.shape[1]

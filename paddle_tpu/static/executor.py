@@ -11,6 +11,8 @@ so updates (optimizer ops / set_var) never retrace.
 
 from __future__ import annotations
 
+import itertools
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -109,6 +111,21 @@ def _replay(program: Program, env: Dict[str, jax.Array], key: jax.Array):
     return env
 
 
+# Per-Program cache identity. `id(program)` is NOT usable as a cache key:
+# a GC'd Program's id can be reallocated to a NEW Program, silently
+# replaying the dead program's executable on the wrong op list. Instead
+# every Program gets a process-unique serial on first touch (held in a
+# WeakKeyDictionary, so pickled/cloned Programs never inherit one), and a
+# weakref.finalize evicts the Program's cache entries when it dies.
+_PROGRAM_SERIALS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_NEXT_SERIAL = itertools.count()
+
+
+def _evict_program_entries(cache: Dict[Tuple, Any], serial: int) -> None:
+    for k in [k for k in cache if k[0] == serial]:
+        cache.pop(k, None)
+
+
 class Executor:
     """exe.run(program, feed=..., fetch_list=...) with per-(program, shapes)
     compiled executables (the _ExecutorCache analog)."""
@@ -117,6 +134,19 @@ class Executor:
         self.place = place
         self.scope = _global_scope
         self._cache: Dict[Tuple, Any] = {}
+        self._tracked: set = set()   # serials with an eviction finalizer
+
+    def _program_serial(self, program) -> int:
+        serial = _PROGRAM_SERIALS.get(program)
+        if serial is None:
+            serial = _PROGRAM_SERIALS[program] = next(_NEXT_SERIAL)
+        if serial not in self._tracked:
+            self._tracked.add(serial)
+            # the finalizer holds the cache DICT (not the Executor), so a
+            # dying Program drops its executables even mid-session
+            weakref.finalize(program, _evict_program_entries, self._cache,
+                             serial)
+        return serial
 
     def run(self, program: Optional[Program] = None,
             feed: Optional[Dict[str, Any]] = None,
@@ -146,17 +176,27 @@ class Executor:
         param_names = tuple(p.name for p in program.parameters())
         param_arrays = [self.scope.vars[n] for n in param_names]
 
-        cache_key = (id(program), len(program.global_block.ops), feed_names,
+        cache_key = (self._program_serial(program),
+                     len(program.global_block.ops), feed_names,
                      tuple((a.shape, str(a.dtype)) for a in feed_arrays),
                      tuple(fetch_names))
         compiled = self._cache.get(cache_key)
         if compiled is None:
             _M_EXEC_COMPILES.inc()
+            # weak capture: the cached executable must not pin the
+            # Program, or the death-eviction finalizer above never fires.
+            # Every legitimate call reaches fn through a cache key built
+            # from the LIVE program, so the deref cannot fail in use.
+            wp = weakref.ref(program)
 
             def fn(feed_vals, param_vals, seed):
+                prog = wp()
+                if prog is None:
+                    raise RuntimeError(
+                        "executor cache entry outlived its Program")
                 env = dict(zip(feed_names, feed_vals))
                 env.update(zip(param_names, param_vals))
-                env = _replay(program, env, jax.random.key(seed))
+                env = _replay(prog, env, jax.random.key(seed))
                 return [env[n] for n in fetch_names]
 
             compiled = jax.jit(fn)

@@ -35,6 +35,7 @@ _HEAVY_MODULES = {
     "test_linalg_fft", "test_domains_misc", "test_distribution",
     "test_fleet_utils", "test_sparse", "test_nn", "test_ops_ext",
     "test_hapi_metric", "test_capi", "test_autograd_functional",
+    "test_tp_attention",
 }
 
 

@@ -138,6 +138,25 @@ class TestCompactTail:
                    for c in last["detail"]["configs"])
 
 
+class TestTpAttentionMicro:
+    def test_micro_runs_and_reports(self):
+        """bench.py tp_attention smoke (ISSUE 4): the shard_map'd Pallas
+        flash vs the GSPMD composite under a tp>=2 mesh must produce a
+        well-formed entry on the forced multi-device CPU mesh."""
+        import jax
+        if jax.device_count() < 2:
+            pytest.skip("needs the forced multi-device CPU mesh")
+        r = bench.bench_tp_attention(False)
+        assert r is not None
+        assert r["metric"] == "tp_attention_us"
+        assert r["unit"] == "us/call"
+        assert r["value"] > 0.0
+        assert r["vs_baseline"] > 0.0
+        d = r["detail"]
+        assert "tp" in d["shape"]
+        assert d["xla_composite_us"] > 0.0
+
+
 class TestObservabilityMicro:
     def test_micro_runs_and_reports(self):
         """bench.py observability_overhead smoke: the micro must run on

@@ -122,6 +122,77 @@ class TestRoundTrip:
         np.testing.assert_array_equal(back["w"], full)
 
 
+class TestBf16Native:
+    """bf16-O2 checkpoints round-trip bf16-NATIVE (VERDICT r5 #8): the
+    payload pickles as a plain-numpy void ('V2') view with the true
+    dtype in the metadata box — no ml_dtypes GLOBAL in the stream, no
+    f32 widening, byte-exact bits."""
+
+    def test_bf16_sharded_roundtrip_exact(self, tmp_path):
+        import ml_dtypes
+        rng = np.random.RandomState(0)
+        full = rng.randn(6, 4).astype(ml_dtypes.bfloat16)   # O2 param
+        dc.save_reference_distcp(
+            {"w": full[:3]}, str(tmp_path), rank=0,
+            shards={"w": ((0, 0), full[:3])})
+        dc.save_reference_distcp(
+            {"w": full[3:]}, str(tmp_path), rank=1, unique_id=1,
+            shards={"w": ((3, 0), full[3:])})
+        back = dc.load_reference_distcp(str(tmp_path))
+        assert back["w"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(back["w"].view(np.uint16),
+                                      full.view(np.uint16))
+
+    def test_payload_pickles_without_ml_dtypes_global(self, tmp_path):
+        import ml_dtypes
+        import pickletools
+        arr = np.ones((2, 3), ml_dtypes.bfloat16)
+        dc.save_reference_distcp({"p": arr}, str(tmp_path))
+        blob = (tmp_path / "0_0.distcp").read_bytes()
+        texts = " ".join(str(a) for op, a, _pos in pickletools.genops(blob)
+                         if a is not None)
+        assert "ml_dtypes" not in texts   # plain-numpy void view only
+
+    def test_metadata_box_carries_dtype(self, tmp_path):
+        import ml_dtypes
+        dc.save_reference_distcp(
+            {"b": np.ones((2,), ml_dtypes.bfloat16),
+             "f": np.ones((2,), np.float32)}, str(tmp_path))
+        md = dc._unpickle(str(tmp_path / "0.metadata"))
+        assert md.state_dict_metadata["b"][0].dtype == "bfloat16"
+        assert md.state_dict_metadata["f"][0].dtype == "float32"
+
+    def test_legacy_boxes_without_dtype_still_load(self, tmp_path):
+        # pickles written before the dtype field existed deserialize to
+        # boxes missing the attribute; payload dtype rules then
+        _reference_style_fixture(str(tmp_path))
+        md = dc._unpickle(str(tmp_path / "0.metadata"))
+        for boxes in md.state_dict_metadata.values():
+            for b in boxes:
+                if hasattr(b, "dtype"):
+                    del b.dtype
+        with dc._install_ref_module_stubs():
+            with open(tmp_path / "0.metadata", "wb") as f:
+                pickle.dump(md, f)
+        out = dc.load_reference_distcp(str(tmp_path))
+        assert out["w1"].dtype == np.float32
+
+    def test_native_to_reference_keeps_bf16(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import ml_dtypes
+        state = {"p": jnp.asarray(np.arange(8, dtype=np.float32)
+                                  .reshape(2, 4)).astype(jnp.bfloat16)}
+        ckpt.save_state_dict(state, str(tmp_path / "native"))
+        dc.convert_to_reference(str(tmp_path / "native"),
+                                str(tmp_path / "ref"))
+        back = dc.load_reference_distcp(str(tmp_path / "ref"))
+        assert back["p"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(
+            back["p"].view(np.uint16),
+            np.asarray(jax.device_get(state["p"])).view(np.uint16))
+
+
 class TestConverters:
     def test_reference_to_native_loads_with_reshard(self, tmp_path):
         import jax.numpy as jnp

@@ -78,6 +78,55 @@ class TestExecutor:
         exe.run(prog, feed={"x": np.zeros(4, np.float32)}, fetch_list=[out])
         assert len(exe._cache) == n  # same shapes -> same executable
 
+    def test_dead_program_never_replays_stale_executable(self):
+        """The cache key must not be id(program): a GC'd-and-reallocated
+        Program could silently replay the dead program's executable.
+        Keys are per-Program serials (never reused) and a dying Program
+        evicts its own entries."""
+        import gc
+
+        def make(scale):
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [4])
+                out = x * scale
+            return prog, out
+
+        exe = static.Executor()
+        feed = {"x": np.ones(4, np.float32)}
+        results = []
+        # churn Programs with IDENTICAL op counts / feeds / fetch names
+        # so any id-reuse collision would reuse a stale executable and
+        # return the previous scale's result
+        for scale in (2.0, 3.0, 4.0, 5.0):
+            prog, out = make(scale)
+            (got,) = exe.run(prog, feed=feed, fetch_list=[out])
+            results.append(float(got[0]))
+            del prog, out
+            gc.collect()
+        assert results == [2.0, 3.0, 4.0, 5.0]
+        # eviction: dead programs left no cache entries behind
+        assert len(exe._cache) == 0
+
+    def test_live_programs_keep_distinct_entries(self):
+        exe = static.Executor()
+        progs = []
+        for scale in (2.0, 3.0):
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [4])
+                out = x * scale
+            progs.append((prog, out))
+        feed = {"x": np.ones(4, np.float32)}
+        for prog, out in progs:
+            exe.run(prog, feed=feed, fetch_list=[out])
+        assert len(exe._cache) == 2
+        # repeat runs hit the cache (no growth), results stay correct
+        (a,) = exe.run(progs[0][0], feed=feed, fetch_list=[progs[0][1]])
+        (b,) = exe.run(progs[1][0], feed=feed, fetch_list=[progs[1][1]])
+        assert (float(a[0]), float(b[0])) == (2.0, 3.0)
+        assert len(exe._cache) == 2
+
     def test_parameters_persist_in_scope(self):
         prog = static.Program()
         with static.program_guard(prog):
