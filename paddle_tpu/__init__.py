@@ -109,6 +109,7 @@ from . import optimizer  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import amp  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
+from .jit import jit_step  # noqa: E402,F401 — whole-step capture API
 from . import framework  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import geometric  # noqa: E402,F401

@@ -447,12 +447,15 @@ def _plan_fused(tensors, grad_tensors) -> Optional[_FusedPlan]:
                       ext_seeds)
 
 
-def _build_fused_runner(plan: _FusedPlan):
-    """jit-compile the whole reverse walk: (node primals, seeds) -> leaf
-    grads. Closes over the vjp callables of the CURRENT tape — for keyed
+def _make_runner(plan: _FusedPlan):
+    """Pure reverse-walk runner: (node primals, seeds) -> leaf grads.
+    Closes over the vjp callables of the CURRENT tape — for keyed
     nodes those are pure functions of (primals, cts) built from the
     shared exec cache, so replaying the traced program on a later tape
-    with the same signature is exact (no arrays are baked in)."""
+    with the same signature is exact (no arrays are baked in). Jitted
+    by the fused-backward cache; called INLINE (unjitted) when a
+    step-capture trace is ambient, so the outer whole-step executable
+    absorbs the walk."""
     vjps = [n.vjp_callable for n in plan.nodes]
     out_avals = [n.out_avals for n in plan.nodes]
     edges = plan.edges
@@ -498,7 +501,42 @@ def _build_fused_runner(plan: _FusedPlan):
             slots[pos] = None            # free traced intermediates early
         return leaf_g
 
-    return jax.jit(run)
+    return run
+
+
+def _build_fused_runner(plan: _FusedPlan):
+    return jax.jit(_make_runner(plan))
+
+
+# Step-capture integration (jit/step_capture.py): non-None while a
+# whole-step capture trace is active. backward() then runs the planner's
+# reverse walk INLINE inside the ambient trace (the outer executable
+# fuses it), and walks the planner can't express — tensor hooks,
+# structurally-unkeyed nodes — or higher-order requests abort the
+# capture so the step replays on the exact eager path instead.
+_CAPTURE = None
+
+
+def _capture_backward(cap, tensors, grad_tensors, retain_graph,
+                      accumulate_ids) -> None:
+    """Run the whole reverse walk inline under the ambient capture trace."""
+    plan = _plan_fused(tensors, grad_tensors)
+    if plan is None:
+        cap.abort("tape has tensor hooks or structurally-unkeyed nodes "
+                  "(sot/to_static segments)")
+    if plan.leaf_tensors:
+        prims = tuple([n.primals for n in plan.nodes])
+        results = _make_runner(plan)(prims, plan.ext_seeds)
+        for t, g in zip(plan.leaf_tensors, results):
+            if accumulate_ids is not None and id(t) not in accumulate_ids:
+                continue
+            if t._grad is None:
+                t._grad = Tensor(g)
+            else:
+                t._grad._set_data(t._grad._data + g)
+    if not retain_graph:
+        for t in tensors:
+            _free_graph(t)
 
 
 def _fused_backward(tensors, grad_tensors, retain_graph,
@@ -586,6 +624,16 @@ def backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]]
     deposited into their .grad too (functional grad() with intermediate
     inputs — the walk normally flows THROUGH non-leaves without storing)."""
     _M_BACKWARD.inc()
+    if _CAPTURE is not None:
+        if create_graph:
+            _CAPTURE.abort("backward(create_graph=True) inside a "
+                           "captured step")
+        if capture:
+            _CAPTURE.abort("functional grad() capture inside a "
+                           "captured step")
+        _capture_backward(_CAPTURE, tensors, grad_tensors, retain_graph,
+                          accumulate_ids)
+        return
     if not create_graph and not capture and _fused_enabled():
         if _fused_backward(tensors, grad_tensors, retain_graph,
                            accumulate_ids):

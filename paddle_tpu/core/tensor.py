@@ -23,6 +23,14 @@ import numpy as np
 
 from . import dtype as dtype_mod
 
+# Step-capture integration (jit/step_capture.py): during a discovery run
+# every buffer rebind is reported so mutated persistent tensors (params,
+# BN running stats) become donated I/O of the captured whole-step
+# program; during the capture trace it guards against writes that escape
+# the captured state set. Called with (tensor, incoming_array) BEFORE the
+# rebind. None keeps _set_data at one extra global read.
+_MUTATION_HOOK = None
+
 
 class Tensor:
     __slots__ = (
@@ -77,8 +85,23 @@ class Tensor:
 
     def _set_data(self, arr: jax.Array):
         """In-place rebind of the underlying buffer (version bump)."""
+        if _MUTATION_HOOK is not None:
+            _MUTATION_HOOK(self, arr)   # before rebind: hook sees old+new
         self._data = arr
         self._version += 1
+
+    def _rebind_donated(self, arr: jax.Array):
+        """Rebind after a donated whole-step replay (jit/step_capture.py).
+
+        The previous buffer was CONSUMED by XLA donation, so any tape
+        reference to it is stale — drop the producing-node edge along
+        with the buffer so a later backward can never walk into a
+        deleted array. The mutation hook is intentionally skipped: the
+        replay itself must not look like user mutation to a probe."""
+        self._data = arr
+        self._version += 1
+        self._node = None
+        self._out_idx = 0
 
     @property
     def shape(self):

@@ -38,8 +38,46 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "topology_mesh", "llama_param_pspecs", "lower_llama_train_step",
-    "collective_stats", "plan_llama3_8b_v5p64",
+    "collective_stats", "projected_throughput", "plan_llama3_8b_v5p64",
 ]
+
+# v5p single-chip peaks (bf16 dense MXU + HBM3): the roofline the
+# projected-throughput estimate is measured against.
+V5P_PEAK_FLOPS = 459e12       # bf16 FLOP/s per chip
+V5P_HBM_BYTES_PER_S = 2765e9  # HBM bandwidth per chip
+
+
+def projected_throughput(compiled, global_batch: int, seq: int,
+                         peak_flops: float = V5P_PEAK_FLOPS,
+                         hbm_bytes_per_s: float = V5P_HBM_BYTES_PER_S
+                         ) -> Dict:
+    """Roofline step-time estimate from the compiled executable's own
+    cost analysis: per-chip FLOPs and HBM traffic of the SPMD program
+    vs device peaks. Closes the VERDICT gap of plans that prove FIT
+    (live-HBM) but project no THROUGHPUT — the estimate is what the
+    hardware allows if the latency-hiding scheduler fully overlaps
+    collectives, i.e. an upper bound the live run is measured against."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):   # older jax returns [dict]
+        cost = cost[0]
+    flops = float(cost.get("flops", 0.0))
+    traffic = float(cost.get("bytes accessed", 0.0))
+    t_compute = flops / peak_flops
+    t_memory = traffic / hbm_bytes_per_s
+    step_s = max(t_compute, t_memory)
+    tokens = float(global_batch * seq)
+    return {
+        "flops_per_chip": flops,
+        "hbm_bytes_per_chip": traffic,
+        "compute_seconds": round(t_compute, 6),
+        "memory_seconds": round(t_memory, 6),
+        "bound": "compute" if t_compute >= t_memory else "memory",
+        "step_seconds": round(step_s, 6),
+        "tokens_per_sec": round(tokens / step_s, 1) if step_s else None,
+        # fraction of the projected step the MXUs are busy — the MFU
+        # ceiling this layout can reach on this topology
+        "mfu_upper_bound": round(t_compute / step_s, 4) if step_s else None,
+    }
 
 
 def topology_mesh(topology: str, axis_shape: Dict[str, int],
@@ -308,4 +346,7 @@ def plan_llama3_8b_v5p64(tp: int = 8, dp: int = 8,
     # evidence the flash kernel actually lowered as Mosaic custom calls
     # (0 would mean the shard_map'd Pallas path silently fell back)
     out["pallas_custom_calls"] = hlo.count("tpu_custom_call")
+    # roofline projection alongside the live-HBM fit evidence
+    out["projected"] = projected_throughput(
+        compiled, global_batch=batch_per_dp * dp, seq=seq)
     return out

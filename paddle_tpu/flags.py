@@ -150,6 +150,15 @@ define_flag("fused_backward", True,
             "(autograd/engine.py). First sight of a structure, and walks "
             "with tensor hooks / create_graph / capture, use the per-node "
             "walk; the signature cache is bounded")
+define_flag("step_capture", True,
+            "whole-step capture (jit/step_capture.py): trace a repeated "
+            "training step — eager forward, tape backward, grad clip and "
+            "optimizer update — into ONE donated, structure-cached XLA "
+            "executable and replay it. Gates both the explicit "
+            "paddle_tpu.jit_step API and hapi.Model.train_batch "
+            "auto-capture; unfusable steps (tensor hooks, create_graph, "
+            "data-dependent control flow, dynamic shapes) fall back to "
+            "the eager path with the reason in the flight recorder")
 define_flag("use_pallas_kernels", True, "route hot ops to Pallas hand kernels")
 define_flag("benchmark", False, "block on every op for accurate timing")
 define_flag("comm_timeout_s", 600.0,

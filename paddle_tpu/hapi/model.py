@@ -49,6 +49,7 @@ class Model:
         self._metrics: List[Metric] = []
         self._optimizer = None
         self._train_step = None   # compiled TrainStep when jit=True
+        self._captured_step = None  # FLAGS_step_capture auto-capture
         self._jit = False
         self.stop_training = False
 
@@ -67,6 +68,7 @@ class Model:
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, jit=False):
         self._optimizer = optimizer
+        self._captured_step = None   # new opt/loss: stale capture closure
         if loss is not None and not (isinstance(loss, Layer)
                                      or callable(loss)):
             raise TypeError("loss must be a Layer or a callable")
@@ -132,6 +134,30 @@ class Model:
             with no_grad():
                 outputs = _to_list(self.network(*inputs))
                 loss = self._loss_value(outputs, labels)
+            return self._with_metric_results(outputs, labels,
+                                             [float(np.asarray(loss._data))])
+
+        # FLAGS_step_capture: after one eager probe the whole eager step
+        # (fwd + tape backward + opt.step/clear_grad) replays as ONE
+        # donated XLA executable (jit/step_capture.py); outputs come back
+        # from the same step, so metrics see the train-mode forward
+        # exactly as the eager path does. Unfusable steps transparently
+        # run the eager body below via the capture's own fallback.
+        from .. import flags as _flags
+        if _flags.get_flag("step_capture"):
+            if self._captured_step is None:
+                from ..jit.step_capture import jit_step
+
+                def _eager_step(ins, lbs):
+                    outputs = self._forward_amp(list(ins))
+                    loss = self._loss_value(outputs, list(lbs))
+                    loss.backward()
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+                    return loss, outputs
+
+                self._captured_step = jit_step(_eager_step)
+            loss, outputs = self._captured_step(tuple(inputs), tuple(labels))
             return self._with_metric_results(outputs, labels,
                                              [float(np.asarray(loss._data))])
 

@@ -360,7 +360,15 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
-        self.prefetch_factor = max(2, prefetch_factor)
+        # honored as given: prefetch_factor=1 means "at most one batch in
+        # flight" (lowest host-memory pressure); the reference validates
+        # >= 1 rather than silently clamping to 2
+        if int(prefetch_factor) < 1:
+            raise ValueError(
+                f"prefetch_factor must be >= 1, got {prefetch_factor} "
+                f"(1 = single batch in flight, larger values deepen the "
+                f"prefetch queue)")
+        self.prefetch_factor = int(prefetch_factor)
         self.use_buffer_reader = use_buffer_reader
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn

@@ -278,6 +278,16 @@ _STATIC_MODE_FN = None
 # SOT-lite integration (jit/sot.py): while tracing, every eager op is
 # mirrored into the recorder's linear trace (ops still execute normally).
 _SOT_RECORDER = None
+
+# Step-capture integration (jit/step_capture.py). _STEP_TRACE is non-None
+# while a whole-step capture trace is active: dispatch then BYPASSES the
+# per-op exec-cache jit and calls the pure-jnp kernel inline, so the
+# ambient jax trace sees the entire step as one program instead of a
+# chain of nested pjit calls. _STEP_PROBE is non-None during a discovery
+# (eager) run: every leaf input tensor is reported so persistent closure
+# state becomes traced I/O of the captured executable.
+_STEP_TRACE = None
+_STEP_PROBE = None
 _EAGER_OP_COUNT = 0   # eager-loop steering counter
 _EAGER_WARNED = False
 _F_EAGER_WARN = None  # cached _Flag object (set lazily; registry import order)
@@ -427,6 +437,9 @@ def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
                 v = dtype_mod.convert_dtype(v)
             attrs[p.name] = v
 
+    if _STEP_PROBE is not None:
+        _STEP_PROBE.on_op(in_tensors)
+
     if _amp_cast_hook is not None:
         primals = _amp_cast_hook(schema, primals)
 
@@ -455,7 +468,11 @@ def _dispatch_impl(schema: OpSchema, arguments: Dict[str, Any]):
                   for p in primals),
             (schema.kernel, attrs_key if hashable else None))
 
-    use_jit = schema.jit and flags.get_flag("eager_op_jit") and hashable
+    # trace-through dispatch: under an ambient step-capture trace the
+    # kernel runs inline (pure jnp on tracers) — the outer jit is the
+    # only executable, and XLA fuses the whole step
+    use_jit = (schema.jit and flags.get_flag("eager_op_jit") and hashable
+               and _STEP_TRACE is None)
 
     if hashable:
         dmask = tuple(
@@ -789,6 +806,7 @@ def _dispatch_binary_fast(schema, attrs_key, a: Tensor, b):
     if (_STATIC_MODE_FN is not None and _STATIC_MODE_FN()) \
             or _OP_SPAN_HOOK is not None or _SOT_RECORDER is not None \
             or _TENSOR_STATS_HOOK is not None \
+            or _STEP_TRACE is not None or _STEP_PROBE is not None \
             or (_amp_cast_hook is not None and _AMP_STATE["enable"]) \
             or _F_CHECK_NAN.value:
         return None

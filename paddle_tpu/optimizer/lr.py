@@ -6,6 +6,13 @@ from __future__ import annotations
 import math
 from typing import Callable, List, Optional
 
+# Step-capture probe (jit/step_capture.py): during a discovery run each
+# scheduler step() is reported so replays of the captured executable can
+# re-apply the same host-side LR advance (a no-arg step() is pure host
+# bookkeeping; one with an explicit epoch or metric marks the step
+# unfusable).
+_PROBE = None
+
 
 class LRScheduler:
     def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1,
@@ -23,6 +30,8 @@ class LRScheduler:
         raise NotImplementedError
 
     def step(self, epoch: Optional[int] = None):
+        if _PROBE is not None:
+            _PROBE.saw_scheduler_step(self, epoch)
         if epoch is None:
             self.last_epoch += 1
         else:
@@ -192,6 +201,9 @@ class ReduceOnPlateau(LRScheduler):
         return self._current
 
     def step(self, metrics=None, epoch=None):
+        if _PROBE is not None:
+            _PROBE.saw_scheduler_step(self, metrics if metrics is not None
+                                      else epoch)
         self.last_epoch += 1
         if metrics is None:
             self.last_lr = self._current

@@ -21,6 +21,17 @@ from ..core.tensor import Tensor
 from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
+# Step-capture integration (jit/step_capture.py). _PROBE is non-None
+# during a discovery run: step() reports itself so the capture knows
+# which optimizers' params/state/lr become donated I/O of the compiled
+# step. _CAPTURE is non-None while the capture trace is active: step()
+# then applies the pure _update rules INLINE with the trace's lr and
+# step scalars (traced inputs — a host int would bake the bias
+# correction of the capture step into every replay) instead of the
+# donated per-instance jit.
+_CAPTURE = None
+_PROBE = None
+
 
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
@@ -91,6 +102,10 @@ class Optimizer:
 
     # -- step ----------------------------------------------------------------
     def step(self):
+        if _PROBE is not None:
+            _PROBE.saw_optimizer(self)
+        if _CAPTURE is not None and self._state_shardings:
+            _CAPTURE.abort("ZeRO state sharding active on the optimizer")
         params, grads, idxs = [], [], []
         for i, p in enumerate(self._parameter_list):
             if p.grad is None or p.stop_gradient:
@@ -134,10 +149,19 @@ class Optimizer:
             getattr(self._parameter_list[i]._data, "sharding", None)
             for i in idxs)
 
-        new_p, new_s = _apply_pytree_update(
-            self, self._update_static_key(),
-            tuple(p_arrays), g_arrays, s_pytree,
-            jnp.asarray(lr, jnp.float32), self._step_count, wd_arrays)
+        if _CAPTURE is not None:
+            # in-trace application: the ambient whole-step jit is the
+            # only executable, and lr/step arrive as traced inputs so a
+            # replayed step keeps advancing bias corrections and LR
+            new_p, new_s = self._inline_update(
+                tuple(p_arrays), g_arrays, s_pytree,
+                _CAPTURE.traced_lr(self), _CAPTURE.traced_step(self),
+                wd_arrays)
+        else:
+            new_p, new_s = _apply_pytree_update(
+                self, self._update_static_key(),
+                tuple(p_arrays), g_arrays, s_pytree,
+                jnp.asarray(lr, jnp.float32), self._step_count, wd_arrays)
 
         for k, i in enumerate(idxs):
             p = self._parameter_list[i]
@@ -158,6 +182,16 @@ class Optimizer:
     def _update_static_key(self):
         """Hashable config that changes the compiled update rule."""
         return (self._weight_decay,)
+
+    def _inline_update(self, p_tuple, g_tuple, s_tuple, lr, step, wd_tuple):
+        """The ONE per-param application of the pure _update rules (grad
+        cast included). _apply_pytree_update jits it with donation/pins;
+        an ambient step-capture trace calls it directly, so eager and
+        captured steps can never diverge on cast/update semantics."""
+        outs = [self._update(p, g.astype(p.dtype) if g.dtype != p.dtype else g,
+                             s, lr, step, wd)
+                for p, g, s, wd in zip(p_tuple, g_tuple, s_tuple, wd_tuple)]
+        return tuple(x[0] for x in outs), tuple(x[1] for x in outs)
 
     def clear_grad(self, set_to_zero: bool = False):
         for p in self._parameter_list:
@@ -252,11 +286,8 @@ def _apply_pytree_update(opt, static_key, p_tuple, g_tuple, s_tuple, lr, step,
 
         def run(p_tuple, g_tuple, s_tuple, lr, step, wd_tuple):
             o = ref()
-            outs = [o._update(p, g.astype(p.dtype) if g.dtype != p.dtype else g,
-                              s, lr, step, wd)
-                    for p, g, s, wd in zip(p_tuple, g_tuple, s_tuple, wd_tuple)]
-            new_p = tuple(x[0] for x in outs)
-            new_s = tuple(x[1] for x in outs)
+            new_p, new_s = o._inline_update(p_tuple, g_tuple, s_tuple,
+                                            lr, step, wd_tuple)
             if p_sh is not None:
                 new_p = tuple(_pin(x, sh) for x, sh in zip(new_p, p_sh))
                 new_s = tuple({k2: _pin(v, sh.get(k2)) for k2, v in st.items()}

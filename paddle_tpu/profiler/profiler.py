@@ -45,6 +45,7 @@ class TracerEventType(Enum):
     PythonOp = 7
     PythonUserDefined = 8
     UserDefined = 9
+    StepCapture = 10   # whole-step captured executable (jit/step_capture)
 
 
 # -- host event recorder ------------------------------------------------------
@@ -125,10 +126,17 @@ class RecordEvent:
 def _op_span_hook(op_name: str):
     # the autograd engine surfaces its walk here too: per-node vjp calls
     # as "grad::<op>" and the structure-cached single-executable walk as
-    # "fused_backward" — both typed Backward so summaries split fwd/bwd
-    et = (TracerEventType.Backward
-          if op_name.startswith("grad::") or op_name == "fused_backward"
-          else TracerEventType.Operator)
+    # "fused_backward" — both typed Backward so summaries split fwd/bwd.
+    # Whole-step capture replays ("step_capture") and capture traces
+    # ("step_capture::capture") get their own phase: one span covers
+    # fwd+bwd+optimizer, so typing it Operator/Backward would corrupt
+    # both aggregates.
+    if op_name.startswith("grad::") or op_name == "fused_backward":
+        et = TracerEventType.Backward
+    elif op_name.startswith("step_capture"):
+        et = TracerEventType.StepCapture
+    else:
+        et = TracerEventType.Operator
     return RecordEvent(op_name, et)
 
 

@@ -43,9 +43,41 @@ _HEAVY_TESTS = {
     "test_multiprocess_rendezvous",   # 4-process TCPStore barrier, ~17s
 }
 
+# -- tier-1 runtime audit (PR 4) ---------------------------------------------
+# The tier-1 budget is 870s and the SEED already overran it on this host
+# (timeout at ~96%, 1024/1095 dots). These are the slowest REDUNDANT
+# parametrizations — coverage another tier-1 test keeps — moved to
+# `slow` so the suite finishes inside the budget (the full suite still
+# runs them without `-m 'not slow'`). Durations from this host's
+# profiled run; the per-process ~460s TPU topology-client init that
+# test_v5p_aot pays is NOT markable — it lands on whichever topology
+# test runs first.
+_SLOW_TESTS = {
+    # second full v5p plan compile (~17s + recompile pressure); ZeRO-1
+    # state-sharding semantics stay covered by test_sharding_stages
+    ("test_v5p_aot", "test_zero1_shrinks_per_chip_state"),
+    # 16s training smoke on the same YOLOv3 whose forward/loss/predict
+    # test stays tier-1
+    ("test_detection", "test_training_reduces_loss"),
+    # vision-zoo forward-only dups of the same conv/BN machinery;
+    # resnet18/50, vgg and alexnet remain tier-1
+    ("test_vision", "test_densenet121"),
+    ("test_vision", "test_mobilenet_v2"),
+    ("test_vision", "test_mobilenet_v3_small"),
+    ("test_vision", "test_inception_v3"),
+    ("test_vision", "test_googlenet"),
+    ("test_vision", "test_squeezenet"),
+    ("test_vision", "test_shufflenet_v2"),
+    # 11s two-process elastic rerank end-to-end; the other elastic /
+    # launch paths (rendezvous, scale events) remain tier-1
+    ("test_launch", "test_node_death_reranks_survivors"),
+}
+
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if (item.module.__name__ in _HEAVY_MODULES
                 or item.originalname in _HEAVY_TESTS):
             item.add_marker(pytest.mark.heavy)
+        if (item.module.__name__, item.originalname) in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)

@@ -157,6 +157,25 @@ class TestTpAttentionMicro:
         assert d["xla_composite_us"] > 0.0
 
 
+class TestStepCaptureMicro:
+    def test_micro_runs_and_reports(self):
+        """bench.py step_capture smoke (ISSUE 5): captured vs eager
+        fwd+bwd+opt on a dispatch-bound model must produce a well-formed
+        entry on CPU, with the capture actually engaging."""
+        r = bench.bench_step_capture(False)
+        assert r["metric"] == "step_capture_step_us"
+        assert r["unit"] == "us/step"
+        assert r["value"] > 0.0
+        d = r["detail"]
+        assert d["mlp_eager_us_per_step"] > 0.0
+        assert d["bert_tiny_captured_ms_per_step"] > 0.0
+        assert d["counters"]["captures"] >= 2    # mlp + hapi bert both
+        # the flag the micro toggles must be restored afterwards
+        import paddle_tpu as paddle
+        got = paddle.get_flags(["FLAGS_step_capture"])
+        assert got["FLAGS_step_capture"] is True
+
+
 class TestObservabilityMicro:
     def test_micro_runs_and_reports(self):
         """bench.py observability_overhead smoke: the micro must run on

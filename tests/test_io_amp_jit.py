@@ -63,6 +63,21 @@ class TestDataLoader:
         xb, yb = next(iter(paddle.io.DataLoader(ds, batch_size=4)))
         assert xb.shape == [4, 2] and yb.shape == [4]
 
+    def test_prefetch_factor_one_honored(self):
+        # regression: prefetch_factor used to be silently clamped to
+        # max(2, ...) — 1 must mean exactly one batch in flight
+        ds = paddle.io.TensorDataset([np.arange(8, dtype=np.float32)])
+        loader = paddle.io.DataLoader(ds, batch_size=2, prefetch_factor=1)
+        assert loader.prefetch_factor == 1
+        batches = [b[0].numpy() for b in loader]
+        np.testing.assert_array_equal(np.concatenate(batches).ravel(),
+                                      np.arange(8))
+
+    def test_prefetch_factor_below_one_rejected(self):
+        ds = paddle.io.TensorDataset([np.arange(8, dtype=np.float32)])
+        with pytest.raises(ValueError, match="prefetch_factor must be >= 1"):
+            paddle.io.DataLoader(ds, batch_size=2, prefetch_factor=0)
+
     def test_distributed_batch_sampler_shards(self):
         ds = paddle.io.TensorDataset([np.arange(16, dtype=np.float32)])
         s0 = paddle.io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,

@@ -1,0 +1,631 @@
+"""Whole-step capture (jit/step_capture.py): the captured executable must
+match the eager step exactly — allclose values, bit-identical dtypes —
+across the optimizer zoo x {LR scheduler, grad clip, bf16 masters};
+every unfusable edge must replay the eager path with its reason visible
+in the flight recorder; the structure cache must stay bounded and
+invalidate on mesh-epoch bumps."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import step_capture as sc
+from paddle_tpu.observability import flight_recorder as fr
+
+
+@pytest.fixture(autouse=True)
+def _capture_on():
+    paddle.set_flags({"FLAGS_step_capture": True})
+    yield
+    paddle.set_flags({"FLAGS_step_capture": True})
+
+
+def f32(seed, *shape):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+OPTIMIZERS = {
+    "sgd": lambda lr, params, clip: paddle.optimizer.SGD(
+        learning_rate=lr, parameters=params, grad_clip=clip),
+    "momentum": lambda lr, params, clip: paddle.optimizer.Momentum(
+        learning_rate=lr, momentum=0.9, parameters=params, grad_clip=clip),
+    "adam": lambda lr, params, clip: paddle.optimizer.Adam(
+        learning_rate=lr, parameters=params, grad_clip=clip),
+    "adamw": lambda lr, params, clip: paddle.optimizer.AdamW(
+        learning_rate=lr, weight_decay=0.01, parameters=params,
+        grad_clip=clip),
+    "lamb": lambda lr, params, clip: paddle.optimizer.Lamb(
+        learning_rate=lr, parameters=params, grad_clip=clip),
+}
+
+
+def _train(opt_name, variant, captured, n_steps=4):
+    """Build a tiny net, train n_steps, return (losses, params, masters,
+    opt, net). Identical seeds so eager and captured runs see the same
+    initialization and data."""
+    paddle.set_flags({"FLAGS_step_capture": captured})
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3))
+    if variant == "bf16":
+        net.to(dtype="bfloat16")
+    lr = (paddle.optimizer.lr.StepDecay(0.05, step_size=2, gamma=0.5)
+          if variant == "sched" else 0.05)
+    clip = nn.ClipGradByGlobalNorm(1.0) if variant == "clip" else None
+    opt = OPTIMIZERS[opt_name](lr, net.parameters(), clip)
+    ce = nn.CrossEntropyLoss()
+
+    def step(x, y):
+        out = net(x)
+        if variant == "bf16":
+            out = out.astype("float32")
+        loss = ce(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if variant == "sched":
+            lr.step()
+        return loss
+
+    fn = paddle.jit_step(step) if captured else step
+    y = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+    losses = []
+    for i in range(n_steps):
+        x = paddle.to_tensor(f32(i, 4, 6))
+        if variant == "bf16":
+            x = x.astype("bfloat16")
+        losses.append(float(fn(x, y)))
+    return losses, [p._data for p in net.parameters()], opt
+
+
+def _assert_equiv(opt_name, variant):
+    # bf16 intermediates round at op boundaries eagerly but fuse inside
+    # the captured executable — agreement is bounded by bf16 epsilon
+    # (2^-8), not float32's. dtypes must still match EXACTLY.
+    rtol, atol = (1e-2, 1e-3) if variant == "bf16" else (2e-5, 2e-6)
+    le, pe, oe = _train(opt_name, variant, captured=False)
+    before = dict(sc.capture_counters)
+    lc, pc, oc = _train(opt_name, variant, captured=True)
+    after = dict(sc.capture_counters)
+    assert after["captures"] > before["captures"], \
+        "capture never engaged — test is vacuous"
+    assert after["replays"] > before["replays"]
+    np.testing.assert_allclose(le, lc, rtol=rtol, atol=atol)
+    for a, b in zip(pe, pc):
+        assert a.dtype == b.dtype          # exact dtype, not just values
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=rtol, atol=atol)
+    assert oe._step_count == oc._step_count
+    assert oe.get_lr() == oc.get_lr()      # scheduler replayed on host
+    for se, scap in zip(oe._states, oc._states):
+        if se is None:
+            assert scap is None
+            continue
+        for k in se:
+            assert se[k].dtype == scap[k].dtype
+            np.testing.assert_allclose(
+                np.asarray(se[k], np.float32),
+                np.asarray(scap[k], np.float32), rtol=rtol, atol=atol)
+    for me, mc in zip(oe._masters, oc._masters):
+        assert (me is None) == (mc is None)
+        if me is not None:
+            assert me.dtype == mc.dtype
+            np.testing.assert_allclose(np.asarray(me), np.asarray(mc),
+                                       rtol=rtol, atol=atol)
+
+
+class TestCaptureMatchesEager:
+    @pytest.mark.parametrize("opt_name", list(OPTIMIZERS))
+    def test_plain(self, opt_name):
+        _assert_equiv(opt_name, "plain")
+
+    @pytest.mark.parametrize("opt_name", list(OPTIMIZERS))
+    def test_lr_scheduler(self, opt_name):
+        _assert_equiv(opt_name, "sched")
+
+    @pytest.mark.parametrize("opt_name", list(OPTIMIZERS))
+    def test_grad_clip(self, opt_name):
+        _assert_equiv(opt_name, "clip")
+
+    @pytest.mark.parametrize("opt_name", ["sgd", "adam", "adamw", "lamb"])
+    def test_bf16_multi_precision_masters(self, opt_name):
+        _assert_equiv(opt_name, "bf16")
+
+    def test_batchnorm_buffers_chain(self):
+        def run(captured):
+            paddle.set_flags({"FLAGS_step_capture": captured})
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(6, 8), nn.BatchNorm1D(8),
+                                nn.ReLU(), nn.Linear(8, 3))
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+            ce = nn.CrossEntropyLoss()
+
+            def step(x, y):
+                loss = ce(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            fn = paddle.jit_step(step) if captured else step
+            y = paddle.to_tensor(np.array([0, 1, 2, 0] * 2, np.int64))
+            for i in range(4):
+                loss = fn(paddle.to_tensor(f32(i, 8, 6)), y)
+            bn = net[1]
+            return (float(loss), np.asarray(bn._mean._data),
+                    np.asarray(bn._variance._data))
+
+        le, me, ve = run(False)
+        lc, mc, vc = run(True)
+        assert np.isclose(le, lc, rtol=1e-5)
+        np.testing.assert_allclose(me, mc, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(ve, vc, rtol=1e-5, atol=1e-7)
+
+    def test_noop_optimizer_step_count_not_inflated(self):
+        # review regression: an optimizer whose step() early-outs (all
+        # params frozen, no grads) must not gain _step_count on replays
+        # — the replayed host advance is the probe run's measured delta
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        frozen = paddle.to_tensor(np.ones(3, np.float32))  # stop_gradient
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1,
+                                     parameters=[frozen])
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt2.step()
+            opt.clear_grad()
+            opt2.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        b = sc.capture_counters["replays"]
+        for _ in range(5):
+            cap(x)
+        assert sc.capture_counters["replays"] > b   # capture engaged
+        assert opt._step_count == 5
+        assert opt2._step_count == 0                # eager semantics
+
+    def test_decorator_form(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+
+        @paddle.jit_step
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        before = sc.capture_counters["replays"]
+        for _ in range(3):
+            loss = step(x)
+        assert isinstance(loss, paddle.Tensor)
+        assert sc.capture_counters["replays"] > before
+
+
+def _fallback_reasons():
+    return [e[4][0] for e in fr.recorder().entries()
+            if e[3] == "step_capture.fallback"]
+
+
+class TestFallbackEdges:
+    def _mk(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        return net, opt
+
+    def _drive(self, fn, n=4, x_shape=(2, 4)):
+        cap = paddle.jit_step(fn)
+        before = dict(sc.capture_counters)
+        outs = [cap(paddle.to_tensor(np.ones(x_shape, np.float32)))
+                for _ in range(n)]
+        return outs, before, dict(sc.capture_counters)
+
+    def test_tensor_hooks_fall_back(self):
+        net, opt = self._mk()
+        seen = []
+
+        def step(x):
+            loss = net(x).sum()
+            loss.register_hook(lambda g: seen.append(1))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        outs, b, a = self._drive(step)
+        assert a["captures"] == b["captures"]        # never captured
+        assert a["fallbacks"] > b["fallbacks"]
+        assert len(seen) == 4                        # hook fired EVERY step
+        assert any("hooks" in r for r in _fallback_reasons())
+
+    def test_create_graph_falls_back(self):
+        net, opt = self._mk()
+
+        def step(x):
+            y = (net(x) ** 2).sum()
+            g = paddle.grad(y, net.parameters()[0], create_graph=True)[0]
+            loss = (g ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        outs, b, a = self._drive(step)
+        assert a["captures"] == b["captures"]
+        assert a["fallbacks"] > b["fallbacks"]
+        assert any("create_graph" in r for r in _fallback_reasons())
+
+    def test_flags_off_falls_back(self):
+        net, opt = self._mk()
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        paddle.set_flags({"FLAGS_step_capture": False})
+        cap = paddle.jit_step(step)
+        b = dict(sc.capture_counters)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            cap(x)
+        a = dict(sc.capture_counters)
+        assert a["captures"] == b["captures"]
+        assert a["probes"] == b["probes"]            # flag gates probing too
+        assert a["fallbacks"] - b["fallbacks"] == 3
+        assert any("disabled" in r for r in _fallback_reasons())
+
+    def test_host_control_flow_falls_back(self):
+        net, opt = self._mk()
+
+        def step(x):
+            loss = net(x).sum()
+            if float(loss) > 1e9:                    # host sync on a tracer
+                loss = loss * 2.0
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        outs, b, a = self._drive(step)
+        assert a["captures"] == b["captures"]
+        assert a["fallbacks"] > b["fallbacks"]
+        assert any("trace failed" in r for r in _fallback_reasons())
+
+    def test_plateau_scheduler_with_metric_falls_back(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        lr = paddle.optimizer.lr.ReduceOnPlateau(0.1, patience=1)
+        opt = paddle.optimizer.SGD(learning_rate=lr,
+                                   parameters=net.parameters())
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            lr.step(float(loss))                     # host-value branch
+            return loss
+
+        outs, b, a = self._drive(step)
+        assert a["captures"] == b["captures"]
+        assert a["fallbacks"] > b["fallbacks"]
+        assert any("epoch/metric" in r for r in _fallback_reasons())
+
+    def test_grad_requiring_input_falls_back(self):
+        net, opt = self._mk()
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32),
+                             stop_gradient=False)
+        b = dict(sc.capture_counters)
+        for _ in range(3):
+            cap(x)
+        a = dict(sc.capture_counters)
+        assert a["captures"] == b["captures"]
+        assert a["fallbacks"] > b["fallbacks"]
+        assert x.grad is not None                    # eager semantics kept
+        assert any("requires grad" in r for r in _fallback_reasons())
+
+    def test_shape_change_reprobes_and_recaptures(self):
+        net, opt = self._mk()
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        b = dict(sc.capture_counters)
+        for shape in ((2, 4), (2, 4), (2, 4), (3, 4), (3, 4), (3, 4)):
+            cap(paddle.to_tensor(np.ones(shape, np.float32)))
+        a = dict(sc.capture_counters)
+        # two structures, each probe->capture->replay
+        assert a["captures"] - b["captures"] == 2
+        assert a["probes"] - b["probes"] == 2
+        assert a["replays"] - b["replays"] == 2
+
+    def test_never_repeating_shapes_trip_breaker(self):
+        net, opt = self._mk()
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        b = dict(sc.capture_counters)
+        for i in range(2, 2 + sc._MISS_STREAK_MAX + 6):
+            cap(paddle.to_tensor(np.ones((i, 4), np.float32)))
+        a = dict(sc.capture_counters)
+        assert a["bypass"] > b["bypass"]             # probing stopped
+        assert a["captures"] == b["captures"]
+
+    def test_out_of_state_mutation_aborts_then_heals(self):
+        net, opt = self._mk()
+        extra = paddle.to_tensor(np.zeros(4, np.float32))
+        calls = {"n": 0}
+
+        def step(x):
+            calls["n"] += 1
+            loss = net(x).sum()
+            if calls["n"] >= 2:    # appears only AFTER the discovery run
+                extra._set_data(extra._data + loss._data)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        outs, b, a = self._drive(step, n=5)
+        # first capture attempt aborts (the write would be lost on
+        # replay) and replays the eager path ...
+        assert a["fallbacks"] > b["fallbacks"]
+        assert any("outside the captured state" in r
+                   for r in _fallback_reasons())
+        # ... then the re-probe discovers `extra` as state and the step
+        # captures WITH it: later replays keep mutating it on device
+        assert a["captures"] - b["captures"] == 1
+        assert a["replays"] > b["replays"]
+        assert float(np.asarray(extra._data)[0]) != 0.0
+
+
+class TestCacheAndInvalidation:
+    def _cap(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return paddle.jit_step(step)
+
+    def test_entry_cache_is_bounded(self):
+        cap = self._cap()
+        for r in range(2):      # repeat so every shape gets captured
+            for i in range(2, 2 + sc._ENTRIES_MAX + 3):
+                cap(paddle.to_tensor(np.ones((i, 4), np.float32)))
+                cap._streak = 0          # isolate the bound from the breaker
+        assert len(cap._entries) <= sc._ENTRIES_MAX
+
+    def test_mesh_epoch_bump_invalidates(self):
+        from paddle_tpu import flags as flags_mod
+        cap = self._cap()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            cap(x)
+        b = dict(sc.capture_counters)
+        flags_mod.bump_mesh_epoch()      # retired mesh: key must change
+        for _ in range(3):
+            cap(x)
+        a = dict(sc.capture_counters)
+        assert a["captures"] - b["captures"] == 1    # re-captured
+        assert a["probes"] - b["probes"] == 1
+
+    def test_static_variants_keep_their_own_host_effects(self):
+        # review regression: each cache entry must replay the host
+        # effects of the discovery it was CAPTURED under — a later probe
+        # of a different static variant (different scheduler behavior)
+        # must not leak its deltas into the first variant's replays
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        lr = paddle.optimizer.lr.StepDecay(0.1, step_size=100)
+        opt = paddle.optimizer.SGD(learning_rate=lr,
+                                   parameters=net.parameters())
+
+        def step(x, do_sched):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if do_sched:
+                lr.step()
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            cap(x, True)                 # probe, capture, replay
+        e_true = lr.last_epoch
+        assert e_true == 3
+        for _ in range(3):
+            cap(x, False)                # re-probes: sched_deltas empty
+        assert lr.last_epoch == e_true   # False variant never advances
+        cap(x, True)                     # True REPLAY: must still advance
+        assert lr.last_epoch == e_true + 1
+
+    def test_state_dict_survives_replay_donation(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            cap(x)
+        sd = opt.state_dict()            # copies, not donated references
+        cap(x)                           # replay donates current state
+        m = sd["states"][0]["m"]
+        assert np.isfinite(np.asarray(m)).all()   # old copy still readable
+
+    def test_external_step_reset_resyncs_device_counter(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            cap(x)
+        sd = opt.state_dict()
+        for _ in range(2):
+            cap(x)
+        opt.set_state_dict(sd)           # rewind to step 3
+        cap(x)                           # must resync the device scalar
+        assert opt._step_count == sd["step"] + 1
+
+
+class TestHapiAutoCapture:
+    def _model(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3))
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                        parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(),
+                      metrics=paddle.metric.Accuracy())
+        return model
+
+    def test_train_batch_captures_and_keeps_metrics(self):
+        model = self._model()
+        x = f32(0, 4, 6)
+        y = np.array([[0], [1], [2], [0]], np.int64)
+        b = dict(sc.capture_counters)
+        for _ in range(4):
+            res = model.train_batch([x], [y])
+        a = dict(sc.capture_counters)
+        assert a["captures"] - b["captures"] == 1
+        assert a["replays"] - b["replays"] == 2
+        losses, metrics = res
+        assert np.isfinite(losses[0])
+        assert 0.0 <= metrics[0] <= 1.0
+
+    def test_flag_off_keeps_pure_eager(self):
+        paddle.set_flags({"FLAGS_step_capture": False})
+        model = self._model()
+        x = f32(0, 4, 6)
+        y = np.array([[0], [1], [2], [0]], np.int64)
+        b = dict(sc.capture_counters)
+        for _ in range(3):
+            model.train_batch([x], [y])
+        a = dict(sc.capture_counters)
+        assert a["captures"] == b["captures"]
+        assert a["probes"] == b["probes"]
+
+    def test_matches_eager_train_batch(self):
+        def run(captured):
+            paddle.set_flags({"FLAGS_step_capture": captured})
+            model = self._model()
+            x = f32(0, 4, 6)
+            y = np.array([[0], [1], [2], [0]], np.int64)
+            for _ in range(4):
+                res = model.train_batch([x], [y])
+            return (res[0][0],
+                    [np.asarray(p._data)
+                     for p in model.network.parameters()])
+
+        le, pe = run(False)
+        lc, pc = run(True)
+        assert np.isclose(le, lc, rtol=1e-5)
+        for a, b in zip(pe, pc):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+class TestObservability:
+    def test_profiler_gets_typed_step_capture_span(self, tmp_path):
+        import paddle_tpu.profiler as profiler
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            cap(x)                      # compiled before profiling starts
+        p = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                              trace_dir=str(tmp_path))
+        p.start()
+        cap(x)
+        p.stop()
+        res = p.get_profiler_result()
+        spans = [e for e in res.events if e.name == "step_capture"]
+        assert spans, "replay span missing from the profiler timeline"
+        assert spans[0].event_type == profiler.TracerEventType.StepCapture
+
+    def test_metrics_registry_exports_counters(self):
+        from paddle_tpu.observability import metrics as m
+        snap = m.registry().snapshot()
+        for key in ("step_capture.captures", "step_capture.replays",
+                    "step_capture.fallbacks"):
+            assert key in snap, key
+            assert snap[key]["value"] >= 0
+
+
+pytestmark = pytest.mark.smoke

@@ -84,6 +84,20 @@ class TestV5pAotCompile:
         # sanity: sharded args are GBs, not the full replicated model
         assert plan["per_chip_bytes"]["arguments"] < 0.5 * V5P_HBM_BYTES
 
+    def test_projected_throughput_reported(self, plan):
+        # the plan must project THROUGHPUT, not just prove fit: roofline
+        # step time from the compiled program's own cost_analysis()
+        proj = plan["projected"]
+        assert proj["flops_per_chip"] > 0
+        assert proj["hbm_bytes_per_chip"] > 0
+        assert proj["step_seconds"] > 0
+        assert proj["tokens_per_sec"] > 0
+        assert proj["bound"] in ("compute", "memory")
+        assert 0.0 < proj["mfu_upper_bound"] <= 1.0
+        # consistency: the roofline is the max of its two legs
+        assert proj["step_seconds"] >= proj["compute_seconds"]
+        assert proj["step_seconds"] >= proj["memory_seconds"]
+
     def test_collective_schedule(self, plan):
         c = plan["collectives"]
         # canonical Megatron TP: col-shard qkv/gate/up -> local per-head
