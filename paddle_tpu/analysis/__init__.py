@@ -1,0 +1,32 @@
+"""graftcheck: capture/donation-aware static analysis for paddle_tpu.
+
+The reference framework enforces its invariants machine-checkably at
+every layer (``PADDLE_ENFORCE*``, op-schema validation, IR verifiers);
+this package is that idea applied to the TPU graft's own hazards:
+
+* ``capture-safety`` — constructs that doom whole-step capture, also
+  exposed as :func:`screen_step_fn` and called by
+  ``jit/step_capture.py`` before the probe run;
+* ``donation-safety`` — use-after-donate of jit-donated buffers;
+* ``trace-purity`` — host nondeterminism inside trace-region code;
+* ``compat-shim`` — raw shard_map / Mosaic confinement to jax_compat;
+* ``taxonomy`` — frozen fallback-reason / metric-name sets;
+* ``silent-except`` / ``test-flag-restore`` — hygiene.
+
+CLI::
+
+    python -m paddle_tpu.analysis [--format text|json] [--profile src|test]
+                                  [--rules id,id] paths...
+    paddle-tpu-check paddle_tpu/
+
+Exit codes: 0 clean, 1 findings, 2 usage error. Suppress a finding
+with ``# graftcheck: disable=<rule-id> -- <justification>`` (trailing,
+or alone on the previous line); the justification is mandatory.
+"""
+
+from .core import (Finding, Rule, SourceFile, UsageError, register,  # noqa: F401
+                   rule_classes, run_files, run_paths)
+from .screen import screen_step_fn  # noqa: F401
+
+__all__ = ["Finding", "Rule", "SourceFile", "UsageError", "register",
+           "rule_classes", "run_files", "run_paths", "screen_step_fn"]

@@ -1,0 +1,69 @@
+"""graftcheck CLI: ``python -m paddle_tpu.analysis`` / ``paddle-tpu-check``.
+
+Exit codes follow the compiler convention: 0 = clean, 1 = findings,
+2 = usage/internal error — so CI can gate on the analyzer directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .core import UsageError, rule_classes, run_paths
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle-tpu-check",
+        description="graftcheck: capture/donation-aware static analysis "
+                    "for paddle_tpu sources")
+    p.add_argument("paths", nargs="*", help="files or directories to check")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--profile", choices=("src", "test"), default="src",
+                   help="rule set: 'src' for framework code, 'test' for "
+                        "the test suite (default: src)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (overrides --profile)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print every registered rule and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = _build_parser()
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:          # argparse exits 2 on usage errors
+        return 2 if e.code else 0
+    if args.list_rules:
+        for rid, cls in sorted(rule_classes().items()):
+            profiles = ",".join(cls.profiles)
+            print(f"{rid:20s} [{profiles}] {cls.help}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        sys.stderr.write("error: no paths given\n")
+        return 2
+    rule_ids = None
+    if args.rules is not None:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        findings = run_paths(args.paths, rule_ids, args.profile)
+    except UsageError as e:
+        sys.stderr.write(f"error: {e}\n")
+        return 2
+    if args.format == "json":
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "count": len(findings)}, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        n = len(findings)
+        print(f"graftcheck: {n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0
+
+
+def console_main() -> None:
+    sys.exit(main())

@@ -1,0 +1,245 @@
+"""graftcheck core: findings, the rule registry, suppressions, the driver.
+
+The reference framework bakes machine-checkable invariants into every
+layer — ``PADDLE_ENFORCE*`` at the C++ call sites, op-schema validation
+at registration, IR verifiers between passes. This package is the
+TPU-native analog at the source level: an AST-based analysis framework
+whose rules encode the invariants the capture/donation/taxonomy
+machinery depends on (see ``rules/``), run over ``paddle_tpu/`` as a
+tier-1 test and available as a CLI (``python -m paddle_tpu.analysis`` /
+``paddle-tpu-check``).
+
+Vocabulary:
+
+* **Finding** — one violation: rule id, severity, ``path:line``, message.
+* **Rule** — a registered check. Rules are instantiated fresh per run
+  (``begin(files)`` may accumulate cross-file state, e.g. the taxonomy
+  rule collects every ``*_REASONS`` frozenset before checking call
+  sites).
+* **Profile** — which rule set a run uses: ``src`` for framework code,
+  ``test`` for the test suite (tests intentionally plant capture-unsafe
+  steps and raw-API samples, but have their own hazards — flag
+  mutations without restore).
+* **Suppression** — ``# graftcheck: disable=<rule-id>[,...] -- <why>``
+  on the offending line (or alone on the line above). The justification
+  after ``--`` is MANDATORY: a bare disable is itself reported
+  (``suppression-justification``), so no suppression ships without an
+  inline reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Type
+
+__all__ = [
+    "Finding", "Rule", "SourceFile", "register", "rule_classes",
+    "instantiate", "run_paths", "run_files", "attr_chain", "UsageError",
+]
+
+
+class UsageError(Exception):
+    """Bad invocation (unknown rule id, missing path): CLI exit code 2."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, pointing at source."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    severity: str = "error"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: {self.severity}: "
+                f"[{self.rule}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# `--` justification is mandatory; group(2) empty => meta-finding
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*disable=([A-Za-z0-9_\-*]+(?:\s*,\s*[A-Za-z0-9_\-*]+)*)"
+    r"(?:\s*--\s*(\S.*))?\s*$")
+
+
+class SourceFile:
+    """A parsed module plus its suppression map, handed to every rule."""
+
+    def __init__(self, path: str, text: str, rel: Optional[str] = None):
+        self.path = path
+        # rule scoping (e.g. trace-purity's pallas confinement) matches
+        # on a /-normalized relative path so it works on any OS
+        self.rel = (rel or path).replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self._suppress: Dict[int, set] = {}
+        self.meta_findings: List[Finding] = []
+        self._parse_suppressions()
+
+    @classmethod
+    def load(cls, path: str, rel: Optional[str] = None) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            return cls(path, f.read(), rel)
+
+    def _parse_suppressions(self) -> None:
+        for i, ln in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(ln)
+            if m is None:
+                continue
+            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            # a comment-only line suppresses the NEXT line; a trailing
+            # comment suppresses its own line
+            target = i + 1 if ln.lstrip().startswith("#") else i
+            self._suppress.setdefault(target, set()).update(ids)
+            if not m.group(2):
+                self.meta_findings.append(Finding(
+                    "suppression-justification", self.rel, i,
+                    "graftcheck suppression without a justification — "
+                    "append `-- <why this is safe>`"))
+
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        ids = self._suppress.get(line)
+        return bool(ids) and (rule_id in ids or "*" in ids)
+
+    def has_comment(self, line: int) -> bool:
+        """True when source line `line` (1-based) carries a comment —
+        rules accepting an inline justification-in-place use this."""
+        if not 1 <= line <= len(self.lines):
+            return False
+        return "#" in self.lines[line - 1]
+
+
+class Rule:
+    """Base class: subclass, set `id`/`help`/`profiles`, implement
+    `check`. Register with the @register decorator."""
+
+    id: str = ""
+    help: str = ""
+    severity: str = "error"
+    profiles: Sequence[str] = ("src",)
+
+    def begin(self, files: Sequence[SourceFile]) -> None:
+        """Cross-file pre-pass (optional)."""
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, sf: SourceFile, node, message: str) -> Finding:
+        line = node if isinstance(node, int) else getattr(node, "lineno", 0)
+        return Finding(self.id, sf.rel, line, message, self.severity)
+
+
+_RULE_CLASSES: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    assert cls.id and cls.id not in _RULE_CLASSES, cls
+    _RULE_CLASSES[cls.id] = cls
+    return cls
+
+
+def rule_classes() -> Dict[str, Type[Rule]]:
+    from . import rules as _rules  # noqa: F401 — importing registers
+    return dict(_RULE_CLASSES)
+
+
+def instantiate(rule_ids: Optional[Iterable[str]] = None,
+                profile: str = "src") -> List[Rule]:
+    """Fresh rule objects for one run (cross-file state must not leak
+    between runs)."""
+    classes = rule_classes()
+    if rule_ids is None:
+        return [c() for c in classes.values() if profile in c.profiles]
+    out = []
+    for rid in rule_ids:
+        if rid not in classes:
+            raise UsageError(
+                f"unknown rule id {rid!r} (known: {', '.join(sorted(classes))})")
+        out.append(classes[rid]())
+    return out
+
+
+def _py_files(path: str) -> Iterator[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+
+
+def run_paths(paths: Sequence[str],
+              rule_ids: Optional[Iterable[str]] = None,
+              profile: str = "src",
+              root: Optional[str] = None) -> List[Finding]:
+    """Analyze every .py under `paths` with the profile's (or the named)
+    rules; returns unsuppressed findings sorted by location."""
+    files: List[SourceFile] = []
+    findings: List[Finding] = []
+    for p in paths:
+        if not os.path.exists(p):
+            raise UsageError(f"no such path: {p}")
+        for fp in _py_files(p):
+            rel = os.path.relpath(fp, root) if root else fp
+            try:
+                files.append(SourceFile.load(fp, rel))
+            except SyntaxError as e:
+                findings.append(Finding(
+                    "parse-error", rel.replace(os.sep, "/"),
+                    e.lineno or 0, f"cannot parse: {e.msg}"))
+    findings.extend(run_files(files, rule_ids, profile))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_files(files: Sequence[SourceFile],
+              rule_ids: Optional[Iterable[str]] = None,
+              profile: str = "src") -> List[Finding]:
+    rules = instantiate(rule_ids, profile)
+    findings: List[Finding] = []
+    for sf in files:
+        findings.extend(sf.meta_findings)
+    for r in rules:
+        r.begin(files)
+    for sf in files:
+        for r in rules:
+            for f in r.check(sf):
+                if not sf.suppressed(f.line, r.id):
+                    findings.append(f)
+    return findings
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name of an Attribute/Name chain ('jax.jit', 'self._fn'),
+    or None when the chain roots in something unnameable (a call, a
+    subscript)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(func: ast.AST) -> Optional[str]:
+    """Last component of a call target: `a.b.c(...)` -> 'c', `f(...)` ->
+    'f'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None

@@ -1,0 +1,14 @@
+"""graftcheck rule set — importing this package registers every rule.
+
+Add a rule by dropping a module here that defines a
+``core.Rule`` subclass decorated with ``@core.register``, and
+importing it below. Each rule module's docstring documents the
+invariant it encodes and where the invariant comes from.
+"""
+
+from . import capture_safety  # noqa: F401
+from . import compat_shim     # noqa: F401
+from . import donation        # noqa: F401
+from . import hygiene         # noqa: F401
+from . import taxonomy        # noqa: F401
+from . import trace_purity    # noqa: F401

@@ -1,0 +1,189 @@
+"""capture-safety: pre-probe screen for whole-step capture.
+
+``jit/step_capture.py`` discovers a step's state with an eager probe,
+then pays a full trace + compile before it can learn the step was never
+capturable — a host branch on a tensor value concretizes mid-trace, a
+tensor hook or ``create_graph=True`` aborts in the engine. This rule
+screens the step function's AST for those dooming constructs BEFORE the
+probe, so the diagnosis is a source-located message instead of a
+probe+capture+abort cycle (``step_capture.static_screened``).
+
+Precision contract: a false positive here silently costs the user the
+4x captured path, so every pattern requires TENSOR EVIDENCE — a name is
+only treated as tensor-valued when the function itself proves it (it is
+the receiver of ``.backward()``/``.register_hook()``, or is assigned
+from an expression over such a name). Branches on plain Python values
+(``if do_sched:``), host math on floats, and coercions of non-tensor
+locals are never flagged; anything the screen cannot see through (a
+helper call hiding the coercion) is left for the dynamic probe/abort
+path, which stays authoritative.
+
+Flagged, in capture order of cost saved:
+
+* ``t.register_hook(...)`` — tensor hooks are eager-tape-only.
+* ``create_graph=True`` keyword (higher-order grad inside a step).
+* host coercions — ``float(t)``/``int(t)``/``bool(t)`` and
+  ``t.numpy()``/``t.item()``/``t.tolist()`` on tensor evidence only
+  (bare parameters don't count: step args may be host-side
+  np.ndarrays).
+* host control flow — ``if``/``while``/``assert``/ternary whose test
+  reads tensor evidence (incl. via a coercion).
+
+As a file rule it screens every function passed to (or decorated with)
+``jit_step`` in the module; :func:`screen_function` is the shared core
+the runtime ``analysis.screen_step_fn`` API uses on live functions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, Rule, SourceFile, register, terminal_name
+
+_COERCE_FUNCS = {"float", "int", "bool"}
+_COERCE_METHODS = {"numpy", "item", "tolist"}
+_TENSOR_ANCHOR_METHODS = {"backward", "register_hook"}
+
+
+def _tensor_names(fn: ast.AST) -> Set[str]:
+    """Names with tensor evidence: receivers of anchor methods, plus
+    forward propagation through assignments (to a fixpoint)."""
+    tainted: Set[str] = set()
+    for n in ast.walk(fn):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _TENSOR_ANCHOR_METHODS
+                and isinstance(n.func.value, ast.Name)):
+            tainted.add(n.func.value.id)
+    assigns = [n for n in ast.walk(fn)
+               if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+               and n.value is not None]
+    for _ in range(len(assigns) + 1):
+        changed = False
+        for a in assigns:
+            if not _reads_tainted(a.value, tainted):
+                continue
+            targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+            for t in targets:
+                for x in ast.walk(t):
+                    if isinstance(x, ast.Name) and x.id not in tainted:
+                        tainted.add(x.id)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+def _reads_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    return any(isinstance(x, ast.Name) and x.id in tainted
+               for x in ast.walk(node))
+
+
+def _has_coercion(node: ast.AST, tainted: Set[str]) -> bool:
+    for x in ast.walk(node):
+        if _coercion_at(x, tainted) is not None:
+            return True
+    return False
+
+
+def _coercion_at(node: ast.AST, tainted: Set[str]) -> Optional[str]:
+    """A host-sync coercion at exactly this node, or None.
+
+    Requires tensor EVIDENCE on the receiver/argument — a bare function
+    parameter is NOT enough: step args may legitimately be host-side
+    np.ndarrays (they stay host-side until the jit boundary), and a
+    false positive here permanently costs the captured fast path."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if (isinstance(f, ast.Name) and f.id in _COERCE_FUNCS and node.args
+            and _reads_tainted(node.args[0], tainted)):
+        return f"{f.id}() on a tensor value"
+    if isinstance(f, ast.Attribute) and f.attr in _COERCE_METHODS \
+            and _reads_tainted(f.value, tainted):
+        return f".{f.attr}() host transfer"
+    return None
+
+
+def screen_function(fn: ast.FunctionDef) -> List[Tuple[int, str]]:
+    """Screen one step-function AST; returns [(lineno, message)].
+
+    Works on any FunctionDef/AsyncFunctionDef node whose line numbers
+    already point into the real file (callers offset with
+    ``ast.increment_lineno`` when parsing an extracted snippet).
+    """
+    tainted = _tensor_names(fn)
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "register_hook"):
+                out.append((node.lineno,
+                            "tensor hooks are eager-only: .register_hook() "
+                            "fires per-op on the tape, which a captured "
+                            "replay never walks"))
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "create_graph"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    out.append((kw.value.lineno,
+                                "create_graph=True needs the live eager "
+                                "tape (higher-order grad inside a step)"))
+            why = _coercion_at(node, tainted)
+            if why is not None:
+                out.append((node.lineno,
+                            f"host coercion in a step function: {why} "
+                            f"concretizes the trace"))
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            test = node.test
+            if _reads_tainted(test, tainted) or _has_coercion(test, tainted):
+                kind = {"If": "if", "While": "while", "IfExp": "ternary",
+                        "Assert": "assert"}[type(node).__name__]
+                out.append((test.lineno,
+                            f"host control flow on a tensor value "
+                            f"({kind} test) — data-dependent Python "
+                            f"branching cannot be captured"))
+    out.sort()
+    return out
+
+
+def _step_functions(tree: ast.Module) -> Iterator[ast.FunctionDef]:
+    """Functions a module hands to whole-step capture: decorated with
+    jit_step, or passed by name to a jit_step(...) call."""
+    passed: Set[str] = set()
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if terminal_name(target) == "jit_step":
+                    yield node
+        elif (isinstance(node, ast.Call)
+                and terminal_name(node.func) == "jit_step"):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    passed.add(arg.id)
+    for name in passed:
+        fn = defs.get(name)
+        if fn is not None:
+            yield fn
+
+
+@register
+class CaptureSafetyRule(Rule):
+    id = "capture-safety"
+    help = ("step functions handed to jit_step must be free of "
+            "capture-dooming constructs (hooks, create_graph=True, host "
+            "coercions/branches on tensor values)")
+    profiles = ("src",)   # tests deliberately plant doomed steps
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        seen = set()
+        for fn in _step_functions(sf.tree):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            for line, msg in screen_function(fn):
+                yield self.finding(sf, line, f"in step '{fn.name}': {msg}")

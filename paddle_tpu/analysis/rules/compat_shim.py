@@ -1,0 +1,74 @@
+"""compat-shim: raw shard_map / Mosaic CompilerParams confinement.
+
+Migrated from the PR-4 standalone lint (tests/test_lint_compat.py, now
+a thin wrapper over this rule): every call site of the twice-moved
+shard_map API and of Mosaic CompilerParams must go through
+``paddle_tpu/jax_compat.py``, or new code silently breaks on the old
+jax generation the shim still supports (old-jax runs FULL-manual
+because partial-manual ``auto`` hard-aborts XLA's SPMD partitioner).
+
+AST-based: docstrings and comments may (and do) mention the raw names;
+only real imports / attribute accesses count. ``jax_compat.py`` itself
+is the one allowed home.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Tuple
+
+from ..core import Finding, Rule, SourceFile, attr_chain, register
+
+ALLOWED_BASENAMES = {"jax_compat.py"}
+
+
+def violations(tree: ast.Module) -> List[Tuple[int, str]]:
+    """(lineno, what) for every raw-API use in the module."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            mod = node.module
+            is_raw_jax = mod == "jax" or mod.startswith("jax.")
+            if mod.startswith("jax.experimental.shard_map"):
+                out.append((node.lineno, f"from {mod} import ..."))
+            if is_raw_jax and any(a.name == "shard_map"
+                                  for a in node.names):
+                out.append((node.lineno, f"from {mod} import shard_map"))
+            if "mosaic" in mod and any("CompilerParams" in a.name
+                                       for a in node.names):
+                out.append((node.lineno,
+                            f"from {mod} import CompilerParams"))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.experimental.shard_map"):
+                    out.append((node.lineno, f"import {a.name}"))
+        elif isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+            if chain in ("jax.shard_map", "jax.experimental.shard_map",
+                         "jax.experimental.shard_map.shard_map"):
+                out.append((node.lineno, chain))
+            elif chain is not None and "CompilerParams" in chain.rsplit(
+                    ".", 1)[-1]:
+                out.append((node.lineno, chain))
+        elif isinstance(node, ast.Name) and "CompilerParams" in node.id:
+            out.append((node.lineno, node.id))
+    return out
+
+
+@register
+class CompatShimRule(Rule):
+    id = "compat-shim"
+    help = ("raw jax shard_map / Mosaic CompilerParams use outside "
+            "jax_compat.py — route through the shim so old-jax "
+            "containers keep working")
+    profiles = ("src",)
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        if os.path.basename(sf.rel) in ALLOWED_BASENAMES:
+            return
+        for lineno, what in violations(sf.tree):
+            yield self.finding(
+                sf, lineno,
+                f"direct use of {what} — route through "
+                f"paddle_tpu/jax_compat.py")

@@ -1,0 +1,238 @@
+"""donation-safety: no reads of a buffer after it was donated to XLA.
+
+Every fused executable in this framework donates its state
+(``jax.jit(..., donate_argnums=...)`` in jit/step_capture.py,
+jit/api.py, optimizer/optimizer.py): XLA reuses the input buffers for
+outputs, so the Python-side array object is DEAD after the call —
+reading it raises (CPU) or returns garbage-adjacent errors late
+(``Array has been deleted`` mid-train). The ``_rebind_donated`` class
+of bug is exactly a name being read after the jit call consumed it.
+
+The rule tracks, per function scope and in statement order:
+
+* names bound to a donating jit — ``jfn = jax.jit(f, donate_argnums=
+  (0, 2))`` — including ``self.x = jax.jit(...)`` attributes, which are
+  collected CLASS-WIDE so a call in one method checks donations
+  declared in another (the jit/api.py build/call split);
+* calls through such a name: the plain-name (or dotted) arguments in
+  donated positions become *consumed* from the next statement on;
+* any later Load of a consumed name in the same scope — without an
+  intervening rebind — is a finding.
+
+Branches are path-sensitive the cheap way: ``if``/``try`` arms are
+scanned from the pre-branch state and their consumed-sets union
+afterwards, so the common "call jfn under a profiler hook in one arm,
+bare in the other" shape is not a false positive. Loops are scanned
+linearly (a back-edge read is out of scope — the re-entry rebinds in
+every real call site here).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, Rule, SourceFile, attr_chain, register
+
+_JIT_CHAINS = {"jax.jit"}
+_JIT_TERMINALS = {"jit"}
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """donate_argnums of a jax.jit(...) call, or None when absent /
+    non-literal."""
+    chain = attr_chain(call.func)
+    if chain not in _JIT_CHAINS and \
+            (chain is None or chain.rsplit(".", 1)[-1] not in _JIT_TERMINALS):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                pos = []
+                for e in v.elts:
+                    if not (isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)):
+                        return None
+                    pos.append(e.value)
+                return tuple(pos)
+            return None
+    return None
+
+
+def _class_attr_donors(cls: ast.ClassDef) -> Dict[str, Tuple[int, ...]]:
+    """self.<attr> names bound to donating jits anywhere in the class."""
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not isinstance(v, ast.Call):
+            continue
+        pos = _donated_positions(v)
+        if pos is None:
+            continue
+        for t in node.targets:
+            chain = attr_chain(t)
+            if chain is not None and chain.startswith("self."):
+                donors[chain] = pos
+    return donors
+
+
+class _ScopeScan:
+    """One function scope, statement-ordered with branch-arm forks."""
+
+    def __init__(self, rule: "DonationSafetyRule", sf: SourceFile,
+                 fn: ast.FunctionDef, class_donors: Dict[str, Tuple[int, ...]]):
+        self.rule = rule
+        self.sf = sf
+        self.fn = fn
+        self.donors: Dict[str, Tuple[int, ...]] = dict(class_donors)
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        self._scan(self.fn.body, {})
+        return self.findings
+
+    # consumed: chain -> (donor_name, donation_lineno)
+    def _scan(self, stmts, consumed: Dict[str, Tuple[str, int]]):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue   # nested scope: separate lifetime
+            if isinstance(st, ast.If):
+                consumed = self._fork(st.test, [st.body, st.orelse], consumed)
+                continue
+            if isinstance(st, ast.Try):
+                # handlers/finally see the try body's consumed set: an
+                # exception may fire after the donating call
+                after_body = self._scan(st.body, dict(consumed))
+                merged = dict(after_body)
+                for h in st.handlers:
+                    arm = self._scan(h.body, dict(after_body))
+                    merged.update(arm)
+                merged = self._scan(st.orelse, merged)
+                consumed = self._scan(st.finalbody, merged)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                head = st.iter if isinstance(st, (ast.For, ast.AsyncFor)) \
+                    else st.test
+                self._check_reads(head, consumed)
+                body = self._scan(st.body, dict(consumed))
+                consumed = self._scan(st.orelse, body)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._check_reads(item.context_expr, consumed)
+                consumed = self._scan(st.body, consumed)
+                continue
+            consumed = self._statement(st, consumed)
+        return consumed
+
+    def _fork(self, test, arms, consumed):
+        self._check_reads(test, consumed)
+        merged: Dict[str, Tuple[str, int]] = {}
+        for arm in arms:
+            out = self._scan(arm, dict(consumed))
+            merged.update(out)
+        return merged
+
+    def _statement(self, st, consumed):
+        self._check_reads(st, consumed)
+        # new donor bindings:  jfn = jax.jit(f, donate_argnums=...)
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+            pos = _donated_positions(st.value)
+            if pos is not None:
+                for t in st.targets:
+                    chain = attr_chain(t)
+                    if chain is not None:
+                        self.donors[chain] = pos
+        # donations performed by this statement take effect AFTERWARDS
+        newly: Dict[str, Tuple[str, int]] = {}
+        for call in (n for n in ast.walk(st) if isinstance(n, ast.Call)):
+            donor = attr_chain(call.func)
+            pos = self.donors.get(donor) if donor is not None else None
+            if pos is None:
+                # direct form: jax.jit(f, donate_argnums=...)(args)
+                if isinstance(call.func, ast.Call):
+                    pos = _donated_positions(call.func)
+                    donor = "jax.jit(...)"
+                if pos is None:
+                    continue
+            for p in pos:
+                if p < len(call.args):
+                    chain = attr_chain(call.args[p])
+                    if chain is not None:
+                        newly[chain] = (donor, call.lineno)
+        # stores rebind and happen LAST at runtime, so they clear even a
+        # same-statement donation: `x = jfn(x)` leaves x bound to the
+        # executable's output, which is exactly the sanctioned pattern
+        consumed = dict(consumed)
+        consumed.update(newly)
+        for target in _store_chains(st):
+            consumed.pop(target, None)
+        return consumed
+
+    def _check_reads(self, node, consumed):
+        # NOTE: reads are checked even when the same statement also
+        # stores the name — `state = state * 2` after a donation READS
+        # the dead buffer before rebinding. The sanctioned same-statement
+        # rebind `x = jfn(x)` is safe here because _check_reads runs
+        # BEFORE that statement's donation is registered.
+        if not consumed:
+            return
+        reported = set()
+        for x in ast.walk(node):
+            if isinstance(x, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(x, "ctx", None), ast.Load):
+                chain = attr_chain(x)
+                hit = consumed.get(chain) if chain is not None else None
+                if hit is not None and chain not in reported:
+                    reported.add(chain)
+                    donor, line = hit
+                    self.findings.append(self.rule.finding(
+                        self.sf, x.lineno,
+                        f"`{chain}` is read after being donated to "
+                        f"`{donor}` (line {line}) — the donated buffer "
+                        f"is consumed by XLA; rebind it from the "
+                        f"executable's outputs first"))
+
+
+def _store_chains(node) -> Set[str]:
+    out = set()
+    for x in ast.walk(node):
+        if isinstance(x, (ast.Name, ast.Attribute)) and \
+                isinstance(getattr(x, "ctx", None), (ast.Store, ast.Del)):
+            chain = attr_chain(x)
+            if chain is not None:
+                out.add(chain)
+    return out
+
+
+@register
+class DonationSafetyRule(Rule):
+    id = "donation-safety"
+    help = ("no name may be read after being passed in a donated "
+            "position of a jax.jit(donate_argnums=...) call in the same "
+            "scope")
+    profiles = ("src", "test")
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        # class-wide attribute donors, keyed per enclosing class
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                donors = _class_attr_donors(node)
+                for fn in node.body:
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        yield from _ScopeScan(self, sf, fn, donors).run()
+        in_class = {id(fn) for cls in ast.walk(sf.tree)
+                    if isinstance(cls, ast.ClassDef)
+                    for fn in ast.walk(cls)
+                    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(node) not in in_class:
+                yield from _ScopeScan(self, sf, node, {}).run()

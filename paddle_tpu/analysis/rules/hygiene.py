@@ -1,0 +1,225 @@
+"""Hygiene rules: silent exception swallows, test flag restoration.
+
+silent-except
+    ``except Exception: pass`` (or a bare ``except:``) with no inline
+    explanation hides real failures on hot paths — the reference
+    framework's PADDLE_ENFORCE culture is the opposite stance. A
+    swallow is accepted when any of the ``try``/``except``/``pass``
+    lines carries a comment saying WHY swallowing is correct (teardown
+    paths, best-effort store writes); everything else should record the
+    failure (flight recorder) or justify itself.
+
+test-flag-restore (test profile)
+    A test that mutates process-wide config — ``set_flags`` /
+    ``jax.config.update`` — without restoring it leaks state into every
+    later test in the process: the classic flaky-suite hazard (tier-1
+    runs single-process). A mutation is considered restored when
+    * it happens inside a ``try`` whose ``finally`` also mutates flags,
+    * or before such a ``try`` in the same function (set-try-finally-
+      restore shape),
+    * or in a pytest fixture that mutates again after its ``yield``
+      (teardown), — an ``autouse=True`` such fixture guards its flags
+      for the WHOLE module (helpers may then mutate those flags freely),
+    * or the function restores via a saved snapshot
+      (``set_flags(prev)``), which counts for every flag in scope.
+    Flag identity comes from literal dict keys (``{"FLAGS_x": ...}``);
+    non-literal mutations are only accepted as restores, never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, Rule, SourceFile, attr_chain, register, \
+    terminal_name
+
+_SWALLOWED_TYPES = {"Exception", "BaseException", None}
+
+
+@register
+class SilentExceptRule(Rule):
+    id = "silent-except"
+    help = ("`except Exception: pass` without an inline justification "
+            "comment — log it (flight recorder) or say why swallowing "
+            "is safe")
+    profiles = ("src",)
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                if not (len(h.body) == 1 and isinstance(h.body[0], ast.Pass)):
+                    continue
+                if h.type is None:
+                    tname = None
+                else:
+                    types = h.type.elts if isinstance(h.type, ast.Tuple) \
+                        else [h.type]
+                    named = [terminal_name(t) for t in types]
+                    broad = [n for n in named if n in _SWALLOWED_TYPES]
+                    if not broad:
+                        continue   # narrow except: deliberate by construction
+                    tname = broad[0]
+                # try line, plus everything from `except` through `pass`
+                # (a comment on its own line between them is the most
+                # idiomatic justification placement)
+                lines = {node.lineno} | set(
+                    range(h.lineno, h.body[0].lineno + 1))
+                if any(sf.has_comment(ln) for ln in lines):
+                    continue
+                caught = tname or "everything"
+                yield self.finding(
+                    sf, h.lineno,
+                    f"silently swallows {caught} — record the failure "
+                    f"(observability.flight_recorder) or add an inline "
+                    f"comment saying why dropping it is safe")
+
+
+_MUTATORS = {"set_flags"}          # paddle.set_flags / _flags.set_flags
+_CONFIG_CHAINS = {"jax.config.update", "config.update"}
+
+
+def _mutated_flags(call: ast.Call) -> Optional[Set[str]]:
+    """Flag names a mutation call touches; None = unknown (non-literal)."""
+    name = terminal_name(call.func)
+    if name == "set_flags":
+        if call.args and isinstance(call.args[0], ast.Dict):
+            keys = set()
+            for k in call.args[0].keys:
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    return None
+                keys.add(k.value.removeprefix("FLAGS_"))
+            return keys
+        return None
+    # jax.config.update("jax_x", v)
+    if attr_chain(call.func) in _CONFIG_CHAINS and call.args and \
+            isinstance(call.args[0], ast.Constant):
+        return {str(call.args[0].value)}
+    return None
+
+
+def _is_mutator(call: ast.Call) -> bool:
+    return (terminal_name(call.func) in _MUTATORS
+            or attr_chain(call.func) in _CONFIG_CHAINS)
+
+
+def _fixture_decorated(fn: ast.FunctionDef) -> Tuple[bool, bool]:
+    """(is_fixture, autouse)"""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if terminal_name(target) == "fixture":
+            autouse = isinstance(dec, ast.Call) and any(
+                kw.arg == "autouse" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in dec.keywords)
+            return True, autouse
+    return False, False
+
+
+class _FnFlags:
+    """Mutation/restore facts for one function body."""
+
+    def __init__(self, fn: ast.FunctionDef):
+        self.fn = fn
+        self.unguarded: List[Tuple[ast.Call, Optional[Set[str]]]] = []
+        self.restored: Set[str] = set()        # flags restored in teardown
+        self.restores_all = False              # non-literal teardown restore
+        self._collect(fn.body, guarded=False, after_yield=False)
+
+    def _note_restore(self, call: ast.Call) -> None:
+        flags = _mutated_flags(call)
+        if flags is None:
+            self.restores_all = True
+        else:
+            self.restored |= flags
+
+    def _collect(self, stmts, guarded: bool, after_yield: bool) -> bool:
+        """Walk statements; returns whether a yield was passed (so later
+        mutations count as fixture teardown restores)."""
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.Try):
+                has_restoring_finally = any(
+                    _is_mutator(c) for s in st.finalbody
+                    for c in ast.walk(s) if isinstance(c, ast.Call))
+                g = guarded or has_restoring_finally
+                after_yield = self._collect(st.body, g, after_yield)
+                for h in st.handlers:
+                    after_yield = self._collect(h.body, g, after_yield)
+                after_yield = self._collect(st.orelse, g, after_yield)
+                # the finally's own mutations ARE the restore
+                for s in st.finalbody:
+                    for c in ast.walk(s):
+                        if isinstance(c, ast.Call) and _is_mutator(c):
+                            self._note_restore(c)
+                after_yield = self._collect(
+                    [x for x in st.finalbody], True, after_yield)
+                continue
+            if isinstance(st, (ast.If, ast.For, ast.AsyncFor, ast.While,
+                               ast.With, ast.AsyncWith)):
+                for block in (getattr(st, "body", []),
+                              getattr(st, "orelse", [])):
+                    after_yield = self._collect(block, guarded, after_yield)
+                continue
+            for n in ast.walk(st):
+                if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                    after_yield = True
+                elif isinstance(n, ast.Call) and _is_mutator(n):
+                    if after_yield:
+                        self._note_restore(n)   # fixture teardown
+                    elif not guarded:
+                        self.unguarded.append((n, _mutated_flags(n)))
+        return after_yield
+
+
+@register
+class TestFlagRestoreRule(Rule):
+    id = "test-flag-restore"
+    help = ("tests mutating process flags / jax.config must restore "
+            "them (try/finally, fixture teardown, or an autouse "
+            "fixture guarding the module)")
+    profiles = ("test",)
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        fns = [n for n in ast.walk(sf.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        module_guard: Set[str] = set()
+        module_guards_all = False
+        infos: Dict[int, _FnFlags] = {}
+        for fn in fns:
+            info = _FnFlags(fn)
+            infos[id(fn)] = info
+            is_fix, autouse = _fixture_decorated(fn)
+            if is_fix and autouse:
+                if info.restores_all:
+                    module_guards_all = True
+                module_guard |= info.restored
+        if module_guards_all:
+            return
+        for fn in fns:
+            info = infos[id(fn)]
+            guard = module_guard | info.restored
+            for call, flags in info.unguarded:
+                if info.restores_all:
+                    continue
+                if flags is None:
+                    # unknown mutation, no restore anywhere in function
+                    if not (info.restored or module_guard):
+                        yield self._emit(sf, fn, call, None)
+                    continue
+                leaked = flags - guard
+                if leaked:
+                    yield self._emit(sf, fn, call, leaked)
+
+    def _emit(self, sf, fn, call, leaked) -> Finding:
+        what = "process flags" if leaked is None else \
+            ", ".join(sorted(leaked))
+        return self.finding(
+            sf, call.lineno,
+            f"'{fn.name}' mutates {what} without a restore "
+            f"(try/finally or fixture teardown) — state leaks into "
+            f"every later test in the process")

@@ -1,0 +1,155 @@
+"""taxonomy-discipline: fallback reasons and metric names cannot fork.
+
+Observability is only as good as its label discipline: a typo'd
+``_fallback("trace failled")`` or a re-registered
+``"tp_attention.falback"`` counter silently forks the taxonomy —
+dashboards and the flight recorder then under-count the real reason.
+The runtime half of the defense is the frozen constant sets
+(``step_capture.FALLBACK_REASONS``, ``tp_attention.TP_FALLBACK_REASONS``,
+``metrics.METRIC_NAMES``) validated on the hot path; this rule is the
+static half, so the typo is caught at lint time, not mid-run.
+
+Mechanics: a cross-file ``begin`` pass collects every module-level
+``<NAME>_REASONS = frozenset({...})`` (reason taxonomy) and
+``METRIC_NAMES = frozenset({...})`` (metric taxonomy). ``check`` then
+verifies
+
+* every STRING LITERAL in the reason position of a reason-bearing call
+  (``_fallback``/``record_fallback``/``abort``/``CaptureAbort``) is a
+  member of the collected reason union — f-strings in that position are
+  flagged too (parameterize via the ``detail`` argument instead);
+* every literal metric name registered through
+  ``...registry().counter/gauge/histogram("name", ...)`` is a member of
+  ``METRIC_NAMES``.
+
+Non-literal arguments are skipped: they were literals somewhere else,
+where this rule saw them. User code registering its own metrics is out
+of scope — the rule runs on framework sources only (src profile).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Sequence, Set
+
+from ..core import Finding, Rule, SourceFile, register, terminal_name
+
+# callee terminal name -> positional index of the frozen reason/key arg
+REASON_CALLEES: Dict[str, int] = {
+    "_fallback": 0,
+    "abort": 0,
+    "CaptureAbort": 0,
+    "record_fallback": 1,
+}
+_REASON_KWARGS = {"reason", "key"}
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _frozenset_literal(node: ast.AST) -> Optional[Set[str]]:
+    if not (isinstance(node, ast.Call) and terminal_name(node.func) ==
+            "frozenset" and len(node.args) == 1):
+        return None
+    arg = node.args[0]
+    elts = arg.elts if isinstance(arg, (ast.Set, ast.Tuple, ast.List)) \
+        else None
+    if elts is None:
+        return None
+    out = set()
+    for e in elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.add(e.value)
+    return out
+
+
+def _is_metric_registration(call: ast.Call) -> bool:
+    """Matches ``<...>registry().counter|gauge|histogram(...)`` and the
+    registry module's own ``_REGISTRY.<method>(...)`` sites."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _METRIC_METHODS):
+        return False
+    recv = f.value
+    if isinstance(recv, ast.Call) and terminal_name(recv.func) == "registry":
+        return True
+    return isinstance(recv, ast.Name) and recv.id == "_REGISTRY"
+
+
+@register
+class TaxonomyRule(Rule):
+    id = "taxonomy"
+    help = ("fallback-reason and metric-name string literals must be "
+            "members of a frozen *_REASONS / METRIC_NAMES module "
+            "constant")
+    profiles = ("src",)
+
+    def __init__(self):
+        self.reasons: Set[str] = set()
+        self.metric_names: Set[str] = set()
+        self.saw_reason_set = False
+        self.saw_metric_set = False
+
+    def begin(self, files: Sequence[SourceFile]) -> None:
+        for sf in files:
+            for node in sf.tree.body:
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                t = node.targets[0]
+                if not isinstance(t, ast.Name):
+                    continue
+                vals = _frozenset_literal(node.value)
+                if vals is None:
+                    continue
+                if t.id.endswith("_REASONS"):
+                    self.reasons |= vals
+                    self.saw_reason_set = True
+                elif t.id == "METRIC_NAMES":
+                    self.metric_names |= vals
+                    self.saw_metric_set = True
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            yield from self._check_reason_site(sf, node)
+            yield from self._check_metric_site(sf, node)
+
+    def _check_reason_site(self, sf, call) -> Iterator[Finding]:
+        if not self.saw_reason_set:
+            return
+        name = terminal_name(call.func)
+        pos = REASON_CALLEES.get(name or "")
+        if pos is None:
+            return
+        arg = call.args[pos] if pos < len(call.args) else None
+        if arg is None:
+            for kw in call.keywords:
+                if kw.arg in _REASON_KWARGS:
+                    arg = kw.value
+                    break
+        if arg is None:
+            return
+        if isinstance(arg, ast.JoinedStr):
+            yield self.finding(
+                sf, arg.lineno,
+                f"f-string in the frozen-reason position of {name}() — "
+                f"pass a *_REASONS member plus the varying part as the "
+                f"detail argument")
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in self.reasons:
+                yield self.finding(
+                    sf, arg.lineno,
+                    f"reason {arg.value!r} passed to {name}() is not a "
+                    f"member of any *_REASONS frozen set — taxonomy fork "
+                    f"(typo?) or a missing registration")
+
+    def _check_metric_site(self, sf, call) -> Iterator[Finding]:
+        if not self.saw_metric_set or not _is_metric_registration(call):
+            return
+        arg = call.args[0] if call.args else None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in self.metric_names:
+                yield self.finding(
+                    sf, arg.lineno,
+                    f"metric name {arg.value!r} is not a member of "
+                    f"observability.metrics.METRIC_NAMES — register it "
+                    f"there so scrape names cannot fork")

@@ -1,0 +1,74 @@
+"""trace-purity: no host nondeterminism inside traced-region code.
+
+Code under ``paddle_tpu/ops/kernels/pallas/`` (and the whole-step trace
+body in ``jit/step_capture.py``) runs inside a jax trace: it executes
+ONCE at compile time, and whatever host values it reads are baked into
+the executable forever. ``time.time()`` becomes a compile-time
+constant, ``np.random.*`` silently fixes the "random" draw for every
+replay, and ``set_flags`` from inside a trace mutates global state the
+flags fingerprint can't see. The reference enforces the same invariant
+with IR verifiers between lowering passes (TPU-MLIR does too); here the
+rule is the verifier.
+
+``flags.bump_mesh_epoch()`` is deliberately ALLOWED: the tp context
+managers bump it at region entry/exit (host side, by design).
+Device-side randomness must come from ``jax.random`` keys; host-side
+sampling kernels live outside the confined paths.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, Rule, SourceFile, attr_chain, register
+
+_CONFINED_PATHS = ("ops/kernels/pallas/", "jit/step_capture.py")
+
+_FORBIDDEN_CHAINS = {
+    "time.time": "a compile-time constant, not a clock",
+    "time.perf_counter": "a compile-time constant, not a clock",
+    "time.monotonic": "a compile-time constant, not a clock",
+    "datetime.now": "a compile-time constant, not a clock",
+    "datetime.datetime.now": "a compile-time constant, not a clock",
+}
+_FORBIDDEN_PREFIXES = {
+    "np.random.": "baked into the executable — use jax.random keys",
+    "numpy.random.": "baked into the executable — use jax.random keys",
+    "random.": "baked into the executable — use jax.random keys",
+}
+_FORBIDDEN_TERMINALS = {
+    "set_flags": "global-flag mutation inside a trace region is "
+                 "invisible to the flags fingerprint",
+}
+
+
+@register
+class TracePurityRule(Rule):
+    id = "trace-purity"
+    help = ("no time.time()/np.random.*/set_flags inside trace-region "
+            "code (pallas kernels, the step-capture trace body)")
+    profiles = ("src",)
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        if not any(p in sf.rel for p in _CONFINED_PATHS):
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain is None:
+                continue
+            why = _FORBIDDEN_CHAINS.get(chain)
+            if why is None:
+                term = chain.rsplit(".", 1)[-1]
+                why = _FORBIDDEN_TERMINALS.get(term)
+            if why is None:
+                for pref, w in _FORBIDDEN_PREFIXES.items():
+                    if chain.startswith(pref):
+                        why = w
+                        break
+            if why is not None:
+                yield self.finding(
+                    sf, node.lineno,
+                    f"`{chain}(...)` in trace-region code: {why}")

@@ -1,0 +1,60 @@
+"""screen_step_fn: run the capture-safety rule on a LIVE function.
+
+This is the runtime face of ``rules/capture_safety.py`` —
+``jit/step_capture.py`` calls it once per wrapped step, before the
+probe run, so a step that can never capture gets a source-located
+diagnosis (``file.py:N: host control flow on a tensor value``) instead
+of paying probe + trace + compile + abort to learn the same thing.
+
+Fail-open by design: no source (REPL, C extension, lambda), unparsable
+source, or any internal error returns ``[]`` — the dynamic probe/abort
+machinery stays authoritative, the screen only short-circuits the
+certain cases. Findings honor the same suppression comments as the CLI
+(``# graftcheck: disable=capture-safety -- <why>`` on the flagged
+line).
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, List
+
+from .core import Finding, SourceFile
+from .rules.capture_safety import CaptureSafetyRule, screen_function
+
+__all__ = ["screen_step_fn"]
+
+
+def screen_step_fn(fn: Callable) -> List[Finding]:
+    """Statically screen a step function for capture-dooming constructs.
+
+    Returns capture-safety findings pointing at the function's real
+    file/lines; ``[]`` when the function is clean or cannot be analyzed.
+    """
+    fn = inspect.unwrap(fn)
+    try:
+        src_lines, start = inspect.getsourcelines(fn)
+        path = inspect.getsourcefile(fn) or "<unknown>"
+    except (OSError, TypeError):
+        return []
+    try:
+        # SourceFile is the ONE implementation of parsing + suppression
+        # comments, so the runtime screen honors exactly the grammar the
+        # CLI does (line numbers here are local to the extracted block)
+        sf = SourceFile(path, textwrap.dedent("".join(src_lines)), path)
+    except SyntaxError:
+        return []
+    fn_node = next((n for n in sf.tree.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))), None)
+    if fn_node is None:
+        return []   # lambda / expression source: nothing to screen
+    rule_id = CaptureSafetyRule.id
+    out = []
+    for local_line, msg in screen_function(fn_node):
+        if sf.suppressed(local_line, rule_id):
+            continue
+        out.append(Finding(rule_id, path, local_line + start - 1, msg))
+    return out

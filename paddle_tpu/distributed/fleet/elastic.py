@@ -168,7 +168,8 @@ class ElasticManager:
         try:
             self.store.delete(f"{self.prefix}/preempt/{self.node_id}")
         except Exception:
-            pass
+            pass  # best-effort: the notice TTL expires it anyway, and the
+            #       store may already be torn down during shutdown
         # preempt_any is NOT deleted here: a check-then-delete would race a
         # concurrent notify from another node; should_checkpoint verifies
         # the flag against per-node notices instead

@@ -108,8 +108,23 @@ class CommTaskManager:
                     try:
                         store.set(f"comm_error/{rank}/{t.name}",
                                   f"timeout after {t.elapsed():.1f}s")
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # the store write is error FAN-OUT, not detection:
+                        # the local handlers below still fire. But a dead
+                        # store while a collective is wedged is exactly
+                        # what a post-mortem needs to see — record it,
+                        # guarded so a recorder failure can never kill
+                        # the scan thread before the handlers run.
+                        try:
+                            from ..observability import \
+                                flight_recorder as _fr
+                            if _fr.enabled():
+                                _fr.recorder().record(
+                                    "watchdog.store_error",
+                                    (f"{type(e).__name__}: {e}", t.name),
+                                    None)
+                        except Exception:
+                            pass  # handler delivery outranks telemetry
                 for fn in self._handlers:
                     fn(t)
 

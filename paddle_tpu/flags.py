@@ -159,6 +159,13 @@ define_flag("step_capture", True,
             "auto-capture; unfusable steps (tensor hooks, create_graph, "
             "data-dependent control flow, dynamic shapes) fall back to "
             "the eager path with the reason in the flight recorder")
+define_flag("step_capture_screen", True,
+            "pre-probe static screen for whole-step capture "
+            "(analysis.screen_step_fn): steps whose source proves them "
+            "uncapturable (host branches/coercions on tensor values, "
+            "tensor hooks, create_graph=True) fall back to eager with a "
+            "source-located diagnosis BEFORE paying the probe + trace + "
+            "abort cycle; False defers entirely to the dynamic path")
 define_flag("use_pallas_kernels", True, "route hot ops to Pallas hand kernels")
 define_flag("benchmark", False, "block on every op for accurate timing")
 define_flag("comm_timeout_s", 600.0,

@@ -124,4 +124,5 @@ class PjrtPredictor:
         try:
             self.close()
         except Exception:
-            pass
+            pass  # interpreter teardown: the ctypes lib/handle may be
+            #       half-collected; raising from __del__ only prints noise

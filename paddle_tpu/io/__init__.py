@@ -344,7 +344,8 @@ class _WorkerPool:
             try:
                 q.put(None)
             except Exception:
-                pass
+                pass  # worker already died and closed its queue: the
+                #       join/terminate below reaps it either way
         for p in self.procs:
             p.join(timeout=5)
             if p.is_alive():

@@ -34,10 +34,16 @@ Unfusable steps — tensor hooks, ``create_graph``, data-dependent Python
 control flow (a concretization error at trace time), schedulers stepped
 with explicit epochs/metrics, ZeRO-sharded optimizer state, input
 arguments that require grad — fall back to the exact eager path with the
-reason recorded in the flight recorder and the
-``step_capture.{captures,replays,fallbacks}`` counters. Shape changes
-miss the structure cache and re-probe; a never-repeating stream of
-structures trips a miss-streak breaker like the fused backward's.
+reason (a frozen ``FALLBACK_REASONS`` member plus detail) recorded in
+the flight recorder and the ``step_capture.{captures,replays,fallbacks}``
+counters. Steps whose SOURCE already proves them uncapturable are caught
+even earlier: the graftcheck capture-safety screen
+(``analysis.screen_step_fn``, gated by ``FLAGS_step_capture_screen``)
+runs once before the probe and short-circuits with a ``file:line``
+diagnosis (``step_capture.static_screened``), so a doomed step never
+pays probe + trace + compile + abort. Shape changes miss the structure
+cache and re-probe; a never-repeating stream of structures trips a
+miss-streak breaker like the fused backward's.
 
 Host-side Python in the step function (logging, metric math) runs during
 probe and capture but NOT during replay — the same contract as
@@ -75,6 +81,7 @@ from .api import _swap_state, _traced_rng
 __all__ = ["jit_step", "CapturedStep", "capture_counters"]
 
 _F_STEP = flags._REGISTRY["step_capture"]
+_F_SCREEN = flags._REGISTRY["step_capture_screen"]
 
 # structure-cache bounds: each entry is a WHOLE-STEP executable, far
 # heavier than a per-op cache slot, so the FIFO is small; the breaker
@@ -89,23 +96,59 @@ _PRIMED = object()
 # observability: authoritative dict (tests snapshot it), published as
 # callback gauges — zero extra hot-path writes
 capture_counters = {"probes": 0, "captures": 0, "replays": 0,
-                    "fallbacks": 0, "bypass": 0, "invalidations": 0}
+                    "fallbacks": 0, "bypass": 0, "invalidations": 0,
+                    "static_screened": 0}
 for _k in ("probes", "captures", "replays", "fallbacks", "bypass",
-           "invalidations"):
+           "invalidations", "static_screened"):
     _metrics_mod.registry().gauge(
         "step_capture." + _k,
         fn=lambda _k=_k: float(capture_counters[_k]),
         help=f"whole-step capture '{_k}' events (jit/step_capture.py)")
 del _k
 
+# Frozen fallback-reason taxonomy. Every reason that can reach
+# _fallback() — from this module, engine._CAPTURE.abort sites, and
+# optimizer.py — lives here, so the flight recorder and the fallbacks
+# counter can never fork on a typo'd or ad-hoc string. Parameterized
+# reasons ("trace failed", "replay failed", "statically screened")
+# carry the varying part in the separate `detail` argument. The
+# graftcheck `taxonomy` rule checks literal call sites statically;
+# _fallback() enforces membership at runtime for computed ones.
+FALLBACK_REASONS = frozenset({
+    "FLAGS_step_capture disabled",
+    "unhashable static argument",
+    "input argument requires grad (grads must land on the caller's "
+    "tensor)",
+    "LR scheduler stepped with an explicit epoch/metric argument",
+    "step mutates an input argument in place",
+    "ZeRO state sharding active on the optimizer",
+    "optimizer.step() on an optimizer not seen during the discovery run",
+    "learning rate changed mid-step (scheduler stepped before "
+    "optimizer.step)",
+    "step mutates a tensor outside the captured state set (stale "
+    "discovery)",
+    "tape has tensor hooks or structurally-unkeyed nodes "
+    "(sot/to_static segments)",
+    "backward(create_graph=True) inside a captured step",
+    "functional grad() capture inside a captured step",
+    "trace failed",
+    "replay failed",
+    "statically screened",
+})
+
 
 class CaptureAbort(Exception):
     """Raised mid-trace when the step cannot be captured faithfully;
-    the caller rolls host state back and replays the eager path."""
+    the caller rolls host state back and replays the eager path.
 
-    def __init__(self, reason: str):
-        super().__init__(reason)
+    `reason` must be a FALLBACK_REASONS member; `detail` carries the
+    parameterization (exception text, source location)."""
+
+    def __init__(self, reason: str, detail: Optional[str] = None):
+        super().__init__(reason if detail is None
+                         else f"{reason}: {detail}")
         self.reason = reason
+        self.detail = detail
 
 
 # -- ambient-state installation ----------------------------------------------
@@ -205,7 +248,7 @@ class _Discovery:
         elif probe.arg_mutated:
             self.reason = "step mutates an input argument in place"
         elif any(o._state_shardings for o in probe.opts):
-            self.reason = "ZeRO state sharding active on an optimizer"
+            self.reason = "ZeRO state sharding active on the optimizer"
 
         state: List[Tensor] = []
         ids: set = set()
@@ -271,8 +314,8 @@ class _TraceCtx:
         self.state_ids = state_ids
         self.opt_in = opt_in    # id(opt) -> {"step","lr","lr_host","calls"}
 
-    def abort(self, reason: str):
-        raise CaptureAbort(reason)
+    def abort(self, reason: str, detail: Optional[str] = None):
+        raise CaptureAbort(reason, detail)
 
     def traced_lr(self, opt):
         rec = self.opt_in.get(id(opt))
@@ -402,18 +445,52 @@ class CapturedStep:
         self._streak = 0
         self._probe_tick = 0
         self._last_reason: Optional[str] = None
+        self._screen: Optional[str] = None     # None=unscreened, ""=clean
         functools.update_wrapper(self, fn, updated=())
 
     # -- fallbacks -----------------------------------------------------------
-    def _fallback(self, reason: str) -> None:
+    def _fallback(self, reason: str, detail: Optional[str] = None) -> None:
+        if reason not in FALLBACK_REASONS:
+            raise ValueError(
+                f"unregistered step_capture fallback reason {reason!r} — "
+                f"add it to FALLBACK_REASONS (frozen so the flight "
+                f"recorder and counters cannot fork)")
         capture_counters["fallbacks"] += 1
-        if reason != self._last_reason:
+        msg = reason if detail is None else f"{reason}: {detail}"
+        if msg != self._last_reason:
             # one ring entry per distinct reason, not per eager step —
             # a long eager run must not bury the dispatch history
-            self._last_reason = reason
+            self._last_reason = msg
             if _flight_mod.enabled():
                 _flight_mod.recorder().record(
-                    "step_capture.fallback", (reason,), None)
+                    "step_capture.fallback", (msg,), reason)
+
+    # -- static screen -------------------------------------------------------
+    def _compute_screen(self) -> str:
+        """Run the graftcheck capture-safety screen over the step's
+        source ONCE; returns "" when clean/unscreenable, else the
+        source-located diagnosis. A doomed step then never pays the
+        probe + trace + compile + abort cycle — the precise reason is
+        known before the first instrumented run."""
+        try:
+            from ..analysis import screen_step_fn
+            findings = screen_step_fn(self._fn)
+        except Exception:
+            return ""   # the screen must never break training; the
+            #             dynamic probe/abort path stays authoritative
+        if not findings:
+            return ""
+        capture_counters["static_screened"] += 1
+        first = findings[0]
+        detail = f"{first.path}:{first.line}: {first.message}"
+        if len(findings) > 1:
+            detail += f" (+{len(findings) - 1} more)"
+        if _flight_mod.enabled():
+            _flight_mod.recorder().record(
+                "step_capture.static_screened",
+                tuple(f"{f.path}:{f.line}: {f.message}" for f in findings),
+                None)
+        return detail
 
     # -- key -----------------------------------------------------------------
     def _state_sig(self):
@@ -449,7 +526,7 @@ class CapturedStep:
         self._disc = _Discovery(probe)
         key = (flags.version, arg_sig, self._state_sig())
         if self._disc.reason is not None:
-            self._put_entry(key, ("unfusable", self._disc.reason))
+            self._put_entry(key, ("unfusable", self._disc.reason, None))
             self._fallback(self._disc.reason)
         elif key not in self._entries:
             self._put_entry(key, _PRIMED)
@@ -551,7 +628,7 @@ class CapturedStep:
         except Exception as e:  # trace failure: data-dependent control
             snap.restore()      # flow, host sync, unpicklable output, ...
             raise CaptureAbort(
-                f"trace failed: {type(e).__name__}: {e}") from e
+                "trace failed", f"{type(e).__name__}: {e}") from e
         d.refresh_baked_versions()
         entry = _Captured(jfn, d, tracebox)
         entry.out_is_tensor = (outbox["tree"], outbox["is_tensor"])
@@ -624,9 +701,11 @@ class CapturedStep:
                     "exist; restore from a checkpoint (or disable "
                     "FLAGS_step_capture and reload)."
                 ) from e
-            reason = getattr(e, "reason",
-                             f"replay failed: {type(e).__name__}: {e}")
-            self._fallback(reason)
+            if isinstance(e, CaptureAbort):
+                self._fallback(e.reason, e.detail)
+            else:
+                self._fallback("replay failed",
+                               f"{type(e).__name__}: {e}")
             return None
         # if jax silently re-traced, the step's host side already ran
         host_effects = not entry.tracebox.pop("ran", False)
@@ -670,6 +749,17 @@ class CapturedStep:
             # program absorbs this step
             return self._fn(*args, **kwargs)
 
+        if _F_SCREEN.value:
+            # pre-probe static screen: a step whose source proves it can
+            # never capture (host branch on a tensor, .numpy()/.item(),
+            # hooks, create_graph=True) short-circuits to eager with a
+            # file:line diagnosis instead of paying probe+trace+abort
+            if self._screen is None:
+                self._screen = self._compute_screen()
+            if self._screen:
+                self._fallback("statically screened", self._screen)
+                return self._fn(*args, **kwargs)
+
         if self._streak >= _MISS_STREAK_MAX:
             # breaker first: a never-repeating structure stream must not
             # even pay the per-call flatten/signature cost
@@ -700,15 +790,15 @@ class CapturedStep:
             try:
                 out = self._attempt_capture(key, dyn_arrays, rebuild)
             except CaptureAbort as e:
-                self._put_entry(key, ("unfusable", e.reason))
+                self._put_entry(key, ("unfusable", e.reason, e.detail))
                 self._disc = None   # a stale discovery gets one re-probe
-                self._fallback(e.reason)
+                self._fallback(e.reason, e.detail)
                 return self._fn(*args, **kwargs)
             capture_counters["captures"] += 1
             self._streak = 0
             return out
-        if isinstance(ent, tuple):      # ("unfusable", reason)
-            self._fallback(ent[1])
+        if isinstance(ent, tuple):      # ("unfusable", reason, detail)
+            self._fallback(ent[1], ent[2])
             return self._fn(*args, **kwargs)
         # compiled: refresh FIFO age, replay
         self._entries.pop(key)

@@ -44,6 +44,38 @@ from .. import flags as _flags
 _F_METRICS = _flags._REGISTRY["metrics"]
 
 
+# The framework's frozen metric taxonomy: every name paddle_tpu itself
+# registers (ops teams scrape these; README documents them). The
+# graftcheck `taxonomy` rule statically checks each registration literal
+# against this set, so a typo'd name cannot silently fork a scrape
+# series. USER code may register any name it likes — this set governs
+# framework sources only. Adding a metric = adding it here first.
+METRIC_NAMES = frozenset({
+    # ops/dispatcher.py
+    "dispatch.count", "dispatch.bind_fast", "dispatch.bind_slow",
+    "dispatch.exec_cache.hits", "dispatch.exec_cache.misses",
+    "dispatch.exec_cache.size",
+    # autograd/engine.py
+    "autograd.backward.count", "autograd.fused.plan_seconds",
+    "autograd.fused.exec_seconds", "autograd.fused.primed",
+    "autograd.fused.hit", "autograd.fused.fallback",
+    "autograd.fused.compile", "autograd.fused.bypass",
+    # static/executor.py
+    "executor.runs", "executor.compiles", "executor.scope_vars",
+    # distributed/collective.py
+    "distributed.collective_calls",
+    # ops/kernels/pallas/tp_attention.py (+ aot.py readers)
+    "tp_attention.sharded", "tp_attention.fallback",
+    # jit/step_capture.py
+    "step_capture.probes", "step_capture.captures",
+    "step_capture.replays", "step_capture.fallbacks",
+    "step_capture.bypass", "step_capture.invalidations",
+    "step_capture.static_screened",
+    # this module's ambient gauges + jax.monitoring listener
+    "device.live_array_bytes", "device.live_arrays", "device.count",
+    "jit.compiles", "jit.compile_seconds",
+})
+
 # default histogram bounds: geometric, 1µs .. ~67s — sized for wall-time
 # observations in seconds (compile times, backward plan/exec times)
 _TIMING_BOUNDS = tuple(1e-6 * 2 ** i for i in range(27))

@@ -740,7 +740,7 @@ def flash_attention(query, key, value, attn_mask=None, rng_key=None,
             ctx = tpa.current_tp_context()
             if ctx is not None:
                 if not flags.get_flag("use_pallas_kernels"):
-                    tpa.record_fallback("flash",
+                    tpa.record_fallback("flash", "flags_off",
                                         "FLAGS_use_pallas_kernels off")
                 else:
                     mesh, head_axis, batch_axis = ctx
@@ -776,7 +776,8 @@ def flash_attn_unpadded_kernel(q, k, v, cu_seqlens_q, cu_seqlens_k,
     if ctx is not None:
         mesh, head_axis, _ba = ctx
         if not flags.get_flag("use_pallas_kernels"):
-            tpa.record_fallback("varlen", "FLAGS_use_pallas_kernels off")
+            tpa.record_fallback("varlen", "flags_off",
+                                "FLAGS_use_pallas_kernels off")
         else:
             out = tpa.sharded_flash_varlen(
                 q, k, v, cu_seqlens_q, cu_seqlens_k, mesh, head_axis,

@@ -208,9 +208,10 @@ def ring_attention(query, key, value, mesh, axis_name: str = "sep",
         else:
             from .tp_attention import record_fallback
             record_fallback(
-                "ring", f"heads {query.shape[2]}/{key.shape[2]} not "
-                        f"divisible by tp degree {tp} (head-replicated "
-                        f"ring instead)")
+                "ring", "ring_head_replicated",
+                f"heads {query.shape[2]}/{key.shape[2]} not "
+                f"divisible by tp degree {tp} (head-replicated "
+                f"ring instead)")
     hdiv = mesh.shape[ha] if ha else 1
     use_pallas = _pallas_block_supported(
         (query.shape[0], sl, query.shape[2] // hdiv, d),

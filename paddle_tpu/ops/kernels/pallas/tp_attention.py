@@ -124,24 +124,48 @@ def current_tp_context() -> Optional[Tuple]:
 
 # -- fallback recording -------------------------------------------------------
 
-def record_fallback(kind: str, reason: str) -> None:
+# Frozen fallback-reason taxonomy: the `key` passed to record_fallback
+# must be a member, so the tp_attention.fallback counter and the flight
+# recorder can never fork on a typo'd reason. The graftcheck `taxonomy`
+# rule checks every literal call site statically; this runtime check
+# covers computed keys. The human-readable `reason` string carries the
+# parameterization (shapes, degrees) and rides the ring entry.
+TP_FALLBACK_REASONS = frozenset({
+    "flags_off",             # FLAGS_use_pallas_kernels disabled
+    "heads_indivisible",     # num_heads % tp != 0
+    "kv_heads_indivisible",  # kv_heads % tp != 0 (GQA replication edge)
+    "shard_unsupported",     # per-shard shape outside the kernel's support
+    "head_dim_mismatch",     # paged: q head_dim != pool head_dim
+    "ring_head_replicated",  # ring attention running head-replicated
+})
+
+
+def record_fallback(kind: str, key: str, reason: str) -> None:
     """Count + flight-record a composite fallback under a TP mesh.
 
-    Recorded at TRACE time (once per compiled specialization, not per
-    step) — one ring entry per distinct fallback site, which is exactly
-    the post-mortem question 'why is this TP run not on the fast
-    path?'."""
+    `key` is the frozen taxonomy member (TP_FALLBACK_REASONS); `reason`
+    the parameterized human-readable detail. Recorded at TRACE time
+    (once per compiled specialization, not per step) — one ring entry
+    per distinct fallback site, which is exactly the post-mortem
+    question 'why is this TP run not on the fast path?'."""
+    if key not in TP_FALLBACK_REASONS:
+        raise ValueError(
+            f"unregistered tp_attention fallback reason {key!r} — add it "
+            f"to TP_FALLBACK_REASONS (frozen so counters cannot fork)")
     _M_FALLBACK.inc()
     if _flight_mod.enabled():
         _flight_mod.recorder().record(
-            f"tp_attention.fallback[{kind}]", (reason,), None)
+            f"tp_attention.fallback[{kind}]", (reason,), key)
 
 
-def _tp_reason(tp: int, hq: int, hk: int) -> Optional[str]:
+def _tp_reason(tp: int, hq: int, hk: int) -> Optional[Tuple[str, str]]:
+    """(taxonomy key, detail) for a divisibility fallback, or None."""
     if hq % tp:
-        return f"num_heads {hq} not divisible by tp degree {tp}"
+        return ("heads_indivisible",
+                f"num_heads {hq} not divisible by tp degree {tp}")
     if hk % tp:
-        return (f"kv_heads {hk} not divisible by tp degree {tp} "
+        return ("kv_heads_indivisible",
+                f"kv_heads {hk} not divisible by tp degree {tp} "
                 f"(GQA replication)")
     return None
 
@@ -182,13 +206,14 @@ def sharded_flash_attention(query, key, value, mesh, head_axis,
     b, sq, hq, d = query.shape
     sk, hk = key.shape[1], key.shape[2]
     tp = mesh.shape[head_axis]
-    reason = _tp_reason(tp, hq, hk)
-    if reason is None and not fa.supported(
+    fb = _tp_reason(tp, hq, hk)
+    if fb is None and not fa.supported(
             (b, sq, hq // tp, d), (b, sk, hk // tp, d), causal):
-        reason = (f"local shard q[{b},{sq},{hq // tp},{d}] "
-                  f"unsupported by the pallas flash kernel")
-    if reason is not None:
-        record_fallback("flash", reason)
+        fb = ("shard_unsupported",
+              f"local shard q[{b},{sq},{hq // tp},{d}] "
+              f"unsupported by the pallas flash kernel")
+    if fb is not None:
+        record_fallback("flash", *fb)
         return None
     if scale is None:
         scale = d ** -0.5
@@ -221,9 +246,9 @@ def sharded_flash_varlen(q, k, v, cu_q, cu_k, mesh, head_axis,
     h, d = q.shape[1], q.shape[2]
     hk = k.shape[1]
     tp = mesh.shape[head_axis]
-    reason = _tp_reason(tp, h, hk)
-    if reason is not None:
-        record_fallback("varlen", reason)
+    fb = _tp_reason(tp, h, hk)
+    if fb is not None:
+        record_fallback("varlen", *fb)
         return None
     if scale is None:
         scale = d ** -0.5
@@ -257,11 +282,12 @@ def sharded_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     B, _, H, D = q.shape
     KV = k_pool.shape[2]
     tp = mesh.shape[head_axis]
-    reason = _tp_reason(tp, H, KV)
-    if reason is None and D != k_pool.shape[3]:
-        reason = f"q head_dim {D} != pool head_dim {k_pool.shape[3]}"
-    if reason is not None:
-        record_fallback("paged", reason)
+    fb = _tp_reason(tp, H, KV)
+    if fb is None and D != k_pool.shape[3]:
+        fb = ("head_dim_mismatch",
+              f"q head_dim {D} != pool head_dim {k_pool.shape[3]}")
+    if fb is not None:
+        record_fallback("paged", *fb)
         return None
     if scale is None:
         scale = D ** -0.5

@@ -92,7 +92,8 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
         ctx = tpa.current_tp_context()
         if ctx is not None:
             if not flags.get_flag("use_pallas_kernels"):
-                tpa.record_fallback("paged", "FLAGS_use_pallas_kernels off")
+                tpa.record_fallback("paged", "flags_off",
+                                    "FLAGS_use_pallas_kernels off")
             else:
                 mesh, head_axis, batch_axis = ctx
                 out = tpa.sharded_paged_attention(

@@ -397,7 +397,8 @@ class Profiler:
                 import jax
                 jax.profiler.stop_trace()
             except Exception:
-                pass
+                pass  # device trace died mid-window (or was never really
+                #       started): the host-span result below still stands
             self._device_tracing = False
         try:  # observability snapshot rides along with the host spans
             from .. import observability

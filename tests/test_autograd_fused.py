@@ -19,9 +19,12 @@ def set_fused(on: bool):
 
 @pytest.fixture(autouse=True)
 def _fused_on():
-    set_fused(True)
+    # direct set_flags (not the set_fused helper) so the graftcheck
+    # test-flag-restore rule sees this autouse fixture as the module's
+    # FLAGS_fused_backward guard
+    paddle.set_flags({"FLAGS_fused_backward": True})
     yield
-    set_fused(True)
+    paddle.set_flags({"FLAGS_fused_backward": True})
 
 
 def run_both(build, n_runs=3):

@@ -336,11 +336,15 @@ class TestReviewRegressions:
 
     def test_set_flags_string_false(self):
         import paddle_tpu as paddle
-        paddle.set_flags({"FLAGS_check_nan_inf": "false"})
-        assert paddle.get_flags("check_nan_inf")["FLAGS_check_nan_inf"] is False
-        paddle.set_flags({"FLAGS_check_nan_inf": "true"})
-        assert paddle.get_flags("check_nan_inf")["FLAGS_check_nan_inf"] is True
-        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        try:
+            paddle.set_flags({"FLAGS_check_nan_inf": "false"})
+            assert paddle.get_flags(
+                "check_nan_inf")["FLAGS_check_nan_inf"] is False
+            paddle.set_flags({"FLAGS_check_nan_inf": "true"})
+            assert paddle.get_flags(
+                "check_nan_inf")["FLAGS_check_nan_inf"] is True
+        finally:  # a mid-test assert must not leak nan-checking on
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
 
     def test_elastic_concurrent_registration_no_lost_update(self):
         import threading

@@ -22,9 +22,11 @@ class TestNativeLib:
         import paddle_tpu as paddle
         lib = native.load()
         assert lib.PT_HasFlag(b"check_nan_inf") == 1
-        paddle.set_flags({"FLAGS_benchmark": True})
-        assert lib.PT_GetFlag(b"benchmark") == b"True"
-        paddle.set_flags({"FLAGS_benchmark": False})
+        try:
+            paddle.set_flags({"FLAGS_benchmark": True})
+            assert lib.PT_GetFlag(b"benchmark") == b"True"
+        finally:  # a failed mirror assert must not leave blocking-ops on
+            paddle.set_flags({"FLAGS_benchmark": False})
         assert lib.PT_GetFlag(b"benchmark") == b"False"
         # python view agrees
         assert paddle.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is False

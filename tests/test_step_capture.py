@@ -220,6 +220,24 @@ def _fallback_reasons():
             if e[3] == "step_capture.fallback"]
 
 
+# Indirections the static screen cannot see through (it analyzes only
+# the step function's own source, never callees): these keep the
+# DYNAMIC fallback machinery covered now that the directly-written
+# constructs are diagnosed pre-probe by the capture-safety screen.
+def _hidden_hook(t, cb):
+    t.register_hook(cb)
+
+
+def _hidden_float(t):
+    return float(t)
+
+
+def _hidden_branch(loss):
+    if float(loss) > 1e9:      # host sync on a tracer, invisible above
+        return loss * 2.0
+    return loss
+
+
 class TestFallbackEdges:
     def _mk(self):
         paddle.seed(0)
@@ -235,7 +253,9 @@ class TestFallbackEdges:
                 for _ in range(n)]
         return outs, before, dict(sc.capture_counters)
 
-    def test_tensor_hooks_fall_back(self):
+    def test_tensor_hooks_screened_pre_probe(self):
+        # a directly-written register_hook is caught by the STATIC
+        # screen: no probe, no capture attempt, hook still fires
         net, opt = self._mk()
         seen = []
 
@@ -249,11 +269,35 @@ class TestFallbackEdges:
 
         outs, b, a = self._drive(step)
         assert a["captures"] == b["captures"]        # never captured
+        assert a["probes"] == b["probes"]            # diagnosed pre-probe
+        assert a["static_screened"] - b["static_screened"] == 1
         assert a["fallbacks"] > b["fallbacks"]
         assert len(seen) == 4                        # hook fired EVERY step
         assert any("hooks" in r for r in _fallback_reasons())
 
-    def test_create_graph_falls_back(self):
+    def test_dynamic_tensor_hooks_fall_back_at_capture(self):
+        # hidden behind a helper, the hook evades the screen and must
+        # still be caught by the engine's dynamic abort
+        net, opt = self._mk()
+        seen = []
+
+        def step(x):
+            loss = net(x).sum()
+            _hidden_hook(loss, lambda g: seen.append(1))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        outs, b, a = self._drive(step)
+        assert a["captures"] == b["captures"]
+        assert a["probes"] > b["probes"]             # screen let it through
+        assert a["static_screened"] == b["static_screened"]
+        assert a["fallbacks"] > b["fallbacks"]
+        assert len(seen) == 4
+        assert any("tensor hooks" in r for r in _fallback_reasons())
+
+    def test_create_graph_screened_pre_probe(self):
         net, opt = self._mk()
 
         def step(x):
@@ -267,8 +311,33 @@ class TestFallbackEdges:
 
         outs, b, a = self._drive(step)
         assert a["captures"] == b["captures"]
+        assert a["probes"] == b["probes"]            # diagnosed pre-probe
+        assert a["static_screened"] - b["static_screened"] == 1
         assert a["fallbacks"] > b["fallbacks"]
         assert any("create_graph" in r for r in _fallback_reasons())
+
+    def test_dynamic_create_graph_falls_back_at_capture(self):
+        # create_graph passed via **kwargs evades the literal screen;
+        # the engine's in-trace abort must still catch it
+        net, opt = self._mk()
+        kw = {"create_graph": True}
+
+        def step(x):
+            y = (net(x) ** 2).sum()
+            g = paddle.grad(y, net.parameters()[0], **kw)[0]
+            loss = (g ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        outs, b, a = self._drive(step)
+        assert a["captures"] == b["captures"]
+        assert a["probes"] > b["probes"]
+        assert a["static_screened"] == b["static_screened"]
+        assert a["fallbacks"] > b["fallbacks"]
+        assert any("create_graph" in r or "functional grad" in r
+                   for r in _fallback_reasons())
 
     def test_flags_off_falls_back(self):
         net, opt = self._mk()
@@ -293,12 +362,13 @@ class TestFallbackEdges:
         assert any("disabled" in r for r in _fallback_reasons())
 
     def test_host_control_flow_falls_back(self):
+        # coercion hidden in a helper: the screen can't prove it, so
+        # the step probes and the TRACE failure is the diagnosis
         net, opt = self._mk()
 
         def step(x):
             loss = net(x).sum()
-            if float(loss) > 1e9:                    # host sync on a tracer
-                loss = loss * 2.0
+            loss = _hidden_branch(loss)              # host sync on a tracer
             loss.backward()
             opt.step()
             opt.clear_grad()
@@ -306,6 +376,8 @@ class TestFallbackEdges:
 
         outs, b, a = self._drive(step)
         assert a["captures"] == b["captures"]
+        assert a["probes"] > b["probes"]
+        assert a["static_screened"] == b["static_screened"]
         assert a["fallbacks"] > b["fallbacks"]
         assert any("trace failed" in r for r in _fallback_reasons())
 
@@ -321,7 +393,7 @@ class TestFallbackEdges:
             loss.backward()
             opt.step()
             opt.clear_grad()
-            lr.step(float(loss))                     # host-value branch
+            lr.step(_hidden_float(loss))             # host-value metric
             return loss
 
         outs, b, a = self._drive(step)
@@ -415,6 +487,158 @@ class TestFallbackEdges:
         assert a["captures"] - b["captures"] == 1
         assert a["replays"] > b["replays"]
         assert float(np.asarray(extra._data)[0]) != 0.0
+
+
+class TestStaticScreen:
+    """The graftcheck capture-safety screen (analysis.screen_step_fn)
+    runs once before the probe: steps whose SOURCE proves them
+    uncapturable are diagnosed with a file:line message and never pay
+    probe + trace + compile + abort. Steps it cannot prove anything
+    about fall through to the dynamic machinery untouched."""
+
+    def _mk(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        return net, opt
+
+    def test_host_branch_diagnosed_pre_probe(self):
+        net, opt = self._mk()
+
+        def step(x):
+            loss = net(x).sum()
+            if float(loss) > 1e9:                    # provable host sync
+                loss = loss * 2.0
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        b = dict(sc.capture_counters)
+        outs = [cap(x) for _ in range(3)]
+        a = dict(sc.capture_counters)
+        assert a["static_screened"] - b["static_screened"] == 1
+        assert a["probes"] == b["probes"]            # never probed
+        assert a["captures"] == b["captures"]
+        assert a["fallbacks"] - b["fallbacks"] == 3  # every call eager
+        assert all(np.isfinite(float(o)) for o in outs)
+        # the ring event carries the precise source location
+        evs = [e for e in fr.recorder().entries()
+               if e[3] == "step_capture.static_screened"]
+        assert evs
+        assert any("test_step_capture.py" in msg and "host control flow"
+                   in msg for msg in evs[-1][4])
+        assert any("statically screened" in r for r in _fallback_reasons())
+
+    def test_numpy_coercion_diagnosed_pre_probe(self):
+        net, opt = self._mk()
+        history = []
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            history.append(loss.numpy())             # host transfer
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        b = dict(sc.capture_counters)
+        for _ in range(2):
+            cap(x)
+        a = dict(sc.capture_counters)
+        assert a["static_screened"] - b["static_screened"] == 1
+        assert a["probes"] == b["probes"]
+        assert len(history) == 2                     # eager semantics kept
+
+    def test_screened_step_matches_pure_eager(self):
+        def run(captured):
+            paddle.set_flags({"FLAGS_step_capture": captured})
+            paddle.seed(0)
+            net = nn.Linear(4, 2)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+
+            def step(x):
+                loss = net(x).sum()
+                if float(loss) > 1e9:
+                    loss = loss * 2.0
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            fn = paddle.jit_step(step) if captured else step
+            losses = []
+            for i in range(3):
+                losses.append(float(fn(paddle.to_tensor(f32(i, 2, 4)))))
+            return losses, [np.asarray(p._data) for p in net.parameters()]
+
+        le, pe = run(False)
+        lc, pc = run(True)       # screened -> exact eager path
+        np.testing.assert_array_equal(le, lc)
+        for a, b in zip(pe, pc):
+            np.testing.assert_array_equal(a, b)
+
+    def test_screen_flag_off_defers_to_dynamic_path(self):
+        net, opt = self._mk()
+
+        def step(x):
+            loss = net(x).sum()
+            if float(loss) > 1e9:
+                loss = loss * 2.0
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        paddle.set_flags({"FLAGS_step_capture_screen": False})
+        try:
+            cap = paddle.jit_step(step)
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            b = dict(sc.capture_counters)
+            for _ in range(3):
+                cap(x)
+            a = dict(sc.capture_counters)
+            assert a["static_screened"] == b["static_screened"]
+            assert a["probes"] > b["probes"]         # dynamic machinery ran
+            assert any("trace failed" in r for r in _fallback_reasons())
+        finally:
+            paddle.set_flags({"FLAGS_step_capture_screen": True})
+
+    def test_suppression_comment_respected_at_runtime(self):
+        # the same `# graftcheck: disable=...` syntax the CLI honors
+        # lets a user overrule the screen on a specific line
+        net, opt = self._mk()
+        seen = []
+
+        def step(x):
+            loss = net(x).sum()
+            loss.register_hook(lambda g: seen.append(1))  # graftcheck: disable=capture-safety -- exercising the dynamic path on purpose
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        b = dict(sc.capture_counters)
+        for _ in range(2):
+            cap(x)
+        a = dict(sc.capture_counters)
+        assert a["static_screened"] == b["static_screened"]
+        assert a["probes"] > b["probes"]             # screen stood down
+        assert len(seen) == 2
+
+    def test_metrics_registry_exports_static_screened(self):
+        from paddle_tpu.observability import metrics as m
+        snap = m.registry().snapshot()
+        assert "step_capture.static_screened" in snap
+        assert snap["step_capture.static_screened"]["value"] >= 0
 
 
 class TestCacheAndInvalidation:
