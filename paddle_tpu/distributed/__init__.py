@@ -54,6 +54,8 @@ from .sharding import (  # noqa: E402,F401
     shard_optimizer_states)
 from . import watchdog  # noqa: E402,F401
 from .watchdog import comm_watchdog  # noqa: E402,F401
+from . import resilience  # noqa: E402,F401
+from .resilience import AsyncCheckpointer, ResilientTrainer  # noqa: E402,F401
 from . import pp_schedules  # noqa: E402,F401
 from .pp_schedules import (  # noqa: E402,F401
     build_fb_schedule, pipeline_train_tables, schedule_report)

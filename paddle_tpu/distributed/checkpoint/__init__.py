@@ -2,12 +2,16 @@
 reshard-on-load (SURVEY §5 checkpoint/resume)."""
 
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
-from .save_load import load_state_dict, save_state_dict  # noqa: F401
+from .save_load import (load_state_dict, save_state_dict,  # noqa: F401
+                        latest_checkpoint, read_committed_marker,
+                        write_committed_marker)
 from .distcp_compat import (convert_from_reference,  # noqa: F401
                             convert_to_reference, load_reference_distcp,
                             save_reference_distcp)
 
 __all__ = ["save_state_dict", "load_state_dict", "Metadata",
            "LocalTensorMetadata", "LocalTensorIndex",
+           "latest_checkpoint", "read_committed_marker",
+           "write_committed_marker",
            "load_reference_distcp", "save_reference_distcp",
            "convert_from_reference", "convert_to_reference"]

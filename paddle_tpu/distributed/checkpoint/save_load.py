@@ -14,7 +14,9 @@ under any other without materialising the global tensor.
 
 from __future__ import annotations
 
+import json
 import os
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -25,6 +27,102 @@ from ..env import get_rank, get_world_size
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
 _METADATA_FILE = "0.metadata"
+_COMMIT_FILE = "COMMITTED"
+
+
+def _fsync_write(path: str, write_fn) -> None:
+    """Torn-write-safe file creation: write to a ``<name>.tmp-<uid>``
+    sibling, flush+fsync, then atomically rename into place. A reader
+    (or a crash at any point) sees either no file or the whole file,
+    never a prefix."""
+    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _fsync_dir(path: str) -> None:
+    try:  # persist the rename itself (no-op on platforms without dir fds)
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_committed_marker(path: str, step: Optional[int] = None,
+                           world_size: Optional[int] = None) -> None:
+    """Write the generation's ``COMMITTED`` marker (atomic, fsynced).
+    ``load_state_dict``/``latest_checkpoint`` only ever observe
+    checkpoints whose marker exists, so a writer killed mid-save leaves
+    an invisible directory, not a torn checkpoint."""
+    payload = json.dumps({
+        "step": step,
+        "world_size": (world_size if world_size is not None
+                       else get_world_size()),
+    }).encode()
+    _fsync_write(os.path.join(path, _COMMIT_FILE), lambda f: f.write(payload))
+
+
+def read_committed_marker(path: str) -> Optional[Dict[str, Any]]:
+    """The parsed ``COMMITTED`` marker, or None when the checkpoint at
+    ``path`` was never committed (or is still being written)."""
+    try:
+        with open(os.path.join(path, _COMMIT_FILE), "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    try:
+        md = json.loads(raw)
+    except ValueError:
+        return None
+    return md if isinstance(md, dict) else None
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    """Resolve the newest COMMITTED checkpoint generation under ``root``.
+
+    Generations are subdirectories carrying a ``COMMITTED`` marker with
+    a step number; uncommitted directories (a writer died mid-save, or a
+    save is in flight right now) are never returned. ``root`` itself is
+    returned when it is a committed single-generation checkpoint."""
+    own = read_committed_marker(root)
+    if own is not None:
+        return root
+    best: Optional[Tuple[int, str, str]] = None
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        sub = os.path.join(root, name)
+        if not os.path.isdir(sub):
+            continue
+        md = read_committed_marker(sub)
+        if md is None:
+            continue
+        step = md.get("step")
+        step = int(step) if isinstance(step, (int, float)) else -1
+        # tie-break on the directory name so equal/unknown steps still
+        # resolve deterministically (lexicographically newest wins)
+        cand = (step, name, sub)
+        if best is None or cand > best:
+            best = cand
+    return best[2] if best is not None else None
 
 
 def _flatten(tree: Dict[str, Any], prefix: str = "", slots=None
@@ -69,19 +167,31 @@ def _shard_key(key: str, offset: Tuple[int, ...]) -> str:
     return key + "|" + ",".join(map(str, offset))
 
 
-def save_state_dict(state_dict: Dict[str, Any], path: str,
-                    process_group=None, coordinator_rank: int = 0,
-                    unique_id: Optional[int] = None) -> None:
-    """Write this process's owned shards + (on rank 0) the global metadata."""
+def collect_shards(state_dict: Dict[str, Any], rank: Optional[int] = None
+                   ) -> Tuple[Dict[str, np.ndarray], Metadata]:
+    """Snapshot this process's owned shards to HOST memory.
+
+    This is the device-touching half of a save: every owned shard box is
+    ``jax.device_get``'d here (device→host copies are started for all
+    arrays up front so transfers overlap), and from the moment it
+    returns the snapshot is immune to donation — a captured step may
+    consume the source buffers on its very next replay. Serialization of
+    the returned (payload, metadata) pair is pure host work that
+    :func:`write_shards` (or a background writer thread) can do later.
+    """
     flat = _flatten(state_dict)
-    rank = get_rank()
-    os.makedirs(path, exist_ok=True)
+    rank = get_rank() if rank is None else rank
     fname = f"{rank}_0.distcp"
 
+    arrs = {key: _as_array(val) for key, val in flat.items()}
+    for arr in arrs.values():
+        try:  # start all D2H transfers before the first blocking read
+            arr.copy_to_host_async()
+        except AttributeError:
+            pass
     payload: Dict[str, np.ndarray] = {}
     md = Metadata(world_size=get_world_size())
-    for key, val in flat.items():
-        arr = _as_array(val)
+    for key, arr in arrs.items():
         boxes: List[LocalTensorMetadata] = []
         for shard in arr.addressable_shards:
             if shard.replica_id != 0:
@@ -98,20 +208,61 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             md.storage_metadata[LocalTensorIndex(key, off)] = fname
         if boxes:
             md.state_dict_metadata[key] = boxes
+    return payload, md
 
-    np.savez(os.path.join(path, fname + ".npz"), **payload)
+
+def write_shards(payload: Dict[str, np.ndarray], md: Metadata, path: str,
+                 rank: Optional[int] = None, coordinator_rank: int = 0
+                 ) -> None:
+    """Serialize one rank's snapshot into ``path`` torn-write-safely:
+    payload first, then metadata, each via tmp+fsync+rename — a crash at
+    any point leaves either nothing or a superseded partial set that the
+    missing ``COMMITTED`` marker keeps invisible to loads."""
+    rank = get_rank() if rank is None else rank
+    fname = f"{rank}_0.distcp"
+    os.makedirs(path, exist_ok=True)
+    _fsync_write(os.path.join(path, fname + ".npz"),
+                 lambda f: np.savez(f, **payload))
     # single-controller: rank 0 writes the merged metadata. Multi-host
     # launches append per-rank metadata files that load() unions.
     meta_name = (_METADATA_FILE if rank == coordinator_rank
                  else f"{rank}.metadata")
-    with open(os.path.join(path, meta_name), "w") as f:
-        f.write(md.to_json())
+    _fsync_write(os.path.join(path, meta_name),
+                 lambda f: f.write(md.to_json().encode()))
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id: Optional[int] = None,
+                    commit: bool = True, step: Optional[int] = None) -> None:
+    """Write this process's owned shards + (on rank 0) the global metadata.
+
+    The write commits atomically: payload and metadata land via
+    tmp+fsync+rename, then the coordinator writes the ``COMMITTED``
+    marker — loads never observe a torn generation. Multi-writer saves
+    that need a cross-rank barrier before the marker pass
+    ``commit=False`` and invoke :func:`write_committed_marker` after
+    their own synchronization (see resilience.AsyncCheckpointer)."""
+    rank = get_rank()
+    payload, md = collect_shards(state_dict, rank=rank)
+    write_shards(payload, md, path, rank=rank,
+                 coordinator_rank=coordinator_rank)
+    if commit and rank == coordinator_rank:
+        write_committed_marker(path, step=step)
 
 
 def _load_metadata(path: str) -> Metadata:
     coord = os.path.join(path, _METADATA_FILE)
     if not os.path.exists(coord):
         raise FileNotFoundError(f"no {_METADATA_FILE} under {path}")
+    if read_committed_marker(path) is None:
+        raise RuntimeError(
+            f"uncommitted/partial checkpoint at {path}: metadata exists "
+            f"but no {_COMMIT_FILE} marker — the writer died mid-save or "
+            f"the save is still in progress; resolve a committed "
+            f"generation via latest_checkpoint() instead (for a LEGACY "
+            f"pre-marker checkpoint known to be complete, backfill with "
+            f"write_committed_marker(path))")
     with open(coord) as f:
         merged = Metadata.from_json(f.read())
     # union exactly the ranks of the save that wrote 0.metadata — stale
@@ -123,7 +274,13 @@ def _load_metadata(path: str) -> Metadata:
         with open(fn) as f:
             md = Metadata.from_json(f.read())
         for k, v in md.state_dict_metadata.items():
-            merged.state_dict_metadata.setdefault(k, []).extend(v)
+            have = merged.state_dict_metadata.setdefault(k, [])
+            # replicated state saved by several single-host ranks (each
+            # sees replica_id 0 locally) unions to the SAME box per rank;
+            # duplicates would double-count coverage in assemble()
+            seen = {(b.global_offset, b.local_shape) for b in have}
+            have.extend(b for b in v
+                        if (b.global_offset, b.local_shape) not in seen)
         merged.storage_metadata.update(md.storage_metadata)
     return merged
 

@@ -86,6 +86,18 @@ class ElasticManager:
         slot = self.store.add(f"{self.prefix}/index_count", 1)
         self.store.set(f"{self.prefix}/index/{slot}", self.node_id)
 
+    @staticmethod
+    def _beat_time(raw) -> Optional[float]:
+        """Parse a heartbeat payload; None for missing OR corrupt values
+        (a half-written/garbage store value must read as 'lease unknown',
+        never crash the watch loop that every healthy node runs)."""
+        if raw is None:
+            return None
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            return None
+
     def alive_nodes(self) -> List[str]:
         """Nodes whose lease (heartbeat) is fresh within TTL.
 
@@ -95,8 +107,9 @@ class ElasticManager:
         now = time.time()
         alive = []
         for n in self._known_nodes():
-            raw = self.store.get(f"{self.prefix}/beat/{n}", wait=False)
-            if raw is not None and now - float(raw) < self.ttl:
+            ts = self._beat_time(
+                self.store.get(f"{self.prefix}/beat/{n}", wait=False))
+            if ts is not None and now - ts < self.ttl:
                 alive.append(n)
         return alive
 
@@ -108,8 +121,9 @@ class ElasticManager:
         now = time.time()
         alive, usable = [], []
         for n in nodes:
-            raw = self.store.get(f"{self.prefix}/beat/{n}", wait=False)
-            if raw is None or now - float(raw) >= self.ttl:
+            ts = self._beat_time(
+                self.store.get(f"{self.prefix}/beat/{n}", wait=False))
+            if ts is None or now - ts >= self.ttl:
                 continue
             alive.append(n)
             notice = self.store.get(f"{self.prefix}/preempt/{n}", wait=False)
@@ -118,10 +132,12 @@ class ElasticManager:
         return alive, usable
 
     def pod_status(self) -> str:
-        # nodes under preemption notice leave the membership immediately,
-        # so the next relaunch re-ranks without them (reference scale-in)
-        preempted = set(self.preempted_nodes())
-        alive = [n for n in self.alive_nodes() if n not in preempted]
+        # one-pass snapshot: alive-and-not-preempted, so nodes under a
+        # preemption notice leave the membership immediately and the next
+        # relaunch re-ranks without them (reference scale-in). The old
+        # alive_nodes()+preempted_nodes() pair cost two full store scans
+        # per poll — exactly what membership_snapshot was added to avoid.
+        _, alive = self.membership_snapshot()
         n = len(alive)
         if n < self.np_min:
             return ElasticStatus.HOLD
@@ -161,8 +177,8 @@ class ElasticManager:
     notice_ttl: float = 120.0
 
     def _notice_fresh(self, raw) -> bool:
-        return raw is not None and \
-            time.time() - float(raw) < self.notice_ttl
+        ts = self._beat_time(raw)   # corrupt notice == no notice
+        return ts is not None and time.time() - ts < self.notice_ttl
 
     def _clear_own_notice(self):
         try:
@@ -212,8 +228,17 @@ class ElasticManager:
             return False
         if nid is None:
             return True
-        return self._notice_fresh(self.store.get(
-            f"{self.prefix}/preempt/{nid}", wait=False))
+        if not self._notice_fresh(self.store.get(
+                f"{self.prefix}/preempt/{nid}", wait=False)):
+            return False
+        # the checkpoint window is "before membership shrinks": once the
+        # notifier's lease has expired it already LEFT — a relaunched
+        # generation must resume training (membership change recovery is
+        # pod_status's job), not checkpoint-and-exit for the rest of the
+        # dead node's notice_ttl
+        beat = self._beat_time(self.store.get(
+            f"{self.prefix}/beat/{nid}", wait=False))
+        return beat is not None and time.time() - beat < self.ttl
 
 
 class PreemptionHandler:

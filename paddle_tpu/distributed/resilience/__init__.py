@@ -1,0 +1,20 @@
+"""paddle_tpu.distributed.resilience — preemption-safe training.
+
+Composes the pieces that already existed separately (elastic TTL-lease
+membership, SIGTERM PreemptionHandler, comm watchdog, reshard-on-load
+`.distcp` checkpoints, crash-dumping flight recorder) into a job that
+actually survives: async snapshot checkpointing whose I/O overlaps the
+captured training step (:class:`AsyncCheckpointer`), and a per-step
+poll that turns a preemption notice or a lost rank into a bounded-loss
+checkpoint-and-relaunch instead of a dead job
+(:class:`ResilientTrainer`). Reference analog: the fleet elastic stack
+(fleet/elastic/manager.py:126) + comm_task_manager error fan-out
+(phi/core/distributed/comm_task_manager.h:37).
+"""
+
+from .checkpointer import (AsyncCheckpointer, flatten_state,  # noqa: F401
+                           restore_state, training_state)
+from .trainer import ResilientTrainer, TrainerAction  # noqa: F401
+
+__all__ = ["AsyncCheckpointer", "ResilientTrainer", "TrainerAction",
+           "flatten_state", "restore_state", "training_state"]

@@ -1,0 +1,264 @@
+"""Rank-death recovery: one per-step poll over every failure signal.
+
+The signals already existed separately — ``PreemptionHandler`` (SIGTERM
+a few tens of seconds before a TPU-VM spot/maintenance kill),
+``ElasticManager.should_checkpoint()`` (a peer's broadcast notice),
+``ElasticManager.pod_status()`` (TTL-lease membership: a SIGKILLed rank
+stops heartbeating), and the comm watchdog (a wedged cross-host
+collective). :class:`ResilientTrainer` composes them into one
+``poll()`` the step loop calls once per step:
+
+* preemption notice (own SIGTERM or a peer's)  →  snapshot NOW
+  (blocking — the VM is about to die) and return ``CHECKPOINT_EXIT``;
+  the process exits cleanly and the launcher relaunches the survivors.
+* lost heartbeat / collective timeout  →  ``RESTART``: the process
+  exits non-zero, the elastic launcher re-ranks the survivors
+  (world-size change included), and the relaunched generation restores
+  from the latest COMMITTED checkpoint via reshard-on-load.
+* otherwise  →  an async snapshot every ``snapshot_every`` steps whose
+  I/O overlaps the next captured steps, then ``CONTINUE``.
+
+Every transition lands in the flight recorder and the
+``resilience.{preemptions,rank_deaths,restores,resume_step}`` metrics,
+so a post-mortem can reconstruct exactly why a generation ended.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ...observability import flight_recorder as _flight
+from ...observability import metrics as _metrics
+from ..checkpoint.save_load import latest_checkpoint
+from .checkpointer import AsyncCheckpointer, restore_state
+
+__all__ = ["ResilientTrainer", "TrainerAction"]
+
+_M_PREEMPTIONS = _metrics.registry().counter(
+    "resilience.preemptions",
+    help="preemption notices this trainer checkpointed-and-exited on")
+_M_RANK_DEATHS = _metrics.registry().counter(
+    "resilience.rank_deaths",
+    help="lost-member / collective-timeout events that forced a restart")
+_M_RESTORES = _metrics.registry().counter(
+    "resilience.restores",
+    help="restores from a committed checkpoint generation")
+_M_RESUME_STEP = _metrics.registry().gauge(
+    "resilience.resume_step",
+    help="step this process resumed from after its last restore")
+
+
+def _record(event: str, info: tuple) -> None:
+    if _flight.enabled():
+        _flight.recorder().record(event, info, None)
+
+
+class TrainerAction:
+    CONTINUE = "continue"
+    CHECKPOINT_EXIT = "checkpoint_exit"   # preempted: snapshot taken, exit 0
+    RESTART = "restart"                   # lost rank: exit for re-rank+restore
+    COMPLETED = "completed"
+
+
+class ResilientTrainer:
+    """Wires checkpointer + elastic membership + watchdog into a loop.
+
+    ``state_fn()`` returns the live state tree to snapshot (model
+    ``state_dict`` + optimizer ``state_dict`` + anything else);
+    ``apply_fn(rebuilt, step)`` pushes restored values back into owners
+    that return copies (e.g. ``optimizer.set_state_dict``) — Tensor
+    leaves are already restored in place before it runs.
+    """
+
+    def __init__(self, checkpointer: AsyncCheckpointer,
+                 state_fn: Callable[[], Any],
+                 apply_fn: Optional[Callable[[Any, int], None]] = None,
+                 elastic=None, watchdog=None,
+                 snapshot_every: int = 50,
+                 install_signal: bool = True,
+                 signum: Optional[int] = None):
+        self.checkpointer = checkpointer
+        self.state_fn = state_fn
+        self.apply_fn = apply_fn
+        self.elastic = elastic
+        self.snapshot_every = max(0, int(snapshot_every))
+        self.handler = None
+        if elastic is not None and install_signal:
+            from ..fleet.elastic import PreemptionHandler
+            self.handler = PreemptionHandler(elastic).install(signum)
+        self._comm_timeout = threading.Event()
+        self._watchdog = watchdog
+        if watchdog is not None:
+            watchdog.add_handler(self._on_comm_timeout)
+        self._preempted = False
+        self._rank_death = False
+        self._next_member_check = 0.0
+        self.resume_step = 0
+
+    # -- watchdog fan-in -----------------------------------------------------
+    def _on_comm_timeout(self, task) -> None:
+        # runs on the watchdog scan thread: flag only, poll() acts on it
+        if not self._comm_timeout.is_set():
+            self._comm_timeout.set()
+            _record("resilience.comm_timeout",
+                    (task.name, f"{task.elapsed():.1f}s"))
+
+    # -- restore -------------------------------------------------------------
+    def restore(self) -> int:
+        """Restore from the newest committed generation (if any) and
+        return the step to resume FROM (committed step + 1, or 0)."""
+        path = latest_checkpoint(self.checkpointer.root)
+        if path is None:
+            return 0
+        rebuilt, step = restore_state(self.state_fn(), path)
+        resume = (step + 1) if step is not None else 0
+        if self.apply_fn is not None:
+            self.apply_fn(rebuilt, resume)
+        _M_RESTORES.inc()
+        _M_RESUME_STEP.set(float(resume))
+        _record("resilience.restore", (path, resume))
+        self.resume_step = resume
+        return resume
+
+    # -- per-step poll -------------------------------------------------------
+    def poll(self, step: int) -> str:
+        """Call once per training step, AFTER the step ran (state holds
+        replay outputs, safe to snapshot). Returns a TrainerAction."""
+        preempted = self._poll_preempted()
+        death = False
+        if not preempted:
+            death = self._poll_rank_death(step)
+            if death:
+                # a peer's notice can land BETWEEN the two store reads:
+                # its departure from membership and its broadcast are
+                # not atomic. Preemption outranks death — re-check, or
+                # this rank restarts instead of checkpointing.
+                preempted = self._poll_preempted()
+        if preempted:
+            if not self._preempted:
+                self._preempted = True
+                _M_PREEMPTIONS.inc()
+                _record("resilience.preempted", (step,))
+            # the host is about to die: the snapshot must be durable
+            # before this process exits, so this save blocks
+            self.checkpointer.save(self.state_fn(),
+                                   self._agree_preempt_step(step),
+                                   block=True)
+            if self.checkpointer.last_error is not None:
+                # the snapshot did NOT commit (disk full, barrier timed
+                # out on a dead peer): exiting "clean" would claim a
+                # durability this process doesn't have — restart instead,
+                # and the relaunch restores the last committed generation
+                _record("resilience.preempt_save_failed",
+                        (step, repr(self.checkpointer.last_error)))
+                return TrainerAction.RESTART
+            return TrainerAction.CHECKPOINT_EXIT
+        if death:
+            if not self._rank_death:
+                self._rank_death = True
+                _M_RANK_DEATHS.inc()
+                _record("resilience.rank_death", (step,))
+            return TrainerAction.RESTART
+        if self.snapshot_every and step > 0 \
+                and step % self.snapshot_every == 0:
+            self.checkpointer.save(self.state_fn(), step)
+        return TrainerAction.CONTINUE
+
+    def _poll_preempted(self) -> bool:
+        if self.handler is not None and self.handler.process():
+            return True
+        return self.elastic is not None and self.elastic.should_checkpoint()
+
+    def _agree_preempt_step(self, step: int) -> int:
+        """Agree on ONE generation tag for the preemption snapshot.
+
+        Peers observe a preemption notice at slightly different local
+        steps, and the commit barrier keys on the generation name — a
+        per-rank tag would leave every rank's snapshot uncommitted. The
+        first observer claims the tag (atomic store add) with its own
+        step; everyone else adopts it, scoped by the notice payload so a
+        later preemption in a relaunched generation negotiates afresh."""
+        store = self.checkpointer.store
+        if store is None or self.checkpointer.world_size <= 1 \
+                or self.elastic is None:
+            return step
+        raw = store.get(f"{self.elastic.prefix}/preempt_any", wait=False)
+        scope = raw.decode().replace("/", "_") if raw else "local"
+        key = f"{self.elastic.prefix}/ckpt_tag/{scope}"
+        try:
+            if store.add(f"{key}/claim", 1) == 1:
+                store.set(key, str(step))
+                return step
+            return int(store.get(key, wait=True, timeout_ms=10_000))
+        except Exception:
+            # store unreachable mid-preemption: save under the local tag
+            # anyway — worst case the barrier times the commit out and
+            # the last periodic generation stays the restore point
+            return step
+
+    def _poll_rank_death(self, step: int) -> bool:
+        if self._comm_timeout.is_set():
+            return True
+        if self.elastic is None:
+            return False
+        # membership needs O(n) store reads — poll at lease granularity,
+        # not step granularity (the one-pass snapshot keeps it 1 scan)
+        now = time.monotonic()
+        if now < self._next_member_check:
+            return False
+        self._next_member_check = now + max(0.5, self.elastic.ttl / 2)
+        from ..fleet.elastic import ElasticStatus
+        return self.elastic.pod_status() in (ElasticStatus.RESTART,
+                                             ElasticStatus.HOLD)
+
+    def close(self) -> None:
+        """Drain pending writes and detach the signal/watchdog hooks
+        (restores the previous SIGTERM handler — test and notebook
+        hygiene; a real job just exits)."""
+        self.checkpointer.wait()
+        if self.handler is not None:
+            self.handler.uninstall()
+            self.handler = None
+        if self._watchdog is not None:
+            try:
+                self._watchdog._handlers.remove(self._on_comm_timeout)
+            except ValueError:
+                # already detached (double close)
+                pass
+            self._watchdog = None
+
+    # -- convenience loop ----------------------------------------------------
+    def run(self, step_fn: Callable[[int], Any], max_steps: int,
+            final_snapshot: bool = True) -> str:
+        """Restore, then drive ``step_fn(step)`` with a poll per step.
+
+        Also catches the captured-step "donated inputs were consumed"
+        replay failure: when a committed generation exists, the loop
+        restores in process and resumes (bounded-loss) instead of dying
+        with unusable state."""
+        step = self.restore()
+        recovered_at = -1
+        while step < max_steps:
+            try:
+                step_fn(step)
+            except RuntimeError as e:
+                if ("donated inputs were consumed" in str(e)
+                        and recovered_at != step
+                        and latest_checkpoint(self.checkpointer.root)
+                        is not None):
+                    recovered_at = step
+                    step = self.restore()
+                    continue
+                raise
+            action = self.poll(step)
+            if action != TrainerAction.CONTINUE:
+                self.checkpointer.wait()
+                return action
+            step += 1
+        if final_snapshot:
+            self.checkpointer.save(self.state_fn(), max_steps - 1,
+                                   block=True)
+        self.checkpointer.wait()
+        return TrainerAction.COMPLETED

@@ -126,7 +126,25 @@ class CommTaskManager:
                         except Exception:
                             pass  # handler delivery outranks telemetry
                 for fn in self._handlers:
-                    fn(t)
+                    try:
+                        fn(t)
+                    except Exception as e:
+                        # a raising handler must not kill the daemon scan
+                        # thread — that would silently disable timeout
+                        # detection for the rest of the process. Record
+                        # it (guarded) and keep fanning out: the OTHER
+                        # handlers (checkpoint-and-restart wiring) still
+                        # deserve the event.
+                        try:
+                            from ..observability import \
+                                flight_recorder as _fr
+                            if _fr.enabled():
+                                _fr.recorder().record(
+                                    "watchdog.handler_error",
+                                    (f"{type(e).__name__}: {e}", t.name),
+                                    None)
+                        except Exception:
+                            pass  # handler delivery outranks telemetry
 
     def shutdown(self):
         self._stop.set()

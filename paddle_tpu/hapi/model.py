@@ -233,8 +233,15 @@ class Model:
     # ------------------------------------------------------------------- fit
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            resilience_dir=None, snapshot_steps=100):
         assert train_data is not None, "train_data must be given"
+        if resilience_dir:
+            # preemption-safe auto-checkpointing: async snapshots every
+            # `snapshot_steps` batches + restore-on-start from the newest
+            # COMMITTED generation (distributed/resilience)
+            callbacks = _to_list(callbacks) + [cbks_mod.ResilientCheckpoint(
+                resilience_dir, snapshot_steps=snapshot_steps)]
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers, drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,

@@ -695,11 +695,20 @@ class CapturedStep:
             self._lr_cache.clear()
             if any(getattr(t._data, "is_deleted", lambda: False)()
                    for t in d.state):
+                if _flight_mod.enabled():
+                    # the post-mortem must distinguish "replay failed,
+                    # eager retry ran" from "donation consumed the
+                    # state" — only the latter needs a restore
+                    _flight_mod.recorder().record(
+                        "step_capture.donation_lost",
+                        (f"{type(e).__name__}: {e}",), None)
                 raise RuntimeError(
                     "step_capture replay failed after its donated inputs "
                     "were consumed — params/optimizer state no longer "
-                    "exist; restore from a checkpoint (or disable "
-                    "FLAGS_step_capture and reload)."
+                    "exist; restore from a committed checkpoint "
+                    "(distributed.resilience.ResilientTrainer.restore / "
+                    "checkpoint.latest_checkpoint) or disable "
+                    "FLAGS_step_capture and reload."
                 ) from e
             if isinstance(e, CaptureAbort):
                 self._fallback(e.reason, e.detail)

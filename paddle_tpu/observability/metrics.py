@@ -71,6 +71,12 @@ METRIC_NAMES = frozenset({
     "step_capture.replays", "step_capture.fallbacks",
     "step_capture.bypass", "step_capture.invalidations",
     "step_capture.static_screened",
+    # distributed/resilience/checkpointer.py
+    "checkpoint.snapshot_seconds", "checkpoint.write_seconds",
+    "checkpoint.committed", "checkpoint.aborted",
+    # distributed/resilience/trainer.py
+    "resilience.preemptions", "resilience.rank_deaths",
+    "resilience.restores", "resilience.resume_step",
     # this module's ambient gauges + jax.monitoring listener
     "device.live_array_bytes", "device.live_arrays", "device.count",
     "jit.compiles", "jit.compile_seconds",

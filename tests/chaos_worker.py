@@ -1,0 +1,101 @@
+"""Chaos-harness training worker (driven by tests/test_resilience.py).
+
+One rank of a deterministic multi-process training run wired through
+ResilientTrainer: heartbeats TTL leases into the parent's TCPStore,
+snapshots through AsyncCheckpointer every few steps, and reacts to the
+chaos the parent injects (SIGKILL = rank death, SIGTERM = preemption).
+Per-step batches are derived from the step index, so a run restored
+from a committed generation retraces the exact loss curve an
+uninterrupted run from that generation produces — the continuity
+property the harness asserts.
+
+argv: out_dir ckpt_dir total_steps
+env:  PADDLE_TRAINER_ID PADDLE_TRAINERS_NUM CHAOS_STORE_PORT
+      CHAOS_ATTEMPT [CHAOS_STEP_SLEEP]
+
+exit: 0 completed | 64 preempted (snapshot committed, clean exit)
+      | 75 lost member (relaunch + restore me)
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+EXIT_CODES = {"completed": 0, "checkpoint_exit": 64, "restart": 75}
+
+
+def main() -> int:
+    out_dir, ckpt_dir, total_steps = (sys.argv[1], sys.argv[2],
+                                      int(sys.argv[3]))
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    attempt = int(os.environ["CHAOS_ATTEMPT"])
+    port = int(os.environ["CHAOS_STORE_PORT"])
+    step_sleep = float(os.environ.get("CHAOS_STEP_SLEEP", "0.05"))
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.fleet import ElasticManager
+    from paddle_tpu.distributed.resilience import (AsyncCheckpointer,
+                                                   ResilientTrainer)
+    from paddle_tpu.native.tcp_store import TCPStore
+
+    store = TCPStore("127.0.0.1", port, is_master=False, world_size=world)
+    elastic = ElasticManager(store, node_id=f"n{rank}", np_min=world,
+                             ttl=2.0, job_id="chaos")
+    elastic.register()
+    assert elastic.wait_for_np(timeout=60), "rendezvous never reached np_min"
+
+    # architecture mirrors tests/test_resilience.py::_tiny_job so the
+    # parent can restore every committed generation into a template
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+
+    losses = open(os.path.join(out_dir, f"losses_r{rank}_a{attempt}.jsonl"),
+                  "a")
+
+    def batch(step):
+        r = np.random.RandomState(1000 + step)
+        x = r.rand(8, 8).astype(np.float32)
+        return x, x.sum(axis=1, keepdims=True).astype(np.float32)
+
+    def step_fn(step):
+        x, y = batch(step)
+        loss = ((net(Tensor(x)) - Tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.write(json.dumps(
+            {"step": step, "loss": float(np.asarray(loss._data))}) + "\n")
+        losses.flush()
+        time.sleep(step_sleep)   # keep kills landing mid-run, not post-run
+
+    def state_fn():
+        return {"model": net.state_dict(), "opt": opt.state_dict()}
+
+    def apply_fn(rebuilt, resume):
+        opt.set_state_dict(rebuilt["opt"])
+
+    ck = AsyncCheckpointer(ckpt_dir, keep=4,
+                           store=store if world > 1 else None,
+                           rank=rank, world_size=world,
+                           barrier_timeout_ms=6000)
+    tr = ResilientTrainer(ck, state_fn, apply_fn, elastic=elastic,
+                          snapshot_every=5, signum=signal.SIGTERM)
+    action = tr.run(step_fn, total_steps)
+    with open(os.path.join(out_dir, f"result_r{rank}_a{attempt}.json"),
+              "w") as f:
+        json.dump({"action": action, "resume": tr.resume_step}, f)
+    elastic.stop()
+    return EXIT_CODES[action]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

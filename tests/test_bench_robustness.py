@@ -176,6 +176,31 @@ class TestStepCaptureMicro:
         assert got["FLAGS_step_capture"] is True
 
 
+class TestCheckpointOverlapMicro:
+    def test_micro_runs_and_meets_gate(self):
+        """bench.py checkpoint_overlap smoke (ISSUE 7 acceptance): async
+        snapshot saves overlapped with captured steps must cost <20% of
+        a blocking save_state_dict in ADDED step time, and the entry
+        must be well-formed for the bench artifact."""
+        r = bench.bench_checkpoint_overlap(False)
+        if r["value"] >= 20.0:    # timing gate: one retry absorbs a
+            r = bench.bench_checkpoint_overlap(False)   # busy-host blip
+        assert r["metric"] == "checkpoint_overlap_added_pct"
+        assert r["unit"] == "pct_of_blocking_added_step_time"
+        d = r["detail"]
+        assert d["base_step_us"] > 0.0
+        assert d["blocking_step_us"] > d["base_step_us"]
+        assert d["added_blocking_us_per_step"] > 0.0
+        assert d["ckpt_every_k_steps"] >= 8
+        # the acceptance gate itself
+        assert r["value"] < 20.0, r
+        assert r["vs_baseline"] > 1.0
+        # the flag the micro toggles must be restored afterwards
+        import paddle_tpu as paddle
+        got = paddle.get_flags(["FLAGS_step_capture"])
+        assert got["FLAGS_step_capture"] is True
+
+
 class TestObservabilityMicro:
     def test_micro_runs_and_reports(self):
         """bench.py observability_overhead smoke: the micro must run on

@@ -19,6 +19,24 @@ from paddle_tpu.distributed.auto_parallel import aot
 V5P_HBM_BYTES = 95 * 1024 ** 3          # 95 GiB per v5p chip
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _isolated_tp_mesh_state():
+    """Cross-module TP-mesh isolation (CHANGES.md PR 5 flagged errors).
+
+    mp_layers/tp_attention read the AMBIENT hybrid-communicate-group at
+    trace time, so an hcg another module built on the 8-device CPU mesh
+    and never cleared makes the v5p-topology lowering device_put onto
+    retired CPU devices ("incompatible devices for jitted computation").
+    Clear it for this module — set_hybrid_communicate_group(None) also
+    bumps the mesh epoch and drops mesh-keyed kernel caches — and clear
+    again on exit so the plans built HERE don't leak state either way.
+    """
+    from paddle_tpu.distributed import topology as topo
+    topo.set_hybrid_communicate_group(None)
+    yield
+    topo.set_hybrid_communicate_group(None)
+
+
 class TestTopologyMesh:
     def test_v5p_64_mesh(self):
         mesh = aot.topology_mesh("v5p:4x4x4", {"dp": 8, "mp": 8})
