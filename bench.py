@@ -901,17 +901,19 @@ def bench_serving(on_tpu: bool):
 # --------------------------------------------------------------------------
 
 def bench_cbatch(on_tpu: bool):
-    """Tokens/s under mixed output lengths: the continuous engine refills
-    slots as sequences finish; the static baseline gang-schedules batches
-    that run until their LONGEST member finishes (VERDICT r4 Next#10).
-    Cost model uses the device clock for the shared compiled decode step
-    and the two prefill widths; scheduling quality (step counts) comes
-    from actually running the engine."""
+    """Tokens/s under mixed output lengths: the (now-baseline)
+    gang-scheduled continuous engine refills slots as sequences finish;
+    the static baseline gang-schedules batches that run until their
+    LONGEST member finishes (VERDICT r4 Next#10). The ragged engine's
+    win over THIS engine is measured by serving_ragged. Cost model uses
+    the device clock for the shared compiled decode step and the two
+    prefill widths; scheduling quality (step counts) comes from actually
+    running the engine."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.models.serving import GangScheduledEngine
     from paddle_tpu.ops.dispatcher import call_op
 
     if on_tpu:
@@ -941,7 +943,7 @@ def bench_cbatch(on_tpu: bool):
                for _ in range(n_req)]
 
     bs = 64 if on_tpu else 4
-    eng = ContinuousBatchingEngine(
+    eng = GangScheduledEngine(
         model, max_batch=max_batch,
         num_blocks=max_batch * (-(-(prompt + int(max(lens)) + bs) // bs))
         + n_req, block_size=bs, temperature=0.0)
@@ -1022,6 +1024,133 @@ def bench_cbatch(on_tpu: bool):
             "baseline": "gang-scheduled batches of max_batch (each runs "
                         "its longest member); same compiled decode step, "
                         "device-clock costs",
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# ragged serving: one-kernel chunked prefill + decode vs the gang engine
+# --------------------------------------------------------------------------
+
+def bench_serving_ragged(on_tpu: bool, quick: bool = False):
+    """ISSUE 8 acceptance micro: tokens/s at mixed prompt/output lengths,
+    ragged engine (chunked prefill + decode in ONE compiled step over the
+    paged pool, prefix-cache sharing) vs the preserved gang-scheduled
+    engine (batch-1 prefill + gang decode) on IDENTICAL request streams.
+    Both engines run end to end twice — the first full run absorbs every
+    compile, the second is timed wall-clock — so the ratio measures the
+    execution model, not XLA. TTFT/TPOT p50/p99 come from the ragged
+    engine's per-request records of the timed run (arrival = enqueue
+    before the run starts, so TTFT includes queue wait under load)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                           GangScheduledEngine)
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=4, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            dtype="bfloat16")
+        max_batch, n_req, bs = 8, 24, 64
+        budget, chunk = 512, 256
+        head_len, plens, olens = 256, (128, 384, 768), (16, 48, 96)
+        paddle.set_default_dtype("bfloat16")
+    else:
+        # request-heavy chat-turn mix: the regime where the gang engine's
+        # per-admission batch-1 prefill stall dominates. `quick` halves
+        # the stream for the tier-1 smoke (same shapes, same code paths)
+        cfg = LlamaConfig.tiny()
+        max_batch, n_req, bs = 4, (10 if quick else 32), 16
+        budget, chunk = 48, 32
+        head_len, plens, olens = 16, (4, 12, 24, 36), (2, 3, 5, 8)
+
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+    finally:
+        if on_tpu:
+            paddle.set_default_dtype("float32")
+
+    # mixed stream: a shared system-prompt head on half the requests
+    # (prefix-cache food), prompt/output lengths cycling the mix
+    rng = np.random.RandomState(3)
+    head = rng.randint(0, cfg.vocab_size, head_len).tolist()
+    reqs = []
+    for i in range(n_req):
+        body = rng.randint(0, cfg.vocab_size,
+                           int(plens[i % len(plens)])).tolist()
+        prompt = (head + body) if i % 2 else body
+        reqs.append((prompt, int(olens[i % len(olens)])))
+    max_total = max(len(p) + n for p, n in reqs)
+    nb = max_batch * (-(-(max_total + bs) // bs)) + 2
+
+    def run_ragged():
+        eng = ContinuousBatchingEngine(
+            model, max_batch=max_batch, num_blocks=nb, block_size=bs,
+            temperature=0.0, token_budget=budget, prefill_chunk=chunk)
+        for p, n in reqs:
+            eng.add_request(p, max_new_tokens=n)
+        eng.run()
+        return eng
+
+    def run_gang():
+        eng = GangScheduledEngine(
+            model, max_batch=max_batch, num_blocks=nb, block_size=bs,
+            temperature=0.0)
+        for p, n in reqs:
+            eng.add_request(p, max_new_tokens=n)
+        eng.run()
+        return eng
+
+    run_ragged()          # warmup: compiles the ragged step
+    run_gang()            # warmup: compiles every prefill width + decode
+    pc_hits0 = obs_metrics.registry().get(
+        "serving.prefix_cache.hit_blocks").value
+    t0 = time.perf_counter()
+    eng_r = run_ragged()
+    t_ragged = time.perf_counter() - t0
+    pc_hits = obs_metrics.registry().get(
+        "serving.prefix_cache.hit_blocks").value - pc_hits0
+    t0 = time.perf_counter()
+    eng_g = run_gang()
+    t_gang = time.perf_counter() - t0
+
+    tokens = float(sum(n for _, n in reqs))
+    done = [eng_r.results[r] for r in eng_r.results]
+    ttft = np.asarray(sorted((r.t_first - r.t_arrive) * 1e3 for r in done))
+    tpot = np.asarray(sorted(
+        (r.t_done - r.t_first) / (len(r.out_tokens) - 1) * 1e3
+        for r in done if len(r.out_tokens) > 1))
+    return {
+        "metric": "serving_ragged_tok_per_sec",
+        "value": round(tokens / t_ragged, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round((tokens / t_ragged) / (tokens / t_gang), 4),
+        "detail": {
+            "requests": n_req, "max_batch": max_batch,
+            "token_budget": budget, "prefill_chunk": chunk,
+            "block_size": bs, "num_blocks": nb,
+            "prompt_lens": sorted({len(p) for p, _ in reqs}),
+            "out_lens": sorted({n for _, n in reqs}),
+            "ragged_steps": eng_r.steps,
+            "gang_steps": eng_g.steps,
+            "gang_prefills": eng_g.prefills,
+            "prefix_cache_hit_blocks": int(pc_hits),
+            "ttft_p50_ms": round(float(np.percentile(ttft, 50)), 2),
+            "ttft_p99_ms": round(float(np.percentile(ttft, 99)), 2),
+            "tpot_p50_ms": round(float(np.percentile(tpot, 50)), 2),
+            "tpot_p99_ms": round(float(np.percentile(tpot, 99)), 2),
+            "gang_tok_per_sec": round(tokens / t_gang, 1),
+            "baseline": "GangScheduledEngine (batch-1 prefill + "
+                        "gang-scheduled decode), same request stream, "
+                        "wall clock after a full warmup run"
+                        + ("" if on_tpu else
+                           " (CPU proxy: Pallas runs interpreted)"),
         },
     }
 
@@ -1792,8 +1921,8 @@ def main():
     which = os.environ.get(
         "PTPU_BENCH_CONFIGS",
         "llama,llamapeak,llama4k,llamalong,resnet,bert,ocr,moe,serving,"
-        "cbatch,aot,tp_attention,micro,dispatch,observability,"
-        "step_capture,checkpoint_overlap")
+        "cbatch,serving_ragged,aot,tp_attention,micro,dispatch,"
+        "observability,step_capture,checkpoint_overlap")
     which = [w.strip() for w in which.split(",") if w.strip()]
     if (on_tpu and len(which) > 1
             and os.environ.get("PTPU_BENCH_ISOLATED", "1") != "0"):
@@ -1876,6 +2005,7 @@ def main():
     for name, fn in (("resnet", bench_resnet), ("bert", bench_bert),
                      ("ocr", bench_ocr), ("moe", bench_moe),
                      ("serving", bench_serving), ("cbatch", bench_cbatch),
+                     ("serving_ragged", bench_serving_ragged),
                      ("aot", bench_aot),
                      ("tp_attention", bench_tp_attention)):
         r = guard(name, fn, on_tpu)

@@ -95,6 +95,37 @@ class PagedKVCache:
             self._allocated[seq] += 1
         return self.block_tables[seq, blk_idx]
 
+    def alloc_slots(self, seq: int, pos0: int, n: int,
+                    alloc_block=None) -> np.ndarray:
+        """Vectorized write slots for ``n`` tokens at ``pos0..pos0+n-1``:
+        block allocation runs once per NEW BLOCK (not per token, the old
+        `_ensure_block`-per-token loop), and the flat slot ids come out
+        of one vectorized expression. ``alloc_block`` overrides the
+        free-list pop — the serving engine routes allocation through its
+        prefix-cache-aware allocator (evictable cached blocks count as
+        free there)."""
+        if n <= 0:
+            return np.empty((0,), np.int64)
+        blk_hi = (pos0 + n - 1) // self.block_size
+        if blk_hi >= self.block_tables.shape[1]:
+            raise RuntimeError(
+                f"PagedKVCache: position {pos0 + n - 1} needs block "
+                f"{blk_hi} but max_blocks_per_seq="
+                f"{self.block_tables.shape[1]}")
+        while self._allocated[seq] <= blk_hi:
+            if alloc_block is not None:
+                blk = alloc_block()
+            elif self._free:
+                blk = self._free.pop()
+            else:
+                raise RuntimeError("PagedKVCache: block pool exhausted")
+            self.block_tables[seq, self._allocated[seq]] = blk
+            self._allocated[seq] += 1
+        pos = pos0 + np.arange(n)
+        return (self.block_tables[seq, pos // self.block_size]
+                .astype(np.int64) * self.block_size
+                + pos % self.block_size)
+
     def release(self, seq: int):
         used = int(self._allocated[seq])
         self._free.extend(int(b) for b in self.block_tables[seq, :used])
@@ -144,12 +175,8 @@ class PagedKVCache:
             # for the whole decode
             self._prefill_kv.clear()
         if self._slot_cache_key != (p0, s):
-            slots = np.empty((b, s), np.int64)
-            for seq in range(b):
-                for i in range(s):
-                    blk = self._ensure_block(seq, p0 + i)
-                    slots[seq, i] = (blk * self.block_size
-                                     + (p0 + i) % self.block_size)
+            slots = np.stack([self.alloc_slots(seq, p0, s)
+                              for seq in range(b)])
             self._slots = Tensor(jnp.asarray(slots.reshape(-1), jnp.int32))
             self._slot_cache_key = (p0, s)
         self.k[layer] = call_op("paged_cache_write", self.k[layer], k_new,

@@ -167,8 +167,12 @@ class LlamaAttention(Layer):
             # decode path: rope at absolute positions, write into the cache,
             # attend against everything written so far (serving kernels).
             # start_pos may be a PER-ROW vector (continuous batching:
-            # every slot decodes at its own depth, models/serving.py)
-            if getattr(start_pos, "ndim", 0) == 1:
+            # every slot decodes at its own depth, models/serving.py) or a
+            # [b, s] PER-TOKEN matrix (ragged mixed prefill+decode: the
+            # packed token axis carries every row's chunk at its own depth)
+            if getattr(start_pos, "ndim", 0) == 2:
+                pos_ids = start_pos
+            elif getattr(start_pos, "ndim", 0) == 1:
                 pos_ids = (start_pos.reshape([b, 1])
                            + call_op("arange", end=s, dtype="int32")
                            .reshape([1, s]))

@@ -1,43 +1,106 @@
-"""Continuous batching over the paged-KV cache — a real serving loop.
+"""Continuous batching over the paged-KV cache — the ragged serving loop.
 
 Reference counterpart: the block_multi_head_attention serving flow
 (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)
-driven by an insert/evict scheduler. TPU-native realisation: ONE compiled
-decode step over a fixed max_batch of slots (static shapes — XLA compiles
-once), with the scheduler purely host-side:
+driven by an insert/evict scheduler, modernised to the "Ragged Paged
+Attention" TPU serving discipline (arXiv:2604.15464) with vLLM-lineage
+chunked prefill and prefix caching:
 
-- requests queue until a slot AND enough pool blocks for their worst case
-  (prompt + max_new_tokens) are free — vLLM-style admission reservation,
-  so decode never hits pool exhaustion mid-flight;
-- admitted requests prefill alone (batch-1 causal pass writing their
-  slot's blocks), then join the next decode step;
-- finished sequences (eos / max_new_tokens) release their blocks
-  immediately, and the freed slot admits the next queued request at the
-  very next step — the continuous part: slots refill while other
-  sequences keep decoding, so stragglers never hold a whole batch
-  hostage the way static batching does;
-- inactive slots ride along masked: their write lands in one reserved
-  trash block and their sampled token is discarded.
+- **One ragged step.** Every scheduler step packs a fixed ``token_budget``
+  of tokens — one per decoding row plus fixed-size prefill chunks of the
+  admitted prompts — into ONE model invocation over the shared pool
+  (`ragged_paged_attention`): static shapes, so XLA compiles the step
+  once and every mix of prefill/decode replays it. Batch-1 prompt
+  prefill and the decode gang-stall around it are gone: long prompts
+  prefill in chunks interleaved with everyone else's decode tokens.
+- **Token-budget admission.** Requests queue until a row slot AND enough
+  pool blocks for their worst case (prompt + max_new_tokens, minus the
+  prefix-cached head) are free — the vLLM reservation rule, so decode
+  never exhausts the pool mid-flight. Head-of-line starvation preempts
+  the LIFO victim (recompute-on-resume) exactly as before.
+- **Prefix cache.** Full prompt blocks are content-hashed (chained, so a
+  block's identity covers its whole prefix) and published after being
+  written; a later request whose prompt shares the head acquires the
+  blocks by refcount instead of recomputing them — admission cost drops
+  to the unshared suffix. Blocks with no active holder stay warm in an
+  evictable FIFO until the allocator needs them; a write into a tracked
+  block copy-on-writes to a fresh block first (defensive: chunked
+  prefill only ever appends past the shared, block-aligned head).
+- **Operability.** Scheduler state (queue depth, active rows, prefill
+  backlog, free blocks, prefix-cache hit/share/eviction, preemptions)
+  exports through the metrics registry — the Prometheus dumper makes
+  the server observable under load — and per-request TTFT/TPOT land in
+  histograms so the bench reports latency percentiles.
+- **Schedule-independent sampling.** Each request samples through its
+  own PRNG stream (`sample_logits_keyed`: engine seed folded with the
+  request id, then the token index), so stochastic output is identical
+  whatever the batching, chunking, or preemption schedule.
 
-Per-row decode positions require a vector start_pos; LlamaAttention
-builds rope position ids from it and PagedKVCache.update consumes the
-engine's precomputed slot vector (set_decode_override).
+`GangScheduledEngine` preserves the previous execution model (batch-1
+prefill + gang-scheduled decode) as the measured baseline and the
+equivalence reference for tests and `bench.py serving_ragged`.
 """
 
 from __future__ import annotations
 
-from collections import deque
+import hashlib
+import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _metrics_mod
 from ..ops.dispatcher import call_op
 from .generation import PagedKVCache
 
-__all__ = ["Request", "ContinuousBatchingEngine"]
+__all__ = ["Request", "ContinuousBatchingEngine", "GangScheduledEngine",
+           "PrefixCache"]
+
+_M = _metrics_mod.registry()
+_M_STEPS = _M.counter(
+    "serving.steps", "ragged scheduler steps executed")
+_M_STEP_TOKENS = _M.counter(
+    "serving.step_tokens", "packed tokens processed (prefill + decode)")
+_M_GEN_TOKENS = _M.counter(
+    "serving.generated_tokens", "tokens sampled and emitted to requests")
+_M_PREFILL_TOKENS = _M.counter(
+    "serving.prefill_tokens", "prompt tokens prefilled (chunked)")
+_M_ADMITTED = _M.counter(
+    "serving.admitted", "requests admitted to a row slot")
+_M_FINISHED = _M.counter(
+    "serving.finished", "requests completed (eos / max_new_tokens)")
+_M_PREEMPTIONS = _M.counter(
+    "serving.preemptions", "LIFO preemptions (head-of-line starvation)")
+_M_QUEUE = _M.gauge(
+    "serving.queue_depth", "requests waiting for admission")
+_M_ACTIVE = _M.gauge(
+    "serving.active_rows", "row slots occupied by live requests")
+_M_BACKLOG = _M.gauge(
+    "serving.prefill_backlog_tokens",
+    "prompt tokens admitted but not yet prefilled")
+_M_FREE = _M.gauge(
+    "serving.free_blocks", "allocatable pool blocks (free + evictable)")
+_M_PC_HIT = _M.counter(
+    "serving.prefix_cache.hit_blocks", "prompt blocks served from cache")
+_M_PC_MISS = _M.counter(
+    "serving.prefix_cache.miss_blocks", "full prompt blocks recomputed")
+_M_PC_SHARED = _M.counter(
+    "serving.prefix_cache.shared_tokens",
+    "prompt tokens whose KV was shared instead of recomputed")
+_M_PC_EVICT = _M.counter(
+    "serving.prefix_cache.evictions",
+    "cached blocks reclaimed by the allocator")
+_M_COW = _M.counter(
+    "serving.cow_copies", "copy-on-write block copies before a shared write")
+_M_TTFT = _M.histogram(
+    "serving.ttft_seconds", "request arrival -> first emitted token")
+_M_TPOT = _M.histogram(
+    "serving.tpot_seconds", "mean inter-token time after the first token")
 
 
 @dataclass
@@ -50,12 +113,96 @@ class Request:
     done: bool = False
     admit_order: int = -1              # LIFO preemption victim choice
     preemptions: int = 0
+    # -- ragged-engine occupancy state (reset on preemption) ---------------
+    ctx: int = 0                       # tokens written to the pool
+    target: int = 0                    # prefill target length
+    full_seq: Optional[np.ndarray] = None
+    block_hashes: List[bytes] = field(default_factory=list)
+    key_data: Optional[np.ndarray] = None   # private sampling stream
+    t_arrive: float = 0.0
+    t_first: Optional[float] = None
+    t_done: Optional[float] = None
+    _registered_upto: int = 0          # prompt blocks published to the cache
+
+
+class PrefixCache:
+    """Content-addressed sharing of full prompt blocks (vLLM lineage).
+
+    A block's key is the CHAINED hash of its tokens and every token
+    before it, so equal keys imply equal KV content. Refcounts track the
+    active holders; blocks whose count drops to zero stay warm in an
+    evictable FIFO (hash retained) until `evict_one` hands them back to
+    the allocator. Registration is first-writer-wins: a concurrent
+    identical prefill keeps its private copy, which the release path
+    simply frees."""
+
+    def __init__(self):
+        self._map: Dict[bytes, int] = {}     # chain digest -> block id
+        self._hash_of: Dict[int, bytes] = {}  # block id -> chain digest
+        self._ref: Dict[int, int] = {}       # block id -> active holders
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def tracked(self, block: int) -> bool:
+        return block in self._ref
+
+    def ref(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    @property
+    def evictable(self) -> int:
+        return len(self._evictable)
+
+    def lookup(self, hashes: List[bytes]) -> List[int]:
+        """Longest cached prefix: block ids for the leading hashes."""
+        out = []
+        for h in hashes:
+            b = self._map.get(h)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def acquire(self, block: int) -> None:
+        self._ref[block] += 1
+        self._evictable.pop(block, None)
+
+    def register(self, h: bytes, block: int) -> bool:
+        if h in self._map:
+            return False
+        self._map[h] = block
+        self._hash_of[block] = h
+        self._ref[block] = 1
+        return True
+
+    def release_block(self, block: int) -> bool:
+        """Drop one hold. True when the block is cache-tracked (the
+        caller must then NOT return it to the free list)."""
+        if block not in self._ref:
+            return False
+        self._ref[block] -= 1
+        if self._ref[block] <= 0:
+            self._ref[block] = 0
+            self._evictable[block] = None
+        return True
+
+    def evict_one(self) -> Optional[int]:
+        """Reclaim the oldest zero-ref cached block for reuse."""
+        if not self._evictable:
+            return None
+        block, _ = self._evictable.popitem(last=False)
+        del self._map[self._hash_of.pop(block)]
+        del self._ref[block]
+        return block
 
 
 class _SlotView:
     """Batch-1 cache facade targeting ONE slot of the shared pool: the
     model's prefill pass (update + causal attend) runs unchanged, but
-    writes land in the slot's block table."""
+    writes land in the slot's block table. (GangScheduledEngine only —
+    the ragged engine prefills through the packed step.)"""
 
     def __init__(self, cache: PagedKVCache, slot: int):
         self._c = cache
@@ -66,12 +213,8 @@ class _SlotView:
         c, slot = self._c, self._slot
         p0 = int(np.asarray(pos._data)) if isinstance(pos, Tensor) \
             else int(pos)
-        s = k_new.shape[1]
-        slots = np.empty((s,), np.int64)
-        for i in range(s):
-            blk = c._ensure_block(slot, p0 + i)
-            slots[i] = blk * c.block_size + (p0 + i) % c.block_size
-        sl = Tensor(jnp.asarray(slots, jnp.int32))
+        sl = Tensor(jnp.asarray(
+            c.alloc_slots(slot, p0, k_new.shape[1]), jnp.int32))
         c.k[layer] = call_op("paged_cache_write", c.k[layer], k_new, sl)
         c.v[layer] = call_op("paged_cache_write", c.v[layer], v_new, sl)
         self._stash[layer] = (k_new, v_new)
@@ -83,7 +226,458 @@ class _SlotView:
                        attn_mask=attn_mask, is_causal=True)
 
 
+class _RaggedView:
+    """Cache facade for ONE ragged step: per-token write slots were
+    precomputed by the scheduler (bulk block allocation, COW-guarded),
+    and attention is the single ragged_paged_attention invocation over
+    the pool — decode rows and prefill chunks in the same call."""
+
+    def __init__(self, cache: PagedKVCache, slots: Tensor, tables: Tensor,
+                 lens: Tensor, cu: Tensor):
+        self._c = cache
+        self._slots = slots
+        self._tables = tables
+        self._lens = lens
+        self._cu = cu
+
+    def update(self, layer: int, k_new: Tensor, v_new: Tensor, pos):
+        c = self._c
+        c.k[layer] = call_op("paged_cache_write", c.k[layer], k_new,
+                             self._slots)
+        c.v[layer] = call_op("paged_cache_write", c.v[layer], v_new,
+                             self._slots)
+        return c.k[layer], c.v[layer]
+
+    def attend(self, layer: int, q: Tensor, pos=None, attn_mask=None):
+        b, s, h, d = q.shape
+        out = call_op("ragged_paged_attention", q.reshape([s, h, d]),
+                      self._c.k[layer], self._c.v[layer],
+                      self._tables, self._lens, self._cu)
+        return out.reshape([b, s, h, d])
+
+
 class ContinuousBatchingEngine:
+    """Ragged continuous batching: chunked prefill + decode in one
+    compiled step over the paged pool, with prefix-cache block sharing.
+
+    ``token_budget`` fixes the packed token count per step (static
+    shapes -> one executable); it must cover at least one token per row
+    (``max_batch``). ``prefill_chunk`` is the fixed chunk size long
+    prompts are sliced into, so a long admission never stalls decode
+    for more than one chunk's worth of compute."""
+
+    def __init__(self, model, max_batch: int, num_blocks: int,
+                 block_size: int = 64,
+                 max_blocks_per_seq: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, preempt_after: Optional[int] = None,
+                 token_budget: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 enable_prefix_cache: bool = True, seed: int = 0):
+        cfg = model.config
+        self.model = model
+        self.eos = eos_token_id
+        self.sampling = dict(temperature=temperature, top_k=top_k,
+                             top_p=top_p)
+        mb = max_blocks_per_seq or (
+            -(-cfg.max_position_embeddings // block_size))
+        self.cache = PagedKVCache(
+            cfg.num_hidden_layers, max_batch, num_blocks=num_blocks,
+            block_size=block_size, num_kv_heads=cfg.num_key_value_heads,
+            head_dim=cfg.hidden_size // cfg.num_attention_heads,
+            max_blocks_per_seq=mb, dtype=getattr(cfg, "dtype", "float32"))
+        self.block_size = block_size
+        self.max_batch = max_batch
+        self.prefill_chunk = prefill_chunk or block_size
+        self.token_budget = token_budget or (max_batch + self.prefill_chunk)
+        if self.token_budget < max_batch:
+            raise ValueError(
+                f"token_budget={self.token_budget} < max_batch={max_batch}:"
+                f" decode rows alone would not fit one step")
+        self.enable_prefix_cache = enable_prefix_cache
+        # one reserved block absorbs the writes of step-padding tokens
+        self._trash_slot = self.cache._free.pop() * block_size
+        self._total_blocks = num_blocks - 1
+        self._pc = PrefixCache()
+        self.slots: List[Optional[Request]] = [None] * max_batch
+        self.pending: deque[Request] = deque()
+        self.results: Dict[int, Request] = {}
+        self.tok = np.zeros((max_batch,), np.int32)
+        self._next_rid = 0
+        self._admit_seq = 0
+        self.steps = 0
+        # head-of-line fairness: preempt the LIFO victim when the queue
+        # head has starved this many steps (None = never preempt)
+        self.preempt_after = preempt_after
+        self._head_waited = 0
+        self.preempt_count = 0
+        # per-request private sampling streams: engine seed -> fold(rid)
+        # -> fold(token index), so stochastic output never depends on the
+        # batching/chunking/preemption schedule (or the global generator).
+        # threefry keys: rbg draws depend on the vmap row position (see
+        # sample_logits_keyed), which would leak the slot assignment back
+        # into the output
+        self._base_key = jax.random.key(seed, impl="threefry2x32")
+        self._key_w = np.asarray(jax.random.key_data(self._base_key)).shape[-1]
+
+    # -- request intake ------------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int = 32) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid, np.asarray(prompt, np.int32).reshape(-1),
+                      max_new_tokens)
+        if len(req.prompt) == 0:
+            raise ValueError("empty prompt: there is no token to prefill, "
+                             "so no logits exist to sample from")
+        mb = self.cache.block_tables.shape[1]
+        if self._blocks_needed(req) > min(self._total_blocks, mb):
+            raise ValueError(
+                f"request needs {self._blocks_needed(req)} blocks but the "
+                f"pool has {self._total_blocks} and a sequence may hold at "
+                f"most max_blocks_per_seq={mb}: it could never be admitted")
+        req.t_arrive = time.time()
+        # sha256 chain digests, NOT builtin hash(): a 64-bit hash()
+        # collision would silently serve another request's KV blocks
+        # (and salted-hash keys are constructible when the seed leaks) —
+        # the same hardening vLLM applied to this exact design
+        h = b""
+        for bi in range(len(req.prompt) // self.block_size):
+            h = hashlib.sha256(
+                h + req.prompt[bi * self.block_size:
+                               (bi + 1) * self.block_size].tobytes()
+            ).digest()
+            req.block_hashes.append(h)
+        req.key_data = np.asarray(jax.random.key_data(
+            jax.random.fold_in(self._base_key, rid)))
+        self.pending.append(req)
+        self.results[rid] = req
+        return rid
+
+    def _blocks_needed(self, req: Request) -> int:
+        return -(-(len(req.prompt) + req.max_new_tokens)
+                 // self.block_size)
+
+    # -- pool accounting -----------------------------------------------------
+    def _free_effective(self) -> int:
+        """Allocatable blocks: the free list plus warm cached blocks with
+        no active holder (the allocator may evict those)."""
+        return len(self.cache._free) + self._pc.evictable
+
+    def _outstanding_reservation(self) -> int:
+        """Blocks the ACTIVE sequences may still claim: their worst case
+        minus what they already hold. Admission must leave room for this,
+        or decode could exhaust the pool mid-flight."""
+        return sum(self._blocks_needed(r)
+                   - int(self.cache._allocated[r.slot])
+                   for r in self.slots if r is not None)
+
+    def _alloc_block(self) -> int:
+        if self.cache._free:
+            return self.cache._free.pop()
+        blk = self._pc.evict_one()
+        if blk is None:
+            raise RuntimeError("PagedKVCache: block pool exhausted")
+        _M_PC_EVICT.inc()
+        return blk
+
+    def _ensure_writable(self, i: int, blk_idx: int) -> None:
+        """Copy-on-write: a write into a cache-tracked block would mutate
+        content other holders (or the cache's hash) still reference —
+        copy it to a fresh private block first. Defensive: the scheduler
+        only appends past the block-aligned shared head, so this fires
+        only if sharing and write ranges ever overlap."""
+        blk = int(self.cache.block_tables[i, blk_idx])
+        if not self._pc.tracked(blk):
+            return
+        fresh = self._alloc_block()
+        # one-block scatter through the cached paged_cache_write
+        # executable (the engine's normal write path — compiled once,
+        # reused for every COW), not an eager full-pool .at[].set
+        bs = self.cache.block_size
+        slots = Tensor(jnp.asarray(fresh * bs + np.arange(bs), jnp.int32))
+        for layer in range(self.cache.num_layers):
+            for pool in (self.cache.k, self.cache.v):
+                rows = Tensor(pool[layer]._data[blk][None])  # [1,BS,KV,D]
+                pool[layer] = call_op("paged_cache_write", pool[layer],
+                                      rows, slots)
+        self.cache.block_tables[i, blk_idx] = fresh
+        self._pc.release_block(blk)
+        _M_COW.inc()
+
+    def _write_slots(self, i: int, pos0: int, n: int) -> np.ndarray:
+        if n > 0 and pos0 % self.block_size:
+            self._ensure_writable(i, pos0 // self.block_size)
+        return self.cache.alloc_slots(i, pos0, n, self._alloc_block)
+
+    # -- admission -----------------------------------------------------------
+    def _admit(self):
+        for i in range(self.max_batch):
+            if not self.pending:
+                return
+            if self.slots[i] is not None:
+                continue
+            req = self.pending[0]
+            full = (np.concatenate([req.prompt,
+                                    np.asarray(req.out_tokens[:-1],
+                                               np.int32)])
+                    if req.out_tokens else req.prompt)
+            target = len(full)
+            hits = (self._pc.lookup(req.block_hashes)
+                    if self.enable_prefix_cache else [])
+            # never share the whole target: the last token must be
+            # recomputed so its logits exist to sample from (and a
+            # resumed row needs a well-formed write position)
+            n_use = min(len(hits), max(0, (target - 1) // self.block_size))
+            # shared blocks with no active holder leave the evictable set,
+            # so they consume allocatable headroom exactly like fresh ones
+            evict_take = sum(1 for b in hits[:n_use]
+                             if self._pc.ref(b) == 0)
+            need = self._blocks_needed(req) - n_use + evict_take
+            if need > self._free_effective() - self._outstanding_reservation():
+                return                 # reservation: wait for reclaims
+            self.pending.popleft()
+            self._head_waited = 0
+            req.slot = i
+            req.admit_order = self._admit_seq
+            self._admit_seq += 1
+            self.slots[i] = req
+            req.full_seq = full
+            req.target = target
+            req._registered_upto = n_use   # shared head: already published
+            for bi in range(n_use):
+                self._pc.acquire(hits[bi])
+                self.cache.block_tables[i, bi] = hits[bi]
+            self.cache._allocated[i] = n_use
+            req.ctx = n_use * self.block_size
+            self.cache.context_lens[i] = req.ctx
+            _M_ADMITTED.inc()
+            if n_use:
+                _M_PC_HIT.inc(n_use)
+                _M_PC_SHARED.inc(n_use * self.block_size)
+            _M_PC_MISS.inc(max(0, len(req.prompt) // self.block_size
+                               - n_use))
+            # n_use is capped at (target-1)//block_size, so ctx < target
+            # here always: every admission prefills at least one token
+            # (a resumed request re-enters decode via step()'s post loop)
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def num_active(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    def _release_slot(self, i: int):
+        used = int(self.cache._allocated[i])
+        for blk in self.cache.block_tables[i, :used]:
+            blk = int(blk)
+            if not self._pc.release_block(blk):
+                self.cache._free.append(blk)
+        self.cache.block_tables[i, :] = 0
+        self.cache.context_lens[i] = 0
+        self.cache._allocated[i] = 0
+        self.cache._slot_cache_key = None
+        self.slots[i] = None
+        self.tok[i] = 0
+
+    def _preempt_lifo(self):
+        """Evict the most-recently-admitted sequence (vLLM's default
+        victim): reclaim its blocks now, requeue it right behind the
+        starved head for recompute-on-resume (its private sampling
+        stream makes the resumed output identical)."""
+        victim = max((r for r in self.slots if r is not None),
+                     key=lambda r: r.admit_order, default=None)
+        if victim is None:
+            return
+        self._release_slot(victim.slot)
+        victim.slot = None
+        victim.ctx = 0
+        victim.full_seq = None      # rebuilt at re-admission
+        victim.preemptions += 1
+        self.preempt_count += 1
+        _M_PREEMPTIONS.inc()
+        self.pending.insert(1, victim)  # right behind the starved head
+
+    def _register_blocks(self, req: Request, i: int, new_ctx: int):
+        """Publish freshly-completed FULL prompt blocks to the prefix
+        cache (never the recomputed tail of a resumed request)."""
+        if not self.enable_prefix_cache:
+            return
+        hi = min(new_ctx, len(req.prompt)) // self.block_size
+        for bi in range(req._registered_upto, hi):
+            self._pc.register(req.block_hashes[bi],
+                              int(self.cache.block_tables[i, bi]))
+        req._registered_upto = max(req._registered_upto, hi)
+
+    def _append_token(self, req: Request, i: int, tok: int, now: float,
+                      finished: List[Request]):
+        req.out_tokens.append(tok)
+        _M_GEN_TOKENS.inc()
+        if req.t_first is None:
+            req.t_first = now
+            _M_TTFT.observe(now - req.t_arrive)
+        self.tok[i] = tok
+        if (len(req.out_tokens) >= req.max_new_tokens
+                or (self.eos is not None and tok == self.eos)):
+            req.done = True
+            req.t_done = now
+            if len(req.out_tokens) > 1:
+                _M_TPOT.observe((now - req.t_first)
+                                / (len(req.out_tokens) - 1))
+            self._release_slot(i)
+            req.slot = None
+            # admission-scoped prefill buffer: a long-running server keeps
+            # every finished Request in self.results (out_tokens are the
+            # result), so drop the prompt+generated copy with it
+            req.full_seq = None
+            _M_FINISHED.inc()
+            finished.append(req)
+
+    # -- the ragged step -----------------------------------------------------
+    def step(self) -> List[Request]:
+        """Admit, then run ONE ragged mixed prefill+decode batch: a token
+        for every decoding row plus prefill chunks up to the token
+        budget, in a single compiled model invocation. Returns the
+        requests that finished during this step."""
+        from ..autograd.engine import no_grad
+
+        self._admit()
+        if self.pending and self.preempt_after is not None:
+            self._head_waited += 1
+            if self._head_waited > self.preempt_after:
+                self._preempt_lifo()
+                self._head_waited = 0
+                self._admit()
+        _M_QUEUE.set(len(self.pending))
+        _M_ACTIVE.set(self.num_active)
+        _M_BACKLOG.set(sum(r.target - r.ctx for r in self.slots
+                           if r is not None and r.ctx < r.target))
+        _M_FREE.set(self._free_effective())
+        if self.num_active == 0:
+            return []
+
+        B, R, bs = self.token_budget, self.max_batch, self.block_size
+        # fixed-size prefill chunks, round-robin by admission order, into
+        # the budget left after every decoding row's token
+        decode_rows = [i for i, r in enumerate(self.slots)
+                       if r is not None and r.ctx >= r.target]
+        prefill_rows = sorted(
+            (i for i, r in enumerate(self.slots)
+             if r is not None and r.ctx < r.target),
+            key=lambda i: self.slots[i].admit_order)
+        grants = dict.fromkeys(prefill_rows, 0)
+        left = B - len(decode_rows)
+        while left > 0:
+            gave = False
+            for i in prefill_rows:
+                req = self.slots[i]
+                g = min(self.prefill_chunk, req.target - req.ctx - grants[i],
+                        left)
+                if g > 0:
+                    grants[i] += g
+                    left -= g
+                    gave = True
+                if left <= 0:
+                    break
+            if not gave:
+                break
+
+        ids = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        slot_vec = np.full((B,), self._trash_slot, np.int64)
+        qlen = np.zeros((R,), np.int32)
+        lens = np.zeros((R,), np.int32)
+        sample_idx = np.zeros((R,), np.int32)
+        stream_pos = np.zeros((R,), np.int32)
+        keys = np.zeros((R, self._key_w), np.uint32)
+        post = []                      # (row, is_decode, n) commit plan
+        t = 0
+        for i in range(R):
+            req = self.slots[i]
+            if req is None:
+                continue
+            if req.ctx >= req.target:                       # decode row
+                ids[t] = self.tok[i]
+                pos[t] = req.ctx
+                slot_vec[t] = self._write_slots(i, req.ctx, 1)[0]
+                qlen[i] = 1
+                lens[i] = req.ctx + 1
+                sample_idx[i] = t
+                stream_pos[i] = len(req.out_tokens)
+                keys[i] = req.key_data
+                post.append((i, True, 1))
+                t += 1
+            else:                                           # prefill chunk
+                n = grants.get(i, 0)
+                lens[i] = req.ctx + n
+                if n == 0:
+                    continue
+                ids[t:t + n] = req.full_seq[req.ctx:req.ctx + n]
+                pos[t:t + n] = np.arange(req.ctx, req.ctx + n)
+                slot_vec[t:t + n] = self._write_slots(i, req.ctx, n)
+                qlen[i] = n
+                if req.ctx + n == req.target and not req.out_tokens:
+                    sample_idx[i] = t + n - 1   # first token: last logits
+                    stream_pos[i] = 0
+                    keys[i] = req.key_data
+                post.append((i, False, n))
+                t += n
+        cu = np.zeros((R + 1,), np.int32)
+        np.cumsum(qlen, out=cu[1:])
+
+        view = _RaggedView(
+            self.cache,
+            Tensor(jnp.asarray(slot_vec, jnp.int32)),
+            Tensor(jnp.asarray(self.cache.block_tables, jnp.int32)),
+            Tensor(jnp.asarray(lens, jnp.int32)),
+            Tensor(jnp.asarray(cu, jnp.int32)))
+        with no_grad():
+            logits = self.model(
+                Tensor(jnp.asarray(ids[None])), cache=view,
+                start_pos=Tensor(jnp.asarray(pos[None], jnp.int32)))
+            lrows = call_op("gather", logits.reshape([B, -1]),
+                            Tensor(jnp.asarray(sample_idx, jnp.int32)))
+            nxt = call_op("sample_logits_keyed", lrows,
+                          Tensor(jnp.asarray(keys)),
+                          Tensor(jnp.asarray(stream_pos, jnp.int32)),
+                          **self.sampling)
+        self.steps += 1
+        _M_STEPS.inc()
+        _M_STEP_TOKENS.inc(t)
+        sampled = np.asarray(nxt._data).reshape(-1)
+        now = time.time()
+        finished: List[Request] = []
+        for i, is_decode, n in post:
+            req = self.slots[i]
+            req.ctx += n
+            self.cache.context_lens[i] = req.ctx
+            if is_decode:
+                self._append_token(req, i, int(sampled[i]), now, finished)
+            else:
+                _M_PREFILL_TOKENS.inc(n)
+                self._register_blocks(req, i, req.ctx)
+                if req.ctx == req.target:
+                    if req.out_tokens:  # resumed: next input pre-sampled
+                        self.tok[i] = req.out_tokens[-1]
+                    else:
+                        self._append_token(req, i, int(sampled[i]), now,
+                                           finished)
+        return finished
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drive until every request (queued + active) completes."""
+        while self.pending or self.num_active:
+            self.step()
+        return {rid: r.out_tokens for rid, r in self.results.items()}
+
+
+class GangScheduledEngine:
+    """The PREVIOUS execution model, preserved as baseline + reference:
+    admitted requests prefill alone at batch-1 against a single slot,
+    and every decode step gang-schedules the whole batch around those
+    stalls. `bench.py serving_ragged` measures the ragged engine against
+    this, and the equivalence tests use it as the sequential
+    batch-1-prefill + gang-decode reference."""
+
     def __init__(self, model, max_batch: int, num_blocks: int,
                  block_size: int = 64,
                  max_blocks_per_seq: Optional[int] = None,
@@ -114,8 +708,7 @@ class ContinuousBatchingEngine:
         self._next_rid = 0
         self._admit_seq = 0
         self.steps = 0
-        # head-of-line fairness: preempt the LIFO victim when the queue
-        # head has starved this many steps (None = never preempt)
+        self.prefills = 0
         self.preempt_after = preempt_after
         self._head_waited = 0
         self.preempt_count = 0
@@ -141,9 +734,6 @@ class ContinuousBatchingEngine:
                  // self.block_size)
 
     def _outstanding_reservation(self) -> int:
-        """Blocks the ACTIVE sequences may still claim: their worst case
-        minus what they already hold. Admission must leave room for this,
-        or decode could exhaust the pool mid-flight."""
         return sum(self._blocks_needed(r)
                    - int(self.cache._allocated[r.slot])
                    for r in self.slots if r is not None)
@@ -168,9 +758,7 @@ class ContinuousBatchingEngine:
             self.slots[i] = req
             view = _SlotView(self.cache, i)
             # a preempted request resumes by re-prefilling prompt + what
-            # it already generated (its blocks were reclaimed — the
-            # recompute-on-resume policy, cheaper than swapping KV host-
-            # side on TPU where prefill is MXU-bound and fast)
+            # it already generated (recompute-on-resume)
             full = (np.concatenate([req.prompt,
                                     np.asarray(req.out_tokens[:-1],
                                                np.int32)])
@@ -180,11 +768,9 @@ class ContinuousBatchingEngine:
                 logits = self.model(ids, cache=view,
                                     start_pos=Tensor(
                                         jnp.asarray(0, jnp.int32)))
+                self.prefills += 1
                 if req.out_tokens:
-                    # resumed after preemption: the next input token was
-                    # already sampled before eviction — keep it and do
-                    # NOT draw (sampling would consume an RNG key and
-                    # make stochastic output schedule-dependent)
+                    # resumed: the next input token was already sampled
                     self.tok[i, 0] = req.out_tokens[-1]
                 else:
                     nxt = call_op("sample_logits", logits[:, -1, :],
@@ -211,15 +797,12 @@ class ContinuousBatchingEngine:
         self.pos[i] = 0
         self.tok[i, 0] = 0
 
-    # -- the continuous loop -------------------------------------------------
+    # -- the gang-scheduled loop ---------------------------------------------
     @property
     def num_active(self) -> int:
         return sum(1 for r in self.slots if r is not None)
 
     def _preempt_lifo(self):
-        """Evict the most-recently-admitted sequence (vLLM's default
-        victim): reclaim its blocks now, requeue it right behind the
-        starved head for recompute-on-resume."""
         victim = max((r for r in self.slots if r is not None),
                      key=lambda r: r.admit_order, default=None)
         if victim is None:

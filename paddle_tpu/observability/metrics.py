@@ -77,6 +77,14 @@ METRIC_NAMES = frozenset({
     # distributed/resilience/trainer.py
     "resilience.preemptions", "resilience.rank_deaths",
     "resilience.restores", "resilience.resume_step",
+    # models/serving.py (ragged continuous-batching engine)
+    "serving.steps", "serving.step_tokens", "serving.generated_tokens",
+    "serving.prefill_tokens", "serving.admitted", "serving.finished",
+    "serving.preemptions", "serving.queue_depth", "serving.active_rows",
+    "serving.prefill_backlog_tokens", "serving.free_blocks",
+    "serving.prefix_cache.hit_blocks", "serving.prefix_cache.miss_blocks",
+    "serving.prefix_cache.shared_tokens", "serving.prefix_cache.evictions",
+    "serving.cow_copies", "serving.ttft_seconds", "serving.tpot_seconds",
     # this module's ambient gauges + jax.monitoring listener
     "device.live_array_bytes", "device.live_arrays", "device.count",
     "jit.compiles", "jit.compile_seconds",

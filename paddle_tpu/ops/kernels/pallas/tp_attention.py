@@ -137,6 +137,9 @@ TP_FALLBACK_REASONS = frozenset({
     "shard_unsupported",     # per-shard shape outside the kernel's support
     "head_dim_mismatch",     # paged: q head_dim != pool head_dim
     "ring_head_replicated",  # ring attention running head-replicated
+    "ragged_rows_replicated",  # ragged serving: rows asked onto dp, but
+                               # the packed token axis is ragged — heads
+                               # still shard, rows stay replicated
 })
 
 
@@ -312,3 +315,56 @@ def sharded_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     _M_SHARDED.inc()
     return fn(q, k_pool, v_pool, block_tables.astype(jnp.int32),
               context_lens.astype(jnp.int32))
+
+
+def sharded_ragged_paged_attention(q, k_pool, v_pool, block_tables,
+                                   context_lens, cu_q_lens, mesh,
+                                   head_axis, batch_axis=None, scale=None):
+    """Ragged mixed prefill+decode serving attention with q heads AND
+    the pool's kv heads sharded over `head_axis`. The packed token axis
+    is ragged (cu_q_lens segments it), so rows CANNOT co-shard over a
+    data axis the way gang decode's batch dim does — when the caller
+    asks for one anyway the request is recorded (frozen reason
+    `ragged_rows_replicated`) and the kernel still runs head-sharded
+    with rows replicated. Returns None (recorded) on the divisibility /
+    head-dim edges; the caller then takes the composite."""
+    from . import ragged_paged_attention as rpa
+
+    T, H, D = q.shape
+    KV = k_pool.shape[2]
+    tp = mesh.shape[head_axis]
+    fb = _tp_reason(tp, H, KV)
+    if fb is None and D != k_pool.shape[3]:
+        fb = ("head_dim_mismatch",
+              f"q head_dim {D} != pool head_dim {k_pool.shape[3]}")
+    if fb is not None:
+        record_fallback("ragged", *fb)
+        return None
+    if batch_axis and mesh.shape.get(batch_axis, 1) > 1:
+        record_fallback(
+            "ragged", "ragged_rows_replicated",
+            f"ragged rows cannot shard over {batch_axis!r} "
+            f"(degree {mesh.shape[batch_axis]}): packed token axis is "
+            f"ragged; running head-sharded with rows replicated")
+    if scale is None:
+        scale = D ** -0.5
+
+    def build():
+        qspec = P(None, head_axis, None)
+        pspec = P(None, None, head_axis, None)
+        rep2, rep1 = P(None, None), P(None)
+
+        def local(q_, kp, vp, tbl, lens, cu):
+            return rpa.ragged_paged_attention(q_, kp, vp, tbl, lens, cu,
+                                              scale=scale)
+
+        return jax.jit(shard_map(
+            local, mesh=mesh,
+            in_specs=(qspec, pspec, pspec, rep2, rep1, rep1),
+            out_specs=qspec, axis_names=frozenset({head_axis}),
+            check_vma=False))
+
+    fn = _cached(("ragged", mesh, head_axis, float(scale)), build)
+    _M_SHARDED.inc()
+    return fn(q, k_pool, v_pool, block_tables.astype(jnp.int32),
+              context_lens.astype(jnp.int32), cu_q_lens.astype(jnp.int32))

@@ -118,12 +118,78 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
     return scaled_dot_product_attention(q, k, v, attn_mask=mask, scale=scale)
 
 
-@register_kernel("sample_logits")
-def sample_logits_kernel(logits, key, temperature=1.0, top_k=0, top_p=1.0):
-    """Token sampling head: greedy when temperature==0, else
-    temperature/top-k/top-p filtered categorical draw. logits[B,V] → [B]."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _ragged_composite(q, k_pool, v_pool, block_tables, context_lens,
+                      cu_q_lens, scale=None):
+    """XLA composite for ragged mixed prefill+decode attention: per-token
+    expansion of the dense paged gather. Every packed token gathers its
+    row's blocks and attends as a batch-1 decode row whose visible
+    context is its own absolute position + 1 — causality inside a
+    prefill chunk falls out of the per-token bound. Memory scales with
+    T * MB * BS (vs B * MB * BS for gang decode); the Pallas kernel
+    streams blocks instead."""
+    T = q.shape[0]
+    nb, bs = k_pool.shape[0], k_pool.shape[1]
+    R, mb = block_tables.shape
+    cu = cu_q_lens.astype(jnp.int32)
+    tok = jnp.arange(T, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(cu, tok, side="right")
+                   .astype(jnp.int32) - 1, 0, R - 1)
+    qlen = cu[row + 1] - cu[row]
+    qpos = (context_lens.astype(jnp.int32)[row] - qlen + (tok - cu[row]))
+    # step-padding tokens carry garbage positions; clamp so their (then
+    # discarded) rows still see one finite score instead of all -inf
+    qpos = jnp.clip(qpos, 0, None)
+    tbl = jnp.clip(block_tables.astype(jnp.int32), 0, nb - 1)[row]
+    k = k_pool[tbl].reshape(T, mb * bs, *k_pool.shape[2:])
+    v = v_pool[tbl].reshape(T, mb * bs, *v_pool.shape[2:])
+    mask = (jnp.arange(mb * bs, dtype=jnp.int32)[None, None, None, :]
+            <= qpos[:, None, None, None])
+    out = scaled_dot_product_attention(q[:, None], k, v, attn_mask=mask,
+                                       scale=scale)
+    return out[:, 0]
+
+
+@register_kernel("ragged_paged_attention")
+def ragged_paged_attention_kernel(q, k_pool, v_pool, block_tables,
+                                  context_lens, cu_q_lens, scale=None):
+    """ONE kernel for a ragged mix of prefill chunks and decode rows
+    over the paged KV pool (Ragged Paged Attention, arXiv:2604.15464).
+
+    q[T,H,D] packed query tokens segmented by cu_q_lens[R+1]; pools
+    [NB,BS,KV,D]; block_tables[R,MB]; context_lens[R] counts the tokens
+    visible per row AFTER this step's chunk was written (write-then-
+    attend order). Decode rows contribute q_len 1, prefill chunks their
+    chunk size. Routed to the Pallas tile kernel
+    (pallas/ragged_paged_attention.py) when FLAGS_use_pallas_kernels;
+    under an ambient TP mesh heads shard over mp via shard_map
+    (pallas/tp_attention.py); XLA per-token gather composite otherwise,
+    with TP fallbacks recording their frozen reason."""
+    from ... import flags
+    from .pallas import ragged_paged_attention as rpa
+    if rpa.supported(q.shape, k_pool.shape):
+        from .pallas import tp_attention as tpa
+        ctx = tpa.current_tp_context()
+        if ctx is not None:
+            if not flags.get_flag("use_pallas_kernels"):
+                tpa.record_fallback("ragged", "flags_off",
+                                    "FLAGS_use_pallas_kernels off")
+            else:
+                mesh, head_axis, batch_axis = ctx
+                out = tpa.sharded_ragged_paged_attention(
+                    q, k_pool, v_pool, block_tables, context_lens,
+                    cu_q_lens, mesh, head_axis, batch_axis, scale)
+                if out is not None:
+                    return out
+        elif flags.get_flag("use_pallas_kernels"):
+            return rpa.ragged_paged_attention(
+                q, k_pool, v_pool, block_tables, context_lens, cu_q_lens,
+                scale)
+    return _ragged_composite(q, k_pool, v_pool, block_tables, context_lens,
+                             cu_q_lens, scale)
+
+
+def _filter_logits(logits, temperature, top_k, top_p):
+    """Temperature/top-k/top-p filtering shared by both sampling heads."""
     logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
     V = logits.shape[-1]
     if top_k and top_k < V:
@@ -137,4 +203,44 @@ def sample_logits_kernel(logits, key, temperature=1.0, top_k=0, top_p=1.0):
         cutoff_idx = jnp.sum(cum < top_p, axis=-1)
         cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+@register_kernel("sample_logits")
+def sample_logits_kernel(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """Token sampling head: greedy when temperature==0, else
+    temperature/top-k/top-p filtered categorical draw. logits[B,V] → [B].
+    The key is injected from the GLOBAL generator (ops.yaml `key: true`),
+    so draws depend on every other consumer of the global stream — fine
+    for generate(), wrong for a serving engine (see sample_logits_keyed)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _filter_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@register_kernel("sample_logits_keyed")
+def sample_logits_keyed_kernel(logits, key_data, stream_pos,
+                               temperature=1.0, top_k=0, top_p=1.0):
+    """Per-row keyed sampling for the serving engine: logits[B,V],
+    key_data[B,W] (raw uint32 key data of each row's PRIVATE stream,
+    jax.random.key_data of a per-request key), stream_pos[B] int32 (the
+    row's token index, folded in per draw) → [B] int32.
+
+    Row r's draw is a pure function of (its key, its token index), so
+    a request's stochastic output is SCHEDULE-INDEPENDENT: batching,
+    chunked prefill, and preemption re-ordering never change which key
+    samples which token — the property the continuous-batching engine
+    needs for deterministic replay and preemption-transparent output."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # threefry, NOT FLAGS_rng_impl: the rbg generator's bits depend on a
+    # key's position inside a vmapped batch, so a request's draw would
+    # change with the slot it happens to occupy — exactly the
+    # schedule-dependence this op exists to eliminate. threefry draws are
+    # a pure function of (key, shape).
+    keys = jax.random.wrap_key_data(key_data, impl="threefry2x32")  # [B]
+    keys = jax.vmap(jax.random.fold_in)(keys,
+                                        stream_pos.astype(jnp.uint32))
+    filt = _filter_logits(logits, temperature, top_k, top_p)
+    return jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
