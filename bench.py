@@ -1155,6 +1155,167 @@ def bench_serving_ragged(on_tpu: bool, quick: bool = False):
     }
 
 
+def bench_serving_recovery(on_tpu: bool, quick: bool = False):
+    """ISSUE 9 acceptance micro: the resilient-serving round trip.
+
+    Three measurements over identical request streams (one shared
+    prompt head — prefix-cache and warm-start food — plus per-request
+    bodies), all after a warmup run absorbs every compile:
+
+    * drain + relaunch wall clock: SIGTERM-style drain mid-stream
+      (journal committed, prefix cache snapshotted), then the relaunch's
+      recovery cost (journal load + warm preload + re-admission);
+    * replay throughput: tokens the relaunch REGENERATES (beyond the
+      journaled watermarks) per second of run time — recovery re-derives
+      KV by prefill instead of loading a snapshot, so this is the
+      honest recovery-speed number;
+    * cold vs warm TTFT p50: the same stream on a cold pool vs a pool
+      preloaded from the drain's prefix-cache snapshot. Warm must be
+      STRICTLY lower — the snapshot exists to buy exactly this.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving.resilience import (ResilientServingEngine,
+                                               load_prefix_cache)
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=4, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            dtype="bfloat16")
+        max_batch, n_req, bs = 8, 24, 64
+        budget, chunk, head_len, max_new = 384, 256, 768, 16
+        blens = (64, 128, 256)
+        paddle.set_default_dtype("bfloat16")
+    else:
+        cfg = LlamaConfig.tiny()
+        max_batch, n_req, bs = 4, (8 if quick else 16), 16
+        budget, chunk, head_len, max_new = 20, 16, 64, 4
+        blens = (4, 8, 12, 16)
+
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+    finally:
+        if on_tpu:
+            paddle.set_default_dtype("float32")
+
+    rng = np.random.RandomState(5)
+    head = rng.randint(0, cfg.vocab_size, head_len).tolist()
+    reqs = [(head + rng.randint(0, cfg.vocab_size,
+                                int(blens[i % len(blens)])).tolist(),
+             max_new) for i in range(n_req)]
+    max_total = max(len(p) + n for p, n in reqs)
+    nb = max_batch * (-(-(max_total + bs) // bs)) + head_len // bs + 8
+    eng_kw = dict(max_batch=max_batch, num_blocks=nb, block_size=bs,
+                  temperature=0.7, seed=11, token_budget=budget,
+                  prefill_chunk=chunk)
+
+    work = tempfile.mkdtemp(prefix="ptpu_recovery_")
+    try:
+        def resilient(name, **kw):
+            return ResilientServingEngine(
+                model, os.path.join(work, name), **{**eng_kw, **kw})
+
+        def ttfts(engine):
+            return np.asarray(sorted(
+                (r.t_first - r.t_arrive) * 1e3 for r in engine))
+
+        # warmup: absorb the ragged-step (and sampler) compiles
+        w = ContinuousBatchingEngine(model, **eng_kw)
+        for p, n in reqs[:max_batch]:
+            w.add_request(p, max_new_tokens=n)
+        w.run()
+
+        # drain mid-stream + relaunch + replay
+        e1 = resilient("r", journal_flush_every=1)
+        for p, n in reqs:
+            e1.add_request(p, max_new_tokens=n)
+        # drain mid-stream, AFTER the first wave starts decoding: the
+        # journal then holds real watermarks (replay = committed prefix
+        # + regenerated tail), and the drain snapshot holds the full
+        # published head
+        for _ in range(400):
+            e1.step()
+            if sum(len(r.out_tokens)
+                   for r in e1.engine.results.values()) >= max_batch:
+                break
+        drain_s = e1.drain(deadline_s=0.0)    # journal-and-preempt all
+        e1.close()
+        t0 = time.perf_counter()
+        e2 = resilient("r")
+        recover_s = time.perf_counter() - t0
+        committed = sum(e2._watermark.values()) \
+            + sum(len(t) for t in e2.outputs.values())
+        replayed_requests = e2.replayed_requests
+        warm_blocks = e2.warm_blocks
+        t0 = time.perf_counter()
+        e2.run()
+        replay_run_s = time.perf_counter() - t0
+        total = sum(len(t) for t in e2.outputs.values())
+        regenerated = total - committed
+        e2.close()
+
+        # cold vs warm TTFT on plain engines (no journal fsyncs in the
+        # latency path; the warm pool preloads the drain-era snapshot)
+        warm_src = os.path.join(work, "r", "warmcache")
+        cold = ContinuousBatchingEngine(model, **eng_kw)
+        for p, n in reqs:
+            cold.add_request(p, max_new_tokens=n)
+        cold.run()
+        warm = ContinuousBatchingEngine(model, **eng_kw)
+        warm_loaded = load_prefix_cache(warm, warm_src)
+        for p, n in reqs:
+            warm.add_request(p, max_new_tokens=n)
+        warm.run()
+        ttft_cold = ttfts(cold.results.values())
+        ttft_warm = ttfts(warm.results.values())
+        cold_p50 = float(np.percentile(ttft_cold, 50))
+        warm_p50 = float(np.percentile(ttft_warm, 50))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    return {
+        "metric": "serving_recovery_warm_ttft_speedup",
+        "value": round(cold_p50 / warm_p50, 4),
+        "unit": "cold_ttft_p50 / warm_ttft_p50",
+        "vs_baseline": round(cold_p50 / warm_p50, 4),
+        "detail": {
+            "requests": n_req, "max_batch": max_batch,
+            "block_size": bs, "num_blocks": nb,
+            "head_len": head_len, "token_budget": budget,
+            "prefill_chunk": chunk, "max_new_tokens": max_new,
+            "drain_s": round(drain_s, 4),
+            "recover_s": round(recover_s, 4),
+            "drain_relaunch_s": round(drain_s + recover_s, 4),
+            "replayed_requests": replayed_requests,
+            "replay_committed_tokens": committed,
+            "replay_regenerated_tokens": regenerated,
+            "replay_tok_per_sec": round(regenerated / replay_run_s, 1),
+            "warm_blocks_preloaded": warm_loaded,
+            "warm_blocks_at_relaunch": warm_blocks,
+            "ttft_cold_p50_ms": round(cold_p50, 2),
+            "ttft_warm_p50_ms": round(warm_p50, 2),
+            "ttft_cold_p99_ms": round(float(np.percentile(ttft_cold, 99)),
+                                      2),
+            "ttft_warm_p99_ms": round(float(np.percentile(ttft_warm, 99)),
+                                      2),
+            "baseline": "identical stream on a cold pool vs the drain's "
+                        "prefix-cache snapshot preloaded; drain/replay "
+                        "timed through the journaled wrapper"
+                        + ("" if on_tpu else
+                           " (CPU proxy: Pallas runs interpreted)"),
+        },
+    }
+
+
 # --------------------------------------------------------------------------
 # deviceless v5p-64 AOT: the BASELINE north-star job compiled for 64 chips
 # --------------------------------------------------------------------------
@@ -1921,8 +2082,8 @@ def main():
     which = os.environ.get(
         "PTPU_BENCH_CONFIGS",
         "llama,llamapeak,llama4k,llamalong,resnet,bert,ocr,moe,serving,"
-        "cbatch,serving_ragged,aot,tp_attention,micro,dispatch,"
-        "observability,step_capture,checkpoint_overlap")
+        "cbatch,serving_ragged,serving_recovery,aot,tp_attention,micro,"
+        "dispatch,observability,step_capture,checkpoint_overlap")
     which = [w.strip() for w in which.split(",") if w.strip()]
     if (on_tpu and len(which) > 1
             and os.environ.get("PTPU_BENCH_ISOLATED", "1") != "0"):
@@ -2006,6 +2167,7 @@ def main():
                      ("ocr", bench_ocr), ("moe", bench_moe),
                      ("serving", bench_serving), ("cbatch", bench_cbatch),
                      ("serving_ragged", bench_serving_ragged),
+                     ("serving_recovery", bench_serving_recovery),
                      ("aot", bench_aot),
                      ("tp_attention", bench_tp_attention)):
         r = guard(name, fn, on_tpu)

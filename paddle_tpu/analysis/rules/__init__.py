@@ -9,6 +9,7 @@ invariant it encodes and where the invariant comes from.
 from . import capture_safety  # noqa: F401
 from . import compat_shim     # noqa: F401
 from . import donation        # noqa: F401
+from . import durability      # noqa: F401
 from . import hygiene         # noqa: F401
 from . import taxonomy        # noqa: F401
 from . import trace_purity    # noqa: F401

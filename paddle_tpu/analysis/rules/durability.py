@@ -1,0 +1,123 @@
+"""durability: resilience code must write files through the commit
+protocol, never bare.
+
+Everything under ``distributed/resilience/`` and ``serving/resilience/``
+exists to make crashes recoverable, which only holds if every file it
+produces is torn-write-safe: written to a tmp sibling, fsynced,
+atomically renamed, made visible by a COMMITTED marker
+(:mod:`paddle_tpu.utils.durability`). A bare ``open(path, "w")`` or a
+hand-rolled ``os.rename`` in those trees re-introduces exactly the
+failure mode the subsystem is built to exclude — a SIGKILL mid-write
+leaves a prefix the next launch happily loads.
+
+Flagged inside the confined trees:
+
+* ``open(...)`` with a write/append/create mode (``w``/``a``/``x``/``+``)
+* ``os.rename`` / ``os.replace`` / ``shutil.move`` — the atomic-rename
+  dance belongs to ``fsync_write``, not call sites
+* ``Path.write_text`` / ``Path.write_bytes``
+* direct serializer-to-path writes (``np.save*``, ``json.dump``,
+  ``pickle.dump``) — UNLESS the call sits inside a writer callback
+  handed to ``fsync_write``/``_fsync_write`` (the idiom:
+  ``fsync_write(path, lambda f: np.savez(f, ...))``)
+
+Reads (``open(path)``, ``np.load``), deletions (``os.unlink``,
+``shutil.rmtree``) and code outside the confined trees are untouched.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import Finding, Rule, SourceFile, attr_chain, register
+
+_CONFINED_PATHS = ("distributed/resilience/", "serving/resilience/")
+
+_WRITER_HELPERS = {"fsync_write", "_fsync_write"}
+
+_RENAME_CHAINS = {
+    "os.rename": "bare rename: a crash between write and rename (or a "
+                 "rename of an un-fsynced file) can surface a torn file",
+    "os.replace": "bare atomic rename: without the tmp+fsync dance the "
+                  "renamed content may not be durable",
+    "shutil.move": "bare move: not atomic across filesystems and never "
+                   "fsynced",
+}
+_WRITE_TERMINALS = {
+    "write_text": "Path.write_text is a bare open-for-write",
+    "write_bytes": "Path.write_bytes is a bare open-for-write",
+}
+_SERIALIZERS = {
+    "np.save", "np.savez", "np.savez_compressed", "numpy.save",
+    "numpy.savez", "numpy.savez_compressed", "json.dump", "pickle.dump",
+}
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """True for open(...) with a literal write/append/create mode."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return False
+    return any(c in mode.value for c in "wax+")
+
+
+@register
+class DurabilityRule(Rule):
+    id = "durability"
+    help = ("resilience code (distributed/resilience/, serving/resilience/) "
+            "must write files via utils.durability's fsync/commit helpers, "
+            "not bare open(...,'w')/os.rename/serializer-to-path")
+    profiles = ("src",)
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        if not any(p in sf.rel for p in _CONFINED_PATHS):
+            return
+        # every node inside an argument of fsync_write(...) is sanctioned:
+        # that IS the commit protocol's writer callback
+        sanctioned: Set[int] = set()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            term = chain.rsplit(".", 1)[-1] if chain else None
+            if term in _WRITER_HELPERS:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    for sub in ast.walk(arg):
+                        sanctioned.add(id(sub))
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain is None:
+                continue
+            term = chain.rsplit(".", 1)[-1]
+            if chain == "open" and _open_write_mode(node):
+                yield self.finding(
+                    sf, node.lineno,
+                    "bare `open(..., 'w'/'a'/'x'/'+')` in resilience code: "
+                    "a kill mid-write leaves a loadable prefix — write "
+                    "through utils.durability.fsync_write")
+            elif chain in _RENAME_CHAINS:
+                yield self.finding(
+                    sf, node.lineno,
+                    f"`{chain}(...)` in resilience code: "
+                    f"{_RENAME_CHAINS[chain]} — use "
+                    f"utils.durability.fsync_write")
+            elif term in _WRITE_TERMINALS:
+                yield self.finding(
+                    sf, node.lineno,
+                    f"`.{term}(...)` in resilience code: "
+                    f"{_WRITE_TERMINALS[term]} — use "
+                    f"utils.durability.fsync_write")
+            elif chain in _SERIALIZERS and id(node) not in sanctioned:
+                yield self.finding(
+                    sf, node.lineno,
+                    f"`{chain}(...)` writing directly in resilience code: "
+                    f"serialize into fsync_write's file handle instead "
+                    f"(`fsync_write(path, lambda f: {chain}(f, ...))`)")

@@ -14,54 +14,31 @@ under any other without materialising the global tensor.
 
 from __future__ import annotations
 
-import json
 import os
-import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...utils.durability import (COMMIT_FILE as _COMMIT_FILE,
+                                 fsync_write as _fsync_write,
+                                 latest_committed,
+                                 read_committed_marker,
+                                 write_committed_marker
+                                 as _write_committed_marker)
 from ..env import get_rank, get_world_size
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
 _METADATA_FILE = "0.metadata"
-_COMMIT_FILE = "COMMITTED"
 
-
-def _fsync_write(path: str, write_fn) -> None:
-    """Torn-write-safe file creation: write to a ``<name>.tmp-<uid>``
-    sibling, flush+fsync, then atomically rename into place. A reader
-    (or a crash at any point) sees either no file or the whole file,
-    never a prefix."""
-    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
-    try:
-        with open(tmp, "wb") as f:
-            write_fn(f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    _fsync_dir(os.path.dirname(path) or ".")
-
-
-def _fsync_dir(path: str) -> None:
-    try:  # persist the rename itself (no-op on platforms without dir fds)
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
+# The commit protocol itself (tmp+fsync+rename, COMMITTED markers,
+# committed-generation resolution) lives in utils/durability.py — one
+# implementation shared with the serving request journal and the
+# prefix-cache warm snapshot. This module keeps the checkpoint-facing
+# surface: `write_committed_marker` defaults world_size from the
+# process group, `latest_checkpoint` is the checkpoint spelling of
+# `latest_committed`.
 
 
 def write_committed_marker(path: str, step: Optional[int] = None,
@@ -70,59 +47,16 @@ def write_committed_marker(path: str, step: Optional[int] = None,
     ``load_state_dict``/``latest_checkpoint`` only ever observe
     checkpoints whose marker exists, so a writer killed mid-save leaves
     an invisible directory, not a torn checkpoint."""
-    payload = json.dumps({
-        "step": step,
-        "world_size": (world_size if world_size is not None
-                       else get_world_size()),
-    }).encode()
-    _fsync_write(os.path.join(path, _COMMIT_FILE), lambda f: f.write(payload))
-
-
-def read_committed_marker(path: str) -> Optional[Dict[str, Any]]:
-    """The parsed ``COMMITTED`` marker, or None when the checkpoint at
-    ``path`` was never committed (or is still being written)."""
-    try:
-        with open(os.path.join(path, _COMMIT_FILE), "rb") as f:
-            raw = f.read()
-    except OSError:
-        return None
-    try:
-        md = json.loads(raw)
-    except ValueError:
-        return None
-    return md if isinstance(md, dict) else None
+    _write_committed_marker(
+        path, step=step,
+        world_size=(world_size if world_size is not None
+                    else get_world_size()))
 
 
 def latest_checkpoint(root: str) -> Optional[str]:
-    """Resolve the newest COMMITTED checkpoint generation under ``root``.
-
-    Generations are subdirectories carrying a ``COMMITTED`` marker with
-    a step number; uncommitted directories (a writer died mid-save, or a
-    save is in flight right now) are never returned. ``root`` itself is
-    returned when it is a committed single-generation checkpoint."""
-    own = read_committed_marker(root)
-    if own is not None:
-        return root
-    best: Optional[Tuple[int, str, str]] = None
-    try:
-        names = os.listdir(root)
-    except OSError:
-        return None
-    for name in names:
-        sub = os.path.join(root, name)
-        if not os.path.isdir(sub):
-            continue
-        md = read_committed_marker(sub)
-        if md is None:
-            continue
-        step = md.get("step")
-        step = int(step) if isinstance(step, (int, float)) else -1
-        # tie-break on the directory name so equal/unknown steps still
-        # resolve deterministically (lexicographically newest wins)
-        cand = (step, name, sub)
-        if best is None or cand > best:
-            best = cand
-    return best[2] if best is not None else None
+    """Resolve the newest COMMITTED checkpoint generation under ``root``
+    (see :func:`paddle_tpu.utils.durability.latest_committed`)."""
+    return latest_committed(root)
 
 
 def _flatten(tree: Dict[str, Any], prefix: str = "", slots=None

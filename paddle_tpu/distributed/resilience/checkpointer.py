@@ -87,9 +87,7 @@ _M_ABORTED = _metrics.registry().counter(
     help="checkpoint saves that failed before their COMMITTED marker")
 
 
-def _record(event: str, info: tuple) -> None:
-    if _flight.enabled():
-        _flight.recorder().record(event, info, None)
+_record = _flight.record_event
 
 
 def _is_array(v: Any) -> bool:

@@ -50,9 +50,7 @@ _M_RESUME_STEP = _metrics.registry().gauge(
     help="step this process resumed from after its last restore")
 
 
-def _record(event: str, info: tuple) -> None:
-    if _flight.enabled():
-        _flight.recorder().record(event, info, None)
+_record = _flight.record_event
 
 
 class TrainerAction:

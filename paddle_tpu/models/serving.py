@@ -59,7 +59,13 @@ from ..ops.dispatcher import call_op
 from .generation import PagedKVCache
 
 __all__ = ["Request", "ContinuousBatchingEngine", "GangScheduledEngine",
-           "PrefixCache"]
+           "PrefixCache", "QueueFull"]
+
+
+class QueueFull(RuntimeError):
+    """Admission queue is at ``max_queue``: the server must shed load
+    explicitly (HTTP 429 / retry-after) instead of buffering without
+    bound — an unbounded `pending` deque turns overload into OOM."""
 
 _M = _metrics_mod.registry()
 _M_STEPS = _M.counter(
@@ -101,6 +107,10 @@ _M_TTFT = _M.histogram(
     "serving.ttft_seconds", "request arrival -> first emitted token")
 _M_TPOT = _M.histogram(
     "serving.tpot_seconds", "mean inter-token time after the first token")
+_M_QWAIT = _M.histogram(
+    "serving.queue_wait_seconds", "request arrival -> row-slot admission")
+_M_REJECTED = _M.counter(
+    "serving.rejected", "requests rejected at intake (queue full)")
 
 
 @dataclass
@@ -122,6 +132,7 @@ class Request:
     t_arrive: float = 0.0
     t_first: Optional[float] = None
     t_done: Optional[float] = None
+    n_replayed: int = 0                # tokens emitted by a previous process
     _registered_upto: int = 0          # prompt blocks published to the cache
 
 
@@ -274,7 +285,9 @@ class ContinuousBatchingEngine:
                  top_p: float = 1.0, preempt_after: Optional[int] = None,
                  token_budget: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 enable_prefix_cache: bool = True, seed: int = 0):
+                 enable_prefix_cache: bool = True, seed: int = 0,
+                 max_queue: Optional[int] = None,
+                 on_finish=None):
         cfg = model.config
         self.model = model
         self.eos = eos_token_id
@@ -320,13 +333,56 @@ class ContinuousBatchingEngine:
         # into the output
         self._base_key = jax.random.key(seed, impl="threefry2x32")
         self._key_w = np.asarray(jax.random.key_data(self._base_key)).shape[-1]
+        self.seed = seed
+        # bounded intake (None = legacy unbounded) + finished hand-off:
+        # with `on_finish` set, completed Requests are passed to the
+        # callback and RETIRED from `results`, so a long-running server
+        # does not grow host memory with every request it ever served
+        self.max_queue = max_queue
+        self.on_finish = on_finish
+        # drain hook (serving/resilience): a paused engine keeps
+        # stepping its in-flight rows but admits nothing new
+        self.admission_paused = False
 
     # -- request intake ------------------------------------------------------
-    def add_request(self, prompt, max_new_tokens: int = 32) -> int:
-        rid = self._next_rid
-        self._next_rid += 1
+    def add_request(self, prompt, max_new_tokens: int = 32, *,
+                    rid: Optional[int] = None,
+                    out_tokens: Optional[List[int]] = None) -> int:
+        """Queue a request. ``rid``/``out_tokens`` are the journal-replay
+        re-admission hooks (serving/resilience): a recovered request must
+        keep its ORIGINAL rid (the sampling stream folds it — a fresh rid
+        would draw a different continuation) and resumes from its already
+        committed output tokens exactly like a preempted row
+        (recompute-on-resume re-derives the lost KV by prefill)."""
+        if rid is None:
+            # the queue bound governs NEW traffic only: a journal-replay
+            # re-admission (rid given) was already durably acked by a
+            # previous incarnation — bouncing it here would turn a
+            # relaunch into a permanent QueueFull crash loop whenever
+            # more than max_queue requests were in flight at the kill
+            if (self.max_queue is not None
+                    and len(self.pending) >= self.max_queue):
+                _M_REJECTED.inc()
+                raise QueueFull(
+                    f"admission queue is full ({len(self.pending)}/"
+                    f"{self.max_queue} pending): shed load or retry later")
+            rid = self._next_rid
+        elif rid in self.results:
+            raise ValueError(f"rid {rid} already journaled to this engine")
+        self._next_rid = max(self._next_rid, rid + 1)
         req = Request(rid, np.asarray(prompt, np.int32).reshape(-1),
                       max_new_tokens)
+        if out_tokens:
+            if len(out_tokens) >= max_new_tokens:
+                raise ValueError(
+                    f"resumed request {rid} already has {len(out_tokens)} "
+                    f"of max_new_tokens={max_new_tokens} tokens: nothing "
+                    f"left to generate (load it from the journal instead)")
+            req.out_tokens = [int(t) for t in out_tokens]
+            # replayed tokens were emitted by a previous incarnation —
+            # this process must not observe their TTFT or TPOT
+            req.t_first = time.time()
+            req.n_replayed = len(req.out_tokens)
         if len(req.prompt) == 0:
             raise ValueError("empty prompt: there is no token to prefill, "
                              "so no logits exist to sample from")
@@ -412,6 +468,8 @@ class ContinuousBatchingEngine:
 
     # -- admission -----------------------------------------------------------
     def _admit(self):
+        if self.admission_paused:
+            return
         for i in range(self.max_batch):
             if not self.pending:
                 return
@@ -438,6 +496,11 @@ class ContinuousBatchingEngine:
                 return                 # reservation: wait for reclaims
             self.pending.popleft()
             self._head_waited = 0
+            if req.admit_order == -1:
+                # first admission only: a preemption re-admission's
+                # arrival-to-now span includes on-device decode
+                # residency, which is not queue wait
+                _M_QWAIT.observe(time.time() - req.t_arrive)
             req.slot = i
             req.admit_order = self._admit_seq
             self._admit_seq += 1
@@ -520,7 +583,10 @@ class ContinuousBatchingEngine:
                 or (self.eos is not None and tok == self.eos)):
             req.done = True
             req.t_done = now
-            if len(req.out_tokens) > 1:
+            # resumed rows skip TPOT like they skip TTFT: t_first is the
+            # re-admission time and part of the count was emitted by a
+            # dead process, so the quotient measures neither incarnation
+            if len(req.out_tokens) > 1 and req.n_replayed == 0:
                 _M_TPOT.observe((now - req.t_first)
                                 / (len(req.out_tokens) - 1))
             self._release_slot(i)
@@ -541,7 +607,8 @@ class ContinuousBatchingEngine:
         from ..autograd.engine import no_grad
 
         self._admit()
-        if self.pending and self.preempt_after is not None:
+        if self.pending and self.preempt_after is not None \
+                and not self.admission_paused:
             self._head_waited += 1
             if self._head_waited > self.preempt_after:
                 self._preempt_lifo()
@@ -661,13 +728,33 @@ class ContinuousBatchingEngine:
                     else:
                         self._append_token(req, i, int(sampled[i]), now,
                                            finished)
+        if self.on_finish is not None:
+            for req in finished:
+                self.results.pop(req.rid, None)
+                self.on_finish(req)
         return finished
 
+    def pop_result(self, rid: int) -> Optional[Request]:
+        """Retire a finished request from ``results`` (long-running
+        server memory: poll-style callers hand finished outputs off
+        instead of retaining every Request forever)."""
+        req = self.results.get(rid)
+        if req is None or not req.done:
+            return None
+        return self.results.pop(rid)
+
     def run(self) -> Dict[int, List[int]]:
-        """Drive until every request (queued + active) completes."""
-        while self.pending or self.num_active:
-            self.step()
-        return {rid: r.out_tokens for rid, r in self.results.items()}
+        """Drive until every request (queued + active) completes (a
+        paused engine only drains its in-flight rows). Requests retired
+        through ``on_finish`` are still included in the return value."""
+        out: Dict[int, List[int]] = {}
+        while ((self.pending and not self.admission_paused)
+               or self.num_active):
+            for req in self.step():
+                out[req.rid] = req.out_tokens
+        for rid, req in self.results.items():
+            out.setdefault(rid, req.out_tokens)
+        return out
 
 
 class GangScheduledEngine:

@@ -177,6 +177,14 @@ def enabled() -> bool:
     return bool(_F_ENABLED.value)
 
 
+def record_event(event: str, info: tuple) -> None:
+    """Record a non-op EVENT (resilience transitions, drains,
+    recoveries) — the shared shim for subsystems that annotate the op
+    stream, so each doesn't carry a private enabled()-guarded copy."""
+    if enabled():
+        recorder().record(event, info, None)
+
+
 def dump(file: Optional[IO[str]] = None) -> List[tuple]:
     """Dump the process-wide recorder (explicit ``dump()`` API)."""
     return recorder().dump(file)

@@ -85,6 +85,16 @@ METRIC_NAMES = frozenset({
     "serving.prefix_cache.hit_blocks", "serving.prefix_cache.miss_blocks",
     "serving.prefix_cache.shared_tokens", "serving.prefix_cache.evictions",
     "serving.cow_copies", "serving.ttft_seconds", "serving.tpot_seconds",
+    "serving.queue_wait_seconds", "serving.rejected",
+    # serving/resilience/ (request journal + replay, drain, warm-start)
+    "serving.resilience.journal_records",
+    "serving.resilience.journal_flushes",
+    "serving.resilience.replayed_requests",
+    "serving.resilience.replayed_tokens",
+    "serving.resilience.recovered_finished",
+    "serving.resilience.drains", "serving.resilience.drain_seconds",
+    "serving.resilience.snapshots", "serving.resilience.warm_blocks",
+    "serving.resilience.step_hangs",
     # this module's ambient gauges + jax.monitoring listener
     "device.live_array_bytes", "device.live_arrays", "device.count",
     "jit.compiles", "jit.compile_seconds",

@@ -1,0 +1,16 @@
+"""paddle_tpu.serving — serving-side subsystems.
+
+The engines themselves live in :mod:`paddle_tpu.models.serving`
+(re-exported here); :mod:`paddle_tpu.serving.resilience` wraps them
+with journal/replay, drain, and warm-start.
+"""
+
+from ..models.serving import (ContinuousBatchingEngine,  # noqa: F401
+                              GangScheduledEngine, PrefixCache, QueueFull,
+                              Request)
+from . import resilience  # noqa: F401
+
+__all__ = [
+    "ContinuousBatchingEngine", "GangScheduledEngine", "PrefixCache",
+    "QueueFull", "Request", "resilience",
+]

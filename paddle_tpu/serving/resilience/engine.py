@@ -1,0 +1,412 @@
+"""ResilientServingEngine: journal + replay, drain-on-SIGTERM,
+prefix-cache warm-start around :class:`ContinuousBatchingEngine`.
+
+The serving twin of ``distributed/resilience``'s ResilientTrainer. A
+SIGKILL'd server loses every in-flight request and its entire paged KV
+pool — but the engine was built so none of that needs checkpointing:
+
+* every admission (prompt, sampling config, engine seed, rid,
+  max_new_tokens) and every committed output watermark is journaled
+  through :class:`RequestJournal` (the PR 6 commit protocol, so a torn
+  journal is never loadable). Replay after relaunch re-admits each
+  unfinished request with its ORIGINAL rid and watermark; the
+  schedule-independent per-request sampling streams then regenerate the
+  remaining tokens **byte-identically** — KV is re-derived by prefill
+  (the engine's preemption path), never snapshotted. Finished outputs
+  load straight from the log.
+* SIGTERM (the TPU-VM preemption notice) triggers :meth:`drain` via
+  ``PreemptionHandler``: admission stops, in-flight rows finish — or
+  are journaled-and-preempted when the deadline lands — the journal
+  flushes + commits, and the prefix cache snapshots for warm-start.
+* a step-hang watchdog flags a wedged step (a stuck device call, a
+  deadlocked host thread) into the same journal→restart recovery: the
+  journal is already durable up to the last flush, so the relaunch
+  replays exactly like a kill.
+
+Lifecycle actions mirror ``TrainerAction``: the serve loop polls once
+per step and exits on ``DRAINED`` (clean, journal committed) or
+``RESTART`` (hang — relaunch and recover).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ...models.serving import ContinuousBatchingEngine
+from ...observability import flight_recorder as _flight
+from ...observability import metrics as _metrics
+from .journal import RequestJournal
+from .warm_cache import (_model_fingerprint, last_generation,
+                         load_prefix_cache, snapshot_prefix_cache)
+
+__all__ = ["ResilientServingEngine", "ServingAction"]
+
+_M_REPLAYED = _metrics.registry().counter(
+    "serving.resilience.replayed_requests",
+    help="unfinished journaled requests re-admitted after relaunch")
+_M_REPLAYED_TOKENS = _metrics.registry().counter(
+    "serving.resilience.replayed_tokens",
+    help="already-committed output tokens restored into replayed requests")
+_M_RECOVERED = _metrics.registry().counter(
+    "serving.resilience.recovered_finished",
+    help="finished requests whose outputs loaded straight from the journal")
+_M_DRAINS = _metrics.registry().counter(
+    "serving.resilience.drains", help="drain-on-SIGTERM completions")
+_M_DRAIN_SECONDS = _metrics.registry().histogram(
+    "serving.resilience.drain_seconds",
+    help="wall time of each drain (stop admission -> committed journal)")
+_M_HANGS = _metrics.registry().counter(
+    "serving.resilience.step_hangs",
+    help="step-hang watchdog firings (journal->restart recovery)")
+
+
+_record = _flight.record_event
+
+
+class ServingAction:
+    CONTINUE = "continue"
+    DRAINED = "drained"       # SIGTERM: journal committed, cache snapshotted
+    RESTART = "restart"       # step hang: relaunch + replay the journal
+    COMPLETED = "completed"
+
+
+class ResilientServingEngine:
+    """Wrap a :class:`ContinuousBatchingEngine` with durable recovery.
+
+    ``root`` holds ``journal/`` (the request WAL) and ``warmcache/``
+    (prefix-block snapshot generations). Construction RECOVERS: an
+    existing journal's config (seed, sampling, eos) overrides the
+    caller's so replay is byte-identical, finished outputs land in
+    :attr:`outputs`, unfinished requests re-admit with their original
+    rid + committed watermark, and the newest committed warm-cache
+    generation preloads into the pool.
+
+    ``engine_kwargs`` pass through to ``ContinuousBatchingEngine``
+    (``max_queue`` gives bounded admission; the wrapper owns
+    ``on_finish`` for retirement + journaling).
+    """
+
+    def __init__(self, model, root: str, *,
+                 warm_start: bool = True,
+                 journal_flush_every: int = 4,
+                 snapshot_every: int = 0,
+                 drain_deadline_s: float = 30.0,
+                 step_timeout_s: Optional[float] = None,
+                 first_step_timeout_s: Optional[float] = None,
+                 hang_exit: bool = False,
+                 install_signal: bool = False,
+                 elastic=None, signum: Optional[int] = None,
+                 **engine_kwargs: Any):
+        self.root = root
+        self.journal = RequestJournal(os.path.join(root, "journal"))
+        self.warm_root = os.path.join(root, "warmcache")
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.journal_flush_every = max(1, int(journal_flush_every))
+        self.snapshot_every = max(0, int(snapshot_every))
+        self.outputs: Dict[int, List[int]] = {}
+        self.drained = False
+        self.replayed_requests = 0
+        self.recovered_finished = 0
+        self.warm_blocks = 0
+
+        state = self.journal.load()
+        model_fp = _model_fingerprint(model)
+        if state.config is not None:
+            # replay against DIFFERENT weights would splice two models'
+            # tokens into one output with no error — refuse up front,
+            # like the warm cache refuses its preload
+            journaled_fp = state.config.get("model_fp")
+            if journaled_fp is not None and journaled_fp != model_fp:
+                raise RuntimeError(
+                    f"journal at {self.journal.root} was written by a "
+                    f"different model (weights fingerprint mismatch): "
+                    f"replaying it here would corrupt the journaled "
+                    f"outputs — point the relaunch at the original "
+                    f"weights or a fresh root")
+            # journal identity wins: byte-identical replay needs the
+            # original seed and sampling config, whatever the relaunch
+            # command line says
+            engine_kwargs["seed"] = int(state.config["seed"])
+            engine_kwargs.update(state.config.get("sampling", {}))
+            # including eos=None: a relaunch flag ADDING an eos would
+            # truncate replayed outputs below their committed watermarks
+            eos = state.config.get("eos")
+            engine_kwargs["eos_token_id"] = (None if eos is None
+                                             else int(eos))
+        self.engine = ContinuousBatchingEngine(
+            model, on_finish=self._on_finish, **engine_kwargs)
+        self.engine._warm_model_fp = model_fp   # _meta()'s memo
+        # committed watermark per live rid (what the journal already has)
+        self._watermark: Dict[int, int] = {}
+        self._steps_since_flush = 0
+        self._last_snap_step = 0
+        self._snap_ok_step = -1    # last step a snapshot actually LANDED
+        # continue the on-disk sequence: rewriting an already-COMMITTED
+        # generation in place would tear it under its live marker
+        self._snapshot_gen = last_generation(self.warm_root)
+        self._last_progress = time.monotonic()
+        if state.config is None:
+            self.journal.append({
+                "t": "config", "seed": self.engine.seed,
+                "sampling": dict(self.engine.sampling),
+                "eos": self.engine.eos, "model_fp": model_fp})
+            # config flushes with the first admission (no empty segment)
+        else:
+            self.journal.uncommit()   # about to append: drain marker stale
+            self._recover(state, warm_start)
+
+        self._hang = threading.Event()
+        self._hang_exit = hang_exit
+        self._watchdog: Optional[threading.Thread] = None
+        self._watchdog_stop = threading.Event()
+        if step_timeout_s is not None:
+            # an incarnation's FIRST step pays the ragged XLA compile
+            # (tens of seconds cold), so a steady-state timeout would
+            # os._exit a healthy relaunch into a permanent crash loop:
+            # compile → watchdog kill → relaunch → same compile
+            self._start_watchdog(
+                float(step_timeout_s),
+                float(first_step_timeout_s) if first_step_timeout_s
+                is not None else 10.0 * float(step_timeout_s))
+        self.handler = None
+        if install_signal:
+            from ...distributed.fleet.elastic import PreemptionHandler
+            self.handler = PreemptionHandler(elastic).install(signum)
+
+    # -- recovery ------------------------------------------------------------
+    def _recover(self, state, warm_start: bool) -> None:
+        if warm_start:
+            self.warm_blocks = load_prefix_cache(self.engine, self.warm_root)
+        for rec in sorted(state.requests.values(), key=lambda r: r.rid):
+            if rec.finished:
+                self.outputs[rec.rid] = list(rec.tokens)
+                self.recovered_finished += 1
+                _M_RECOVERED.inc()
+                # finished rids never pass through add_request, but the
+                # engine's counter must still advance past them: a
+                # reused rid would journal a SECOND admit record and
+                # clobber this durably-acked output on the next relaunch
+                self.engine._next_rid = max(self.engine._next_rid,
+                                            rec.rid + 1)
+                continue
+            self.engine.add_request(rec.prompt,
+                                    max_new_tokens=rec.max_new_tokens,
+                                    rid=rec.rid,
+                                    out_tokens=rec.tokens or None)
+            self._watermark[rec.rid] = len(rec.tokens)
+            self.replayed_requests += 1
+            _M_REPLAYED.inc()
+            _M_REPLAYED_TOKENS.inc(len(rec.tokens))
+        _record("serving.resilience.recover",
+                (self.journal.root, self.replayed_requests,
+                 self.recovered_finished, self.warm_blocks))
+
+    # -- intake --------------------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int = 32) -> int:
+        """Admit + journal durably: the flushed admission record is the
+        ack point — a request this method returned an rid for survives
+        any crash. Raises ``QueueFull`` when bounded admission rejects
+        (nothing is journaled for a rejected request)."""
+        if self.drained:
+            raise RuntimeError("engine is drained: relaunch to serve")
+        rid = self.engine.add_request(prompt, max_new_tokens=max_new_tokens)
+        req = self.engine.results[rid]
+        self.journal.append({
+            "t": "admit", "rid": rid,
+            "prompt": [int(x) for x in req.prompt],
+            "max_new_tokens": int(max_new_tokens)})
+        self.journal.flush()
+        self._watermark[rid] = 0
+        return rid
+
+    # -- finished hand-off ---------------------------------------------------
+    def _on_finish(self, req) -> None:
+        self.outputs[req.rid] = list(req.out_tokens)
+        self._journal_tokens(req)
+        # buffered: step() flushes ONE segment for however many rows
+        # finished this step, not one fsync dance per callback
+        self.journal.append({"t": "finish", "rid": req.rid})
+        self._watermark.pop(req.rid, None)
+
+    def pop_output(self, rid: int) -> Optional[List[int]]:
+        """Retire a delivered output from host memory (the journal
+        still holds it durably, so a relaunch re-materializes it —
+        journal compaction is the open item for retiring it from disk
+        and from recovery time too). Mirrors the inner engine's
+        ``pop_result``: a long-running server pops what it has sent."""
+        return self.outputs.pop(rid, None)
+
+    def _journal_tokens(self, req) -> None:
+        have = self._watermark.get(req.rid, 0)
+        if len(req.out_tokens) > have:
+            self.journal.append({
+                "t": "tokens", "rid": req.rid, "from": have,
+                "toks": [int(t) for t in req.out_tokens[have:]]})
+            self._watermark[req.rid] = len(req.out_tokens)
+
+    # -- the resilient step --------------------------------------------------
+    def step(self) -> list:
+        """One engine step + watermark journaling (flushed every
+        ``journal_flush_every`` steps) + optional periodic warm-cache
+        snapshot. Returns the requests that finished this step."""
+        if self.drained:
+            # stepping would append segments under the COMMITTED marker,
+            # falsifying the 'cleanly drained' certificate
+            raise RuntimeError("engine is drained: relaunch to serve")
+        finished = self.engine.step()
+        for rid in list(self._watermark):
+            req = self.engine.results.get(rid)
+            if req is not None:
+                self._journal_tokens(req)
+        self._steps_since_flush += 1
+        # a finish is worth flushing immediately: it loads straight
+        # from the log after a crash, no regeneration needed
+        if finished or self._steps_since_flush >= self.journal_flush_every:
+            self.journal.flush()
+            self._steps_since_flush = 0
+        # engine.steps freezes on idle steps, so gate on PROGRESS too:
+        # a parked multiple of snapshot_every must not re-fire a full
+        # device_get + fsync snapshot on every idle serve-loop tick
+        if (self.snapshot_every
+                and self.engine.steps > self._last_snap_step
+                and self.engine.steps % self.snapshot_every == 0):
+            self._last_snap_step = self.engine.steps
+            if self.snapshot() is not None:
+                self._snap_ok_step = self.engine.steps
+        self._last_progress = time.monotonic()
+        return finished
+
+    def snapshot(self) -> Optional[str]:
+        self._snapshot_gen += 1
+        try:
+            path = snapshot_prefix_cache(self.engine, self.warm_root,
+                                         self._snapshot_gen)
+        except OSError as e:
+            # a failed snapshot only costs warmth, never correctness —
+            # e.g. a zombie incarnation's prune raced this write; the
+            # serve loop must not die for it
+            _record("serving.resilience.snapshot_failed",
+                    (type(e).__name__, str(e)))
+            path = None
+        # snapshot wall time (device gather + fsyncs) is PROGRESS, not
+        # a wedged step: don't let the watchdog charge it as a hang
+        self._last_progress = time.monotonic()
+        return path
+
+    # -- poll / serve loop ---------------------------------------------------
+    @property
+    def has_work(self) -> bool:
+        # queued requests are not workable under paused admission (the
+        # inner run() guards the same way): counting them would make a
+        # post-drain run() busy-loop on no-op steps forever
+        pending = (bool(self.engine.pending)
+                   and not self.engine.admission_paused)
+        return pending or self.engine.num_active > 0
+
+    def poll(self) -> str:
+        """Call once per step: routes a pending SIGTERM into
+        :meth:`drain` and a watchdog hang into RESTART."""
+        if self._hang.is_set():
+            return ServingAction.RESTART
+        if self.handler is not None and self.handler.process():
+            self.drain()
+            return ServingAction.DRAINED
+        return ServingAction.CONTINUE
+
+    def run(self) -> str:
+        """Drive until every journaled request completes, a SIGTERM
+        drains, or the watchdog flags a hang."""
+        if self.drained:
+            return ServingAction.DRAINED
+        while self.has_work:
+            action = self.poll()
+            if action != ServingAction.CONTINUE:
+                return action
+            self.step()
+        self.journal.flush()
+        return ServingAction.COMPLETED
+
+    # -- drain ---------------------------------------------------------------
+    def drain(self, deadline_s: Optional[float] = None) -> float:
+        """Stop admission; let in-flight rows finish within the deadline
+        (journaling watermarks as they go); journal-and-preempt whatever
+        remains; flush + COMMIT the journal; snapshot the prefix cache.
+        Returns the drain wall time. Idempotent."""
+        if self.drained:
+            return 0.0
+        deadline = self.drain_deadline_s if deadline_s is None \
+            else float(deadline_s)
+        t0 = time.monotonic()
+        # the watchdog's job is over: this IS the clean exit, and the
+        # commit+snapshot tail below must not be misread as a hang
+        # (with hang_exit that would os._exit a server mid-drain)
+        self._watchdog_stop.set()
+        self.engine.admission_paused = True
+        while (self.engine.num_active > 0
+               and time.monotonic() - t0 < deadline):
+            self.step()
+        # journal-and-preempt the survivors: their watermarks are
+        # already current (step() journals each one), the relaunch
+        # re-derives their KV by prefill
+        remaining = self.engine.num_active
+        self.journal.commit(drained=True, remaining=remaining)
+        # skip the final snapshot only when a periodic one SUCCEEDED at
+        # this very step count — the state would be identical, and the
+        # device gather + fsync dance is on the preemption deadline (a
+        # failed or skipped periodic attempt must not forfeit the
+        # warm-start this drain exists to produce)
+        if (not self.snapshot_every
+                or self.engine.steps != self._snap_ok_step):
+            self.snapshot()
+        self.drained = True
+        dt = time.monotonic() - t0
+        _M_DRAINS.inc()
+        _M_DRAIN_SECONDS.observe(dt)
+        _record("serving.resilience.drain",
+                (round(dt, 3), remaining, len(self.engine.pending)))
+        return dt
+
+    # -- step-hang watchdog --------------------------------------------------
+    def _start_watchdog(self, timeout_s: float,
+                        first_step_timeout_s: float) -> None:
+        def scan():
+            while not self._watchdog_stop.wait(min(timeout_s / 4, 1.0)):
+                if not self.has_work:
+                    self._last_progress = time.monotonic()
+                    continue
+                limit = (timeout_s if self.engine.steps > 0
+                         else first_step_timeout_s)
+                if time.monotonic() - self._last_progress > limit:
+                    if not self._hang.is_set():
+                        self._hang.set()
+                        _M_HANGS.inc()
+                        _record("serving.resilience.step_hang",
+                                (round(time.monotonic()
+                                       - self._last_progress, 3),))
+                    if self._hang_exit:
+                        # the main thread is wedged inside a device call
+                        # and can never poll(): the journal already holds
+                        # every admission + the last flushed watermarks,
+                        # so dying here IS the recovery path — the
+                        # launcher relaunches and replay regenerates the
+                        # lost tail byte-identically
+                        os._exit(75)
+                    return
+        self._watchdog = threading.Thread(target=scan, daemon=True,
+                                          name="serving-watchdog")
+        self._watchdog.start()
+
+    def close(self) -> None:
+        """Flush the journal, detach the watchdog + signal handler
+        (test/notebook hygiene; a real server just exits)."""
+        self.journal.flush()
+        self._watchdog_stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+            self._watchdog = None
+        if self.handler is not None:
+            self.handler.uninstall()
+            self.handler = None

@@ -1,0 +1,105 @@
+"""Chaos-harness serving worker (driven by tests/test_serving_resilience.py).
+
+One incarnation of a resilient serving process: a tiny deterministic
+Llama serves a fixed stochastic (temperature>0) request stream through
+``ResilientServingEngine``, journaling every admission and output
+watermark. The parent injects chaos — SIGKILL mid-stream (journal
+replay must regenerate every unfinished request byte-identically) or
+SIGTERM (drain: committed journal + prefix-cache snapshot, clean exit).
+
+Requests are only ADDED on attempt 0; every relaunch recovers them from
+the journal. A per-step progress line lets the parent land kills
+mid-stream, and a per-step sleep keeps the stream long enough to kill.
+
+argv: out_dir root_dir attempt
+env:  SERVE_STEP_SLEEP [SERVE_DRAIN_DEADLINE]
+exit: 0 completed | 64 drained | 75 restart(hang)
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+EXIT_CODES = {"completed": 0, "drained": 64, "restart": 75}
+
+
+def build_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=160, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=256)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def request_stream():
+    """The fixed stream every incarnation agrees on: a shared head
+    (prefix-cache + warm-start food) over half the prompts, mixed
+    lengths, enough output tokens that kills land mid-generation."""
+    rng = np.random.RandomState(7)
+    head = rng.randint(0, 128, 32).tolist()
+    reqs = []
+    for i in range(6):
+        body = rng.randint(0, 128, 4 + 3 * i).tolist()
+        prompt = (head + body) if i % 2 == 0 else body
+        reqs.append((prompt, 10 + 2 * (i % 3)))
+    return reqs
+
+
+def main() -> int:
+    out_dir, root, attempt = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    step_sleep = float(os.environ.get("SERVE_STEP_SLEEP", "0.05"))
+    deadline = float(os.environ.get("SERVE_DRAIN_DEADLINE", "20"))
+
+    from paddle_tpu.serving.resilience import (ResilientServingEngine,
+                                               ServingAction)
+
+    model = build_model()
+    eng = ResilientServingEngine(
+        model, root, install_signal=True, journal_flush_every=1,
+        drain_deadline_s=deadline,
+        max_batch=4, num_blocks=64, block_size=16,
+        temperature=0.85, seed=17)
+    add = os.environ.get("SERVE_ADD")
+    if add == "1" or (add is None and attempt == 0):
+        for prompt, n in request_stream():
+            eng.add_request(prompt, max_new_tokens=n)
+
+    progress = open(os.path.join(out_dir, f"progress_a{attempt}.jsonl"),
+                    "a")
+    action = ServingAction.COMPLETED
+    while eng.has_work:
+        action = eng.poll()
+        if action != ServingAction.CONTINUE:
+            break
+        eng.step()
+        progress.write(json.dumps({
+            "steps": eng.engine.steps,
+            "generated": sum(len(r.out_tokens)
+                             for r in eng.engine.results.values())
+            + sum(len(t) for t in eng.outputs.values())}) + "\n")
+        progress.flush()
+        time.sleep(step_sleep)   # keep kills landing mid-stream
+    if action == ServingAction.CONTINUE:
+        action = ServingAction.COMPLETED
+        eng.journal.flush()
+
+    with open(os.path.join(out_dir, f"result_a{attempt}.json"), "w") as f:
+        json.dump({"action": action,
+                   "outputs": {str(k): v for k, v in eng.outputs.items()},
+                   "replayed": eng.replayed_requests,
+                   "recovered_finished": eng.recovered_finished,
+                   "warm_blocks": eng.warm_blocks}, f)
+    eng.close()
+    return EXIT_CODES[action]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

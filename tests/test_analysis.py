@@ -277,6 +277,77 @@ class TestTracePurityRule:
 
 
 # ---------------------------------------------------------------------------
+# durability (resilience file writes must ride the commit protocol)
+# ---------------------------------------------------------------------------
+
+class TestDurabilityRule:
+    REL = "paddle_tpu/serving/resilience/journal.py"
+    REL_CKPT = "paddle_tpu/distributed/resilience/checkpointer.py"
+
+    def test_bare_open_for_write_fires(self):
+        src = ("def save(path, payload):\n"
+               "    with open(path, 'w') as f:\n"
+               "        f.write(payload)\n")
+        fs = check_src(src, ["durability"], rel=self.REL)
+        assert len(fs) == 1 and "fsync_write" in fs[0].message
+
+    def test_append_and_mode_kw_fire_in_both_trees(self):
+        src = ("def log(path, line):\n"
+               "    f = open(path, mode='ab')\n"
+               "    g = open(path, 'x')\n")
+        assert len(check_src(src, ["durability"], rel=self.REL)) == 2
+        assert len(check_src(src, ["durability"], rel=self.REL_CKPT)) == 2
+
+    def test_bare_rename_family_fires(self):
+        src = ("import os, shutil\n"
+               "def swap(a, b):\n"
+               "    os.rename(a, b)\n"
+               "    os.replace(a, b)\n"
+               "    shutil.move(a, b)\n")
+        fs = check_src(src, ["durability"], rel=self.REL)
+        assert len(fs) == 3
+
+    def test_path_write_text_fires(self):
+        src = ("def mark(p):\n"
+               "    p.write_text('done')\n")
+        assert check_src(src, ["durability"], rel=self.REL)
+
+    def test_serializer_to_path_fires_but_helper_callback_is_clean(self):
+        bare = ("import numpy as np, json\n"
+                "def dump(path, arrs, meta, f2):\n"
+                "    np.savez(path, **arrs)\n"
+                "    json.dump(meta, f2)\n")
+        fs = check_src(bare, ["durability"], rel=self.REL)
+        assert len(fs) == 2
+        idiom = ("import numpy as np, json\n"
+                 "from paddle_tpu.utils.durability import fsync_write\n"
+                 "def dump(path, arrs, meta):\n"
+                 "    fsync_write(path, lambda f: np.savez(f, **arrs))\n"
+                 "    fsync_write(path + '.json',\n"
+                 "                lambda f: f.write(json.dumps(meta)"
+                 ".encode()))\n")
+        assert check_src(idiom, ["durability"], rel=self.REL) == []
+
+    def test_reads_deletes_and_outside_paths_are_clean(self):
+        src = ("import os, shutil, numpy as np\n"
+               "def load(path):\n"
+               "    with open(path) as f:\n"
+               "        data = f.read()\n"
+               "    z = np.load(path + '.npz')\n"
+               "    os.unlink(path + '.tmp')\n"
+               "    shutil.rmtree(path + '.old', ignore_errors=True)\n"
+               "    return data, z\n")
+        assert check_src(src, ["durability"], rel=self.REL) == []
+        bare = ("def save(path, s):\n"
+                "    open(path, 'w').write(s)\n")
+        # the commit protocol's own home and ordinary code are exempt
+        assert check_src(bare, ["durability"],
+                         rel="paddle_tpu/utils/durability.py") == []
+        assert check_src(bare, ["durability"],
+                         rel="paddle_tpu/io/dataloader.py") == []
+
+
+# ---------------------------------------------------------------------------
 # compat-shim (migrated from the PR-4 standalone lint)
 # ---------------------------------------------------------------------------
 
@@ -584,7 +655,7 @@ class TestCli:
         out = capsys.readouterr().out
         for rid in ("capture-safety", "donation-safety", "trace-purity",
                     "compat-shim", "taxonomy", "silent-except",
-                    "test-flag-restore"):
+                    "test-flag-restore", "durability"):
             assert rid in out
 
     @pytest.mark.heavy
