@@ -1780,6 +1780,104 @@ def bench_step_capture(on_tpu: bool):
     }
 
 
+def bench_anomaly_overhead(on_tpu: bool):
+    """In-capture anomaly sentinel cost (ISSUE 10 acceptance): the SAME
+    captured MLP train step with FLAGS_anomaly_sentinel off vs on — the
+    sentinel adds one fused finiteness/global-norm sweep over the grads
+    plus the select-guarded optimizer update inside the donated
+    executable. Gate: <3% added step time.
+
+    Geometry note: the sentinel's work scales with PARAMETER bytes, the
+    step with batch x FLOPs, so the measured ratio is meaningful only on
+    a step whose compute resembles training (the 8-wide dispatch-bound
+    step_capture micro would charge the sentinel XLA-CPU per-op overhead
+    that vanishes on any real model). Timing is paired alternation
+    (off, on, off, on, ...) with per-variant medians, so host drift
+    lands on both sides."""
+    import gc
+    import statistics
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.step_capture import capture_counters
+
+    entry = paddle.get_flags(["FLAGS_step_capture",
+                              "FLAGS_anomaly_sentinel"])
+    batch = 2048
+
+    def build(sentinel):
+        paddle.set_flags({"FLAGS_step_capture": True,
+                          "FLAGS_anomaly_sentinel": sentinel})
+        paddle.seed(0)
+        layers = []
+        for _ in range(8):
+            layers += [nn.Linear(64, 64), nn.Tanh()]
+        net = nn.Sequential(*layers)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        x = Tensor(jnp.ones((batch, 64), jnp.float32))
+
+        def step():
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        cap = paddle.jit_step(step)
+        for _ in range(3):           # probe + capture + first replay
+            cap()
+        jax.block_until_ready(net[0].weight._data)
+        return net, cap
+
+    rounds = 100
+    try:
+        off_net, off_cap = build(False)
+        on_net, on_cap = build(True)
+        t_off, t_on = [], []
+        gc.collect()
+        for _ in range(rounds):
+            paddle.set_flags({"FLAGS_anomaly_sentinel": False})
+            t0 = time.perf_counter()
+            off_cap()
+            jax.block_until_ready(off_net[0].weight._data)
+            t_off.append(time.perf_counter() - t0)
+            paddle.set_flags({"FLAGS_anomaly_sentinel": True})
+            t0 = time.perf_counter()
+            on_cap()
+            jax.block_until_ready(on_net[0].weight._data)
+            t_on.append(time.perf_counter() - t0)
+    finally:
+        paddle.set_flags(entry)
+    off_s = statistics.median(t_off)
+    on_s = statistics.median(t_on)
+    # paired statistic: each alternation contributes one (on - off)
+    # difference, so common-mode host drift cancels sample-by-sample
+    # instead of biasing whichever variant ran during the slow spell
+    added_s = statistics.median([b - a for a, b in zip(t_off, t_on)])
+    added_pct = added_s / off_s * 100.0
+    return {
+        "metric": "anomaly_sentinel_overhead_pct",
+        "value": round(added_pct, 2),
+        "unit": "pct_added_step_time",
+        # ISSUE 10 gate: the sentinel must cost <3% of the captured step
+        "vs_baseline": round(off_s / max(on_s, 1e-12), 4),
+        "detail": {
+            "captured_step_us_sentinel_off": round(off_s * 1e6, 1),
+            "captured_step_us_sentinel_on": round(on_s * 1e6, 1),
+            "batch": batch,
+            "counters": dict(capture_counters),
+            "note": "same captured MLP step (8x Linear(64)+Tanh, Adam, "
+                    f"batch {batch}); sentinel = one variadic "
+                    "lax.reduce sweep per grad (square-sum + isfinite "
+                    "AND) + select-guarded update inside the ONE donated "
+                    "executable (FLAGS_anomaly_sentinel). Paired "
+                    "alternation, per-variant medians",
+        },
+    }
+
+
 def bench_checkpoint_overlap(on_tpu: bool):
     """Async snapshot checkpointing vs blocking save_state_dict (ISSUE 7
     acceptance): the same captured training loop checkpointing every K
@@ -2083,7 +2181,8 @@ def main():
         "PTPU_BENCH_CONFIGS",
         "llama,llamapeak,llama4k,llamalong,resnet,bert,ocr,moe,serving,"
         "cbatch,serving_ragged,serving_recovery,aot,tp_attention,micro,"
-        "dispatch,observability,step_capture,checkpoint_overlap")
+        "dispatch,observability,step_capture,checkpoint_overlap,"
+        "anomaly_overhead")
     which = [w.strip() for w in which.split(",") if w.strip()]
     if (on_tpu and len(which) > 1
             and os.environ.get("PTPU_BENCH_ISOLATED", "1") != "0"):
@@ -2192,6 +2291,9 @@ def main():
     ckpt = guard("checkpoint_overlap", bench_checkpoint_overlap, on_tpu)
     if ckpt:
         configs.append(ckpt)
+    anom = guard("anomaly_overhead", bench_anomaly_overhead, on_tpu)
+    if anom:
+        configs.append(anom)
 
     mfu = llama["mfu"] if llama else 0.0
     print(json.dumps({

@@ -11,6 +11,7 @@ the reference's generated ad_funcs call AmpAutoCast (eager_amp_auto_cast.h).
 from __future__ import annotations
 
 import contextlib
+import functools
 from typing import Optional, Set
 
 import jax
@@ -19,15 +20,33 @@ import jax.numpy as jnp
 from ..core import dtype as dtype_mod
 from ..core.tensor import Tensor
 from ..ops import dispatcher
+from ..ops.kernels.extra_misc import update_loss_scaling_kernel
+from ..optimizer import optimizer as optimizer_mod
 
 
 @jax.jit
-def _fused_unscale(grads, inv):
-    """grads * inv + one global finite flag, compiled as one program."""
-    scaled = tuple(g * inv.astype(g.dtype) for g in grads)
-    finite = jnp.all(jnp.stack(
-        [jnp.all(jnp.isfinite(g)) for g in scaled]))
-    return scaled, ~finite
+def _fused_unscale(grads, scale):
+    """grads / scale + global finite flag + global grad norm, compiled
+    as ONE program (the reference's check_finite_and_unscale kernel,
+    fused with the sentinel's single-pass finiteness/norm sweep — one
+    implementation of that reduction, shared with the optimizer)."""
+    inv = 1.0 / scale.astype(jnp.float32)
+    out = tuple(g * inv.astype(g.dtype) for g in grads)
+    found, gnorm = optimizer_mod._sentinel_reduce(out)
+    return out, found, gnorm
+
+
+@functools.partial(jax.jit, static_argnames=("incr_every", "decr_every",
+                                             "incr_ratio", "decr_ratio"))
+def _scaler_update(found, scale, good, bad, incr_every, decr_every,
+                   incr_ratio, decr_ratio):
+    """Dynamic loss-scale transition — literally update_loss_scaling_op
+    with an empty tensor list, so eager, captured and static regimes
+    share one set of semantics."""
+    return update_loss_scaling_kernel(
+        (), found, scale, good, bad, incr_every_n_steps=incr_every,
+        decr_every_n_nan_or_inf=decr_every, incr_ratio=incr_ratio,
+        decr_ratio=decr_ratio)
 
 # O1 lists (reference python/paddle/amp/amp_lists.py white/black lists)
 WHITE_LIST: Set[str] = {
@@ -130,56 +149,145 @@ def decorate(models=None, optimizers=None, level: str = "O2", dtype: str = "bflo
 class GradScaler:
     """Loss scaling for fp16 (reference grad_scaler.py:579). For bf16 —
     the TPU default — scaling is unnecessary: scale stays 1 and this is a
-    pass-through with the same API."""
+    pass-through with the same API.
+
+    Numerical-fault-tolerance design: the dynamic state (scale,
+    good-step and bad-step counters) lives in persistent device-resident
+    Tensors, so under whole-step capture the scaler is ORDINARY traced
+    donated state — unscale, the finite check, the ``lax.cond``-guarded
+    optimizer update and the ``update_loss_scaling`` transition all run
+    inside the captured executable with no host sync at all. The eager
+    path keeps unscale+check on device and defers its single
+    ``bool(found)`` host sync until after the scale transition is
+    enqueued; a disabled scaler pays no device work and no sync."""
 
     def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
                  incr_ratio: float = 2.0, decr_ratio: float = 0.5,
                  incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 2,
                  use_dynamic_loss_scaling: bool = True):
         self._enable = enable
-        self._scale = init_loss_scaling if enable else 1.0
+        self._init_scale = float(init_loss_scaling) if enable else 1.0
         self._incr_ratio, self._decr_ratio = incr_ratio, decr_ratio
         self._incr_every = incr_every_n_steps
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
-        self._good_steps = 0
-        self._bad_steps = 0
-        self._found_inf = False
+        if enable:
+            self._scale_t = Tensor(jnp.float32(self._init_scale))
+            self._good_t = Tensor(jnp.int32(0))
+            self._bad_t = Tensor(jnp.int32(0))
+        else:
+            self._scale_t = self._good_t = self._bad_t = None
+        self._found_dev = None     # device flag of the last unscale
+        self._gnorm_dev = None
+        self._found_last = False   # last host-synced value
         self._unscaled = set()  # optimizers already unscaled this cycle
 
+    @property
+    def _scale(self):
+        """Host view of the loss scale (syncs; introspection only)."""
+        if self._scale_t is None:
+            return 1.0
+        return float(jax.device_get(self._scale_t._data))
+
+    @property
+    def _found_inf(self):
+        """Host view of the last finite-check outcome. A pending device
+        flag is synced here lazily — ``step()`` itself defers its one
+        sync until after the scale transition is enqueued."""
+        fd = self._found_dev
+        if fd is None or isinstance(fd, jax.core.Tracer):
+            return self._found_last
+        return bool(fd)
+
     def scale(self, loss: Tensor) -> Tensor:
-        if not self._enable or self._scale == 1.0:
+        if not self._enable:
             return loss
-        return loss * self._scale
+        if not self._dynamic and self._init_scale == 1.0:
+            return loss   # statically a pass-through; a DYNAMIC scale
+            #               must multiply even at 1.0 so the captured
+            #               program stays valid when the scale moves
+        # multiply in the LOSS's dtype (scales are powers of two, exact
+        # in bf16/fp16 too) — an f32 scale array would silently promote
+        # a low-precision loss and change the backward's dtypes
+        return loss * Tensor(
+            self._scale_t._data.astype(loss._data.dtype))
 
     def unscale_(self, optimizer):
         """One fused jitted pass over all grads: unscale + global finite
-        check, with a single host sync (the reference's check_finite_and_
-        unscale kernel, grad_scaler.py:579 — NOT a per-param Python loop,
-        which would serialize the device once per parameter)."""
+        check + global norm, all on device (the reference's
+        check_finite_and_unscale kernel, grad_scaler.py:579 — NOT a
+        per-param Python loop, which would serialize the device once per
+        parameter). No host sync happens here; ``step()`` consumes the
+        device flag."""
         if not self._enable:
             return
         if id(optimizer) in self._unscaled:  # guard against double unscale
             return
         self._unscaled.add(id(optimizer))
-        inv = 1.0 / self._scale
         with_grads = [p for p in optimizer._parameter_list
                       if p.grad is not None]
         if not with_grads:
-            self._found_inf = False
+            self._found_dev = None
+            self._found_last = False
             return
         grads = tuple(p.grad._data for p in with_grads)
-        new_grads, found = _fused_unscale(grads, jnp.float32(inv))
+        new_grads, found, gnorm = _fused_unscale(grads, self._scale_t._data)
         for p, g in zip(with_grads, new_grads):
             p.grad._set_data(g)
-        self._found_inf = bool(found)  # the one host sync per step
+        self._found_dev = found
+        self._gnorm_dev = gnorm
+
+    def _enqueue_scale_update(self, found) -> None:
+        if not self._dynamic:
+            return
+        ns, ng, nb = _scaler_update(
+            found, self._scale_t._data, self._good_t._data,
+            self._bad_t._data, incr_every=self._incr_every,
+            decr_every=self._decr_every, incr_ratio=self._incr_ratio,
+            decr_ratio=self._decr_ratio)
+        self._scale_t._set_data(ns)
+        self._good_t._set_data(ng)
+        self._bad_t._set_data(nb)
 
     def step(self, optimizer):
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        if not self._enable:
             optimizer.step()
+            self._unscaled.discard(id(optimizer))
+            return
+        self.unscale_(optimizer)
+        found, gnorm = self._found_dev, self._gnorm_dev
+        if found is not None:
+            self._enqueue_scale_update(found)
+        if optimizer_mod._CAPTURE is not None:
+            # whole-step capture trace: found stays a traced scalar, the
+            # optimizer guards its own update with lax.cond, and the
+            # scale transition above is already traced state math — the
+            # AMP step compiles into the captured executable whole,
+            # with no host branch to fall back on
+            optimizer._guard_found = found
+            try:
+                optimizer.step()
+            finally:
+                optimizer._guard_found = None
+        else:
+            # eager: ONE host sync, deferred until the scale transition
+            # is enqueued so the wait overlaps device work
+            f = bool(found) if found is not None else False
+            self._found_last = f
+            if not f:
+                optimizer.step()
+            if found is not None:
+                # keep the sentinel scalar current for AnomalyDetector /
+                # consume_anomaly regardless of which branch ran; a skip
+                # here never advanced _step_count (optimizer.step was
+                # not called), so advance the reconciliation ledger in
+                # step so consume_anomaly doesn't decrement for it
+                optimizer._stash_anomaly(found, gnorm)
+                if f:
+                    optimizer._reconciled_skips += 1
+        self._found_dev = None
+        self._gnorm_dev = None
         self._unscaled.discard(id(optimizer))
-        self._update_scale()
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
@@ -188,36 +296,27 @@ class GradScaler:
     def update(self):
         pass  # paddle calls scaler.update() after step in some recipes
 
-    def _update_scale(self):
-        if not (self._enable and self._dynamic):
-            return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every:
-                self._scale = max(1.0, self._scale * self._decr_ratio)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every:
-                self._scale *= self._incr_ratio
-                self._good_steps = 0
-
     def is_enable(self):
         return self._enable
 
     def get_loss_scaling(self):
-        return self._scale
+        if not self._enable:
+            return 1.0
+        return float(jax.device_get(self._scale_t._data))
 
     def state_dict(self):
-        return {"scale": self._scale, "good": self._good_steps,
-                "bad": self._bad_steps}
+        if not self._enable:
+            return {"scale": 1.0, "good": 0, "bad": 0}
+        return {"scale": float(jax.device_get(self._scale_t._data)),
+                "good": int(jax.device_get(self._good_t._data)),
+                "bad": int(jax.device_get(self._bad_t._data))}
 
     def set_state_dict(self, sd):
-        self._scale = sd["scale"]
-        self._good_steps = sd["good"]
-        self._bad_steps = sd["bad"]
+        if not self._enable:
+            return
+        self._scale_t._set_data(jnp.float32(float(sd["scale"])))
+        self._good_t._set_data(jnp.int32(int(sd["good"])))
+        self._bad_t._set_data(jnp.int32(int(sd["bad"])))
 
 from . import debugging  # noqa: E402,F401
 from . import accuracy_compare  # noqa: E402,F401

@@ -10,11 +10,21 @@ checkpoint-and-relaunch instead of a dead job
 (:class:`ResilientTrainer`). Reference analog: the fleet elastic stack
 (fleet/elastic/manager.py:126) + comm_task_manager error fan-out
 (phi/core/distributed/comm_task_manager.h:37).
+
+Numerical faults ride the same machinery: the in-capture sentinel
+(``FLAGS_anomaly_sentinel``) turns a poison step into an exact no-op on
+device, :class:`AnomalyDetector` escalates persistent badness
+(non-finite streaks, EMA loss spikes), and
+:meth:`ResilientTrainer.rewind` restores the newest committed
+generation and deterministically skips the poison data window through
+the DataLoader's resumable stream state.
 """
 
+from .anomaly import AnomalyAction, AnomalyDetector  # noqa: F401
 from .checkpointer import (AsyncCheckpointer, flatten_state,  # noqa: F401
                            restore_state, training_state)
 from .trainer import ResilientTrainer, TrainerAction  # noqa: F401
 
-__all__ = ["AsyncCheckpointer", "ResilientTrainer", "TrainerAction",
-           "flatten_state", "restore_state", "training_state"]
+__all__ = ["AnomalyAction", "AnomalyDetector", "AsyncCheckpointer",
+           "ResilientTrainer", "TrainerAction", "flatten_state",
+           "restore_state", "training_state"]

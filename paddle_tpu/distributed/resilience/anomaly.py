@@ -1,0 +1,163 @@
+"""Host-side anomaly policy: when is a bad step a blip, when is it rot?
+
+The device side of numerical fault tolerance is the in-capture sentinel
+(``FLAGS_anomaly_sentinel`` / ``GradScaler``): a non-finite gradient set
+already applied an exact no-op to the (donated) parameters, so a SINGLE
+poison batch costs one skipped update and nothing else. What the device
+cannot decide is whether the badness is *transient* (one corrupt
+example, an fp16 scale overshoot — keep skipping) or *persistent* (a
+diverged run, a poisoned data window — every future step will be bad
+too, and the only way out is to restore a known-good checkpoint and
+route AROUND the poison data). That call needs history, so it lives
+here, on the host, fed one observation per step:
+
+* **non-finite streaks** — ``skipped`` (the sentinel fired) or a
+  non-finite loss. A streak of ``nonfinite_streak`` consecutive bad
+  steps escalates to REWIND.
+* **loss-spike detection** — an EMA mean/variance of the (finite) loss;
+  after ``warmup_steps`` clean observations, a z-score above
+  ``spike_zscore`` marks the step a spike (spikes never update the EMA,
+  so a diverging run cannot drag its own baseline up). A streak of
+  ``spike_streak`` spikes escalates to REWIND.
+
+``observe`` returns one of :class:`AnomalyAction`: ``OK`` (clean),
+``SKIP`` (bad step; the in-device no-op already handled it — keep
+going), ``REWIND`` (restore + skip the poison window;
+``ResilientTrainer.rewind`` consumes this). ``first_bad_step`` marks
+where the current bad run began — the left edge of the data window a
+rewind must skip. Every transition lands in the flight recorder and the
+``anomaly.{nonfinite_steps,skipped_updates,loss_spikes}`` counters.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ...observability import flight_recorder as _flight
+from ...observability import metrics as _metrics
+
+__all__ = ["AnomalyAction", "AnomalyDetector"]
+
+_M_NONFINITE = _metrics.registry().counter(
+    "anomaly.nonfinite_steps",
+    help="steps observed with non-finite grads or loss")
+_M_SKIPPED = _metrics.registry().counter(
+    "anomaly.skipped_updates",
+    help="optimizer updates the device sentinel turned into exact no-ops")
+_M_SPIKES = _metrics.registry().counter(
+    "anomaly.loss_spikes",
+    help="finite-loss steps beyond the EMA z-score spike threshold")
+
+_record = _flight.record_event
+
+
+class AnomalyAction:
+    OK = "ok"
+    SKIP = "skip"        # bad step, already neutralized in-device
+    REWIND = "rewind"    # persistent badness: restore + skip the window
+
+
+class AnomalyDetector:
+    """Streak/z-score reducer over per-step ``(loss, skipped)`` signals.
+
+    ``observe(step, loss, skipped=, grad_norm=)`` — ``loss`` may be None
+    (sentinel-only wiring); ``skipped`` is the device sentinel's verdict
+    for the step (``Optimizer.consume_anomaly()``); ``grad_norm`` is
+    carried into the flight event for post-mortems.
+    """
+
+    def __init__(self, nonfinite_streak: int = 3, spike_zscore: float = 8.0,
+                 spike_streak: int = 3, ema_beta: float = 0.98,
+                 warmup_steps: int = 20):
+        if nonfinite_streak < 1 or spike_streak < 1:
+            raise ValueError("streak thresholds must be >= 1")
+        self.nonfinite_streak = int(nonfinite_streak)
+        self.spike_zscore = float(spike_zscore)
+        self.spike_streak = int(spike_streak)
+        self.ema_beta = float(ema_beta)
+        self.warmup_steps = int(warmup_steps)
+        self.first_bad_step: Optional[int] = None
+        self._nf_run = 0
+        self._spike_run = 0
+        self._bad_run = 0    # ANY-kind consecutive bad steps: an
+        #                      alternating inf/spike oscillation must
+        #                      still escalate even though it resets the
+        #                      per-kind counters against each other
+        self._mean = 0.0
+        self._var = 0.0
+        self._n = 0
+
+    # -- EMA -----------------------------------------------------------------
+    def _zscore(self, loss: float) -> float:
+        if self._n < 2:
+            return 0.0
+        # floor the std at 5% of the mean's magnitude: a freshly-seeded
+        # EMA (or a loss that plateaued hard) has near-zero variance, and
+        # a raw z-score against it would flag every ordinary fluctuation
+        # as a spike — the floor keeps "spike" meaning a multiple of the
+        # loss's own scale, not of numerical dust
+        std = max(math.sqrt(max(self._var, 0.0)),
+                  0.05 * abs(self._mean), 1e-12)
+        return abs(loss - self._mean) / std
+
+    def _update_ema(self, loss: float) -> None:
+        b = self.ema_beta
+        if self._n == 0:
+            self._mean, self._var = loss, 0.0
+        else:
+            d = loss - self._mean
+            self._mean = b * self._mean + (1.0 - b) * loss
+            self._var = b * self._var + (1.0 - b) * d * d
+        self._n += 1
+
+    # -- per-step observation ------------------------------------------------
+    def observe(self, step: int, loss: Optional[float] = None,
+                skipped: bool = False,
+                grad_norm: Optional[float] = None) -> str:
+        bad = False
+        nonfinite = bool(skipped) or (
+            loss is not None and not math.isfinite(loss))
+        if nonfinite:
+            bad = True
+            self._nf_run += 1
+            self._spike_run = 0
+            _M_NONFINITE.inc()
+            if skipped:
+                _M_SKIPPED.inc()
+            _record("anomaly.nonfinite",
+                    (step, loss, grad_norm, self._nf_run))
+        else:
+            self._nf_run = 0
+            if loss is not None:
+                z = self._zscore(loss)
+                if self._n >= self.warmup_steps and z > self.spike_zscore:
+                    bad = True
+                    self._spike_run += 1
+                    _M_SPIKES.inc()
+                    _record("anomaly.loss_spike",
+                            (step, loss, round(z, 2), self._spike_run))
+                else:
+                    self._spike_run = 0
+                    self._update_ema(loss)
+        if bad:
+            self._bad_run += 1
+            if self.first_bad_step is None:
+                self.first_bad_step = step
+            if self._nf_run >= self.nonfinite_streak \
+                    or self._spike_run >= self.spike_streak \
+                    or self._bad_run >= max(self.nonfinite_streak,
+                                            self.spike_streak):
+                return AnomalyAction.REWIND
+            return AnomalyAction.SKIP
+        self._bad_run = 0
+        self.first_bad_step = None
+        return AnomalyAction.OK
+
+    def reset(self) -> None:
+        """Clear streak state after a rewind (the EMA baseline is kept —
+        it was built from clean steps only)."""
+        self._nf_run = 0
+        self._spike_run = 0
+        self._bad_run = 0
+        self.first_bad_step = None

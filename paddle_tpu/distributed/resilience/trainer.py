@@ -27,11 +27,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
 
 from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
 from ..checkpoint.save_load import latest_checkpoint
+from .anomaly import AnomalyAction, AnomalyDetector
 from .checkpointer import AsyncCheckpointer, restore_state
 
 __all__ = ["ResilientTrainer", "TrainerAction"]
@@ -48,6 +51,12 @@ _M_RESTORES = _metrics.registry().counter(
 _M_RESUME_STEP = _metrics.registry().gauge(
     "resilience.resume_step",
     help="step this process resumed from after its last restore")
+_M_REWINDS = _metrics.registry().counter(
+    "anomaly.rewinds",
+    help="anomaly-triggered restores from a committed generation")
+_M_REWIND_SECONDS = _metrics.registry().histogram(
+    "anomaly.rewind_seconds",
+    help="wall time of each anomaly rewind (restore + stream reposition)")
 
 
 _record = _flight.record_event
@@ -57,6 +66,7 @@ class TrainerAction:
     CONTINUE = "continue"
     CHECKPOINT_EXIT = "checkpoint_exit"   # preempted: snapshot taken, exit 0
     RESTART = "restart"                   # lost rank: exit for re-rank+restore
+    REWIND = "rewind"                     # numerical fault: restore in process
     COMPLETED = "completed"
 
 
@@ -76,11 +86,25 @@ class ResilientTrainer:
                  elastic=None, watchdog=None,
                  snapshot_every: int = 50,
                  install_signal: bool = True,
-                 signum: Optional[int] = None):
+                 signum: Optional[int] = None,
+                 anomaly: Optional[AnomalyDetector] = None,
+                 optimizer=None, data_loader=None):
         self.checkpointer = checkpointer
+        self.anomaly = anomaly
+        self.optimizer = optimizer   # sentinel source (consume_anomaly)
+        self.data_loader = data_loader
+        if data_loader is not None:
+            # journal the stream position next to the model/opt state:
+            # the loader's (epoch, cursor, seed) are host scalars, so
+            # they land in the generation's host_state.json and both
+            # preemption-resume and rewind replay the exact batch order
+            self._user_state_fn = state_fn
+            state_fn = lambda: {"train": self._user_state_fn(),  # noqa: E731
+                                "data_stream": data_loader.state_dict()}
         self.state_fn = state_fn
         self.apply_fn = apply_fn
         self.elastic = elastic
+        self._skip_window: Optional[Tuple[int, int]] = None
         self.snapshot_every = max(0, int(snapshot_every))
         self.handler = None
         if elastic is not None and install_signal:
@@ -112,6 +136,11 @@ class ResilientTrainer:
             return 0
         rebuilt, step = restore_state(self.state_fn(), path)
         resume = (step + 1) if step is not None else 0
+        if self.data_loader is not None:
+            stream = rebuilt.get("data_stream")
+            if stream is not None:
+                self.data_loader.load_state_dict(stream)
+            rebuilt = rebuilt.get("train")
         if self.apply_fn is not None:
             self.apply_fn(rebuilt, resume)
         _M_RESTORES.inc()
@@ -119,6 +148,69 @@ class ResilientTrainer:
         _record("resilience.restore", (path, resume))
         self.resume_step = resume
         return resume
+
+    # -- anomaly policy ------------------------------------------------------
+    def observe(self, step: int, loss=None) -> str:
+        """Feed the per-step anomaly signals (loss + the optimizer's
+        device sentinel) to the detector. Returns ``CONTINUE`` or
+        ``REWIND`` — the in-device sentinel already neutralized a SKIP,
+        so nothing more is needed for it here."""
+        if self.anomaly is None:
+            return TrainerAction.CONTINUE
+        skipped, gnorm = False, None
+        if self.optimizer is not None \
+                and hasattr(self.optimizer, "consume_anomaly"):
+            sent = self.optimizer.consume_anomaly()
+            if sent is not None:
+                skipped, gnorm = sent
+        lv = None
+        if loss is not None:
+            arr = getattr(loss, "_data", loss)
+            try:
+                lv = float(np.asarray(arr))
+            except (TypeError, ValueError):
+                lv = None   # step_fn returned something that isn't a loss
+        act = self.anomaly.observe(step, lv, skipped=skipped,
+                                   grad_norm=gnorm)
+        if act == AnomalyAction.REWIND:
+            return TrainerAction.REWIND
+        return TrainerAction.CONTINUE
+
+    def rewind(self, step: int) -> Optional[int]:
+        """Anomaly escalation: restore the newest COMMITTED generation
+        (params, optimizer state, data-stream position) and mark the
+        poison data window ``[first_bad_step, step]`` for deterministic
+        skipping on the replay. Returns the step to resume from, or
+        None when no committed generation exists (the sentinel's
+        in-device skips keep the run safe; training just continues)."""
+        self.checkpointer.wait()   # an in-flight async write may be the
+        #                            generation this rewind needs
+        path = latest_checkpoint(self.checkpointer.root)
+        if path is None:
+            _record("anomaly.rewind_unavailable", (step,))
+            if self.anomaly is not None:
+                self.anomaly.reset()
+            return None
+        t0 = time.monotonic()
+        first_bad = step
+        if self.anomaly is not None \
+                and self.anomaly.first_bad_step is not None:
+            first_bad = self.anomaly.first_bad_step
+        resume = self.restore()
+        self._skip_window = (first_bad, step)
+        _M_REWINDS.inc()
+        _M_REWIND_SECONDS.observe(time.monotonic() - t0)
+        _record("anomaly.rewind", (step, resume, first_bad))
+        if self.anomaly is not None:
+            self.anomaly.reset()
+        return resume
+
+    def should_skip(self, step: int) -> bool:
+        """True while ``step`` sits inside the poison data window of the
+        last rewind: the caller drops that step's batch (advancing its
+        data stream) instead of training on it."""
+        w = self._skip_window
+        return w is not None and w[0] <= step <= w[1]
 
     # -- per-step poll -------------------------------------------------------
     def poll(self, step: int) -> str:
@@ -161,7 +253,17 @@ class ResilientTrainer:
             return TrainerAction.RESTART
         if self.snapshot_every and step > 0 \
                 and step % self.snapshot_every == 0:
-            self.checkpointer.save(self.state_fn(), step)
+            if self.anomaly is not None \
+                    and self.anomaly.first_bad_step is not None:
+                # mid-bad-streak: loss spikes do NOT skip the update
+                # (only the device sentinel's nonfinite path does), so a
+                # snapshot here could commit already-poisoned params —
+                # the very generation a rewind would then restore.
+                # Skip the periodic save until the streak resolves
+                _record("anomaly.snapshot_suppressed",
+                        (step, self.anomaly.first_bad_step))
+            else:
+                self.checkpointer.save(self.state_fn(), step)
         return TrainerAction.CONTINUE
 
     def _poll_preempted(self) -> bool:
@@ -229,8 +331,16 @@ class ResilientTrainer:
 
     # -- convenience loop ----------------------------------------------------
     def run(self, step_fn: Callable[[int], Any], max_steps: int,
-            final_snapshot: bool = True) -> str:
+            final_snapshot: bool = True,
+            skip_fn: Optional[Callable[[int], None]] = None) -> str:
         """Restore, then drive ``step_fn(step)`` with a poll per step.
+
+        With an :class:`AnomalyDetector` configured, ``step_fn``'s
+        return value is observed as the loss each step; a REWIND
+        escalation restores the newest committed generation in process
+        and replays, calling ``skip_fn(step)`` instead of ``step_fn``
+        for every step inside the poison data window (the caller drops
+        that step's batch there, keeping its stream aligned).
 
         Also catches the captured-step "donated inputs were consumed"
         replay failure: when a committed generation exists, the loop
@@ -239,8 +349,13 @@ class ResilientTrainer:
         step = self.restore()
         recovered_at = -1
         while step < max_steps:
+            if self.should_skip(step):
+                if skip_fn is not None:
+                    skip_fn(step)
+                step += 1
+                continue
             try:
-                step_fn(step)
+                out = step_fn(step)
             except RuntimeError as e:
                 if ("donated inputs were consumed" in str(e)
                         and recovered_at != step
@@ -250,6 +365,80 @@ class ResilientTrainer:
                     step = self.restore()
                     continue
                 raise
+            if self.anomaly is not None \
+                    and self.observe(step, out) == TrainerAction.REWIND:
+                resumed = self.rewind(step)
+                if resumed is not None:
+                    step = resumed
+                    continue
+            action = self.poll(step)
+            if action != TrainerAction.CONTINUE:
+                self.checkpointer.wait()
+                return action
+            step += 1
+        if final_snapshot:
+            self.checkpointer.save(self.state_fn(), max_steps - 1,
+                                   block=True)
+        self.checkpointer.wait()
+        return TrainerAction.COMPLETED
+
+    def run_data(self, train_fn: Callable[[int, Any], Any],
+                 max_steps: int, final_snapshot: bool = True) -> str:
+        """Like :meth:`run`, but the trainer OWNS the data iteration
+        over its ``data_loader``: ``train_fn(step, batch)`` trains one
+        step. Epochs chain automatically; a restore or rewind drops the
+        live iterator so the next batch comes from the loader's restored
+        stream position, and poison-window steps consume (drop) their
+        batch without training — which is exactly what makes the replay
+        deterministic: every step index maps to the same batch on every
+        incarnation."""
+        if self.data_loader is None:
+            raise ValueError("run_data requires the data_loader the "
+                             "trainer was constructed with")
+        it = [None]
+
+        def next_batch():
+            empties = 0
+            while True:
+                if it[0] is None:
+                    it[0] = iter(self.data_loader)
+                try:
+                    return next(it[0])
+                except StopIteration:
+                    # one empty pass is legal (a resume positioned at an
+                    # epoch boundary); two in a row = an empty loader
+                    empties += 1
+                    if empties >= 2:
+                        raise RuntimeError(
+                            "run_data: data_loader yielded no batches")
+                    it[0] = None   # epoch boundary: roll into the next
+
+        step = self.restore()
+        recovered_at = -1
+        while step < max_steps:
+            batch = next_batch()
+            if self.should_skip(step):
+                step += 1
+                continue
+            try:
+                out = train_fn(step, batch)
+            except RuntimeError as e:
+                if ("donated inputs were consumed" in str(e)
+                        and recovered_at != step
+                        and latest_checkpoint(self.checkpointer.root)
+                        is not None):
+                    recovered_at = step
+                    step = self.restore()
+                    it[0] = None
+                    continue
+                raise
+            if self.anomaly is not None \
+                    and self.observe(step, out) == TrainerAction.REWIND:
+                resumed = self.rewind(step)
+                if resumed is not None:
+                    step = resumed
+                    it[0] = None
+                    continue
             action = self.poll(step)
             if action != TrainerAction.CONTINUE:
                 self.checkpointer.wait()

@@ -166,6 +166,19 @@ define_flag("step_capture_screen", True,
             "tensor hooks, create_graph=True) fall back to eager with a "
             "source-located diagnosis BEFORE paying the probe + trace + "
             "abort cycle; False defers entirely to the dynamic path")
+define_flag("anomaly_sentinel", False,
+            "numerical-fault sentinel (optimizer/optimizer.py): every "
+            "optimizer update computes a fused device-side finiteness + "
+            "global-norm reduction over the gradients and guards the "
+            "parameter/state update with per-leaf selects — a "
+            "non-finite step applies an exact bitwise no-op (critical "
+            "under whole-step capture, "
+            "where the update lands in DONATED buffers and a NaN step "
+            "would corrupt params irrecoverably in-process). The sentinel "
+            "scalar rides the step's outputs; read it host-side via "
+            "Optimizer.consume_anomaly() or distributed.resilience."
+            "AnomalyDetector. Eager steps pay one deferred host sync; "
+            "captured steps pay none")
 define_flag("use_pallas_kernels", True, "route hot ops to Pallas hand kernels")
 define_flag("benchmark", False, "block on every op for accurate timing")
 define_flag("comm_timeout_s", 600.0,

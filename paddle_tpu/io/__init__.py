@@ -353,6 +353,17 @@ class _WorkerPool:
 
 
 class DataLoader:
+    """Batching loader with RESUMABLE streams: :meth:`state_dict` /
+    :meth:`load_state_dict` capture (epoch, batch cursor, sampler seed)
+    so a preempted or rewound training run replays the exact batch
+    sequence byte-identically. When the loader owns its sampler
+    (``batch_sampler=None``), each epoch's shuffle order derives from a
+    per-loader seed + the epoch number (never the process-global RNG),
+    so mid-epoch resume regenerates the same permutation and skips to
+    the cursor; a custom ``batch_sampler`` must itself be deterministic
+    per epoch (``DistributedBatchSampler.set_epoch`` is) for the cursor
+    skip to replay the same indices."""
+
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False,
                  drop_last=False, collate_fn=None, num_workers=0,
@@ -375,12 +386,21 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
         self._pool: Optional[_WorkerPool] = None
+        self.shuffle = bool(shuffle)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        # resumable-stream state: epoch counter, consumed-batch cursor,
+        # and the per-loader sampler seed the shuffle derives from
+        self._epoch = -1
+        self._cursor = 0
+        self._resume = False
+        self._seed = int(np.random.randint(0, 2 ** 31))
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
-            self.batch_size = batch_size
-            self.drop_last = drop_last
             self.num_workers = 0  # stream datasets stay on the thread path
+            self._owns_sampler = False
         else:
+            self._owns_sampler = batch_sampler is None
             self.batch_sampler = batch_sampler or BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size,
                 drop_last=drop_last)
@@ -394,43 +414,89 @@ class DataLoader:
         if self._pool is not None:
             self._pool.shutdown()
 
-    def _produce(self):
-        if self.batch_sampler is None:
-            batch = []
-            for sample in self.dataset:
-                batch.append(sample)
-                if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
-                    batch = []
-            if batch and not self.drop_last:
-                yield self.collate_fn(batch)
-        else:
-            for idxs in self.batch_sampler:
-                yield self.collate_fn([self.dataset[i] for i in idxs])
+    # -- resumable-stream state ----------------------------------------------
+    def state_dict(self) -> dict:
+        """Snapshot the stream position: resuming a fresh loader from
+        this dict replays the remaining batches byte-identically (the
+        checkpoint ``host_state.json`` journals it, so preemption-resume
+        and anomaly rewind both restore the exact data order)."""
+        if isinstance(self.dataset, IterableDataset):
+            raise TypeError(
+                "IterableDataset streams are not resumable: the loader "
+                "cannot re-derive an arbitrary position in user iterator "
+                "state — checkpoint the stream inside the dataset instead")
+        return {"epoch": self._epoch, "batch": self._cursor,
+                "seed": self._seed, "dataset_len": len(self.dataset),
+                "owns_sampler": self._owns_sampler}
 
-    def _iter_multiprocess(self):
+    def load_state_dict(self, sd: dict) -> None:
+        if isinstance(self.dataset, IterableDataset):
+            raise TypeError("IterableDataset streams are not resumable")
+        have = len(self.dataset)
+        saved = int(sd["dataset_len"])
+        if saved != have:
+            raise ValueError(
+                f"DataLoader.load_state_dict: dataset length changed "
+                f"({saved} samples at save time, {have} now) — the saved "
+                f"cursor/permutation would replay DIFFERENT data "
+                f"silently; refusing. Restore the original dataset or "
+                f"drop the stream state")
+        saved_owns = bool(sd.get("owns_sampler", self._owns_sampler))
+        if saved_owns != self._owns_sampler:
+            raise ValueError(
+                "DataLoader.load_state_dict: sampler arrangement changed "
+                "(saved from a loader that "
+                + ("owned its sampler" if saved_owns
+                   else "used a custom batch_sampler")
+                + ", restoring into one that does not) — the cursor "
+                "would skip into a DIFFERENT index stream silently; "
+                "construct the loader the way the saving run did")
+        self._epoch = int(sd["epoch"])
+        self._cursor = int(sd["batch"])
+        self._seed = int(sd["seed"])
+        self._resume = True
+
+    def _index_batches(self, epoch: int):
+        """Deterministic index-batch stream for ``epoch``."""
+        if self._owns_sampler:
+            n = len(self.dataset)
+            if self.shuffle:
+                rng = np.random.RandomState(
+                    (self._seed + 0x9E3779B1 * epoch) % (2 ** 31 - 1))
+                order = rng.permutation(n)
+            else:
+                order = np.arange(n)
+            bs = self.batch_size
+            end = (n // bs) * bs if self.drop_last else n
+            for i in range(0, end, bs):
+                yield order[i:i + bs].tolist()
+        else:
+            yield from iter(self.batch_sampler)
+
+    def _produce_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_multiprocess(self, idx_iter):
         if self._pool is None or self._pool._closed:
             self._pool = _WorkerPool(self.dataset, self.collate_fn,
                                      self.num_workers, self.worker_init_fn,
                                      self.prefetch_factor, self.timeout)
         pool = self._pool
         try:
-            for batch in pool.run_epoch(iter(self.batch_sampler)):
-                yield _to_tensors(batch)
+            yield from pool.run_epoch(idx_iter)
         finally:
             if not self.persistent_workers:
                 pool.shutdown()
                 self._pool = None
 
-    def __iter__(self):
-        if self.num_workers > 0 and self.batch_sampler is not None:
-            yield from self._iter_multiprocess()
-            return
-        src = self._produce()
-        if not self.use_buffer_reader:
-            for b in src:
-                yield _to_tensors(b)
-            return
+    def _buffered(self, src):
         # bounded background prefetch (blocking-queue analog)
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
@@ -453,7 +519,39 @@ class DataLoader:
                 if error:
                     raise error[0]
                 break
-            yield _to_tensors(item)
+            yield item
+
+    def __iter__(self):
+        if isinstance(self.dataset, IterableDataset):
+            src = self._produce_iterable()
+            if self.use_buffer_reader:
+                src = self._buffered(src)
+            for b in src:
+                yield _to_tensors(b)
+            return
+        # map-style: position the (resumable) cursor for this pass
+        if self._resume:
+            self._resume = False
+            start = self._cursor
+        else:
+            self._epoch += 1
+            start = 0
+        self._cursor = start
+        idx_iter = self._index_batches(self._epoch)
+        if start:
+            idx_iter = itertools.islice(idx_iter, start, None)
+        if self.num_workers > 0:
+            src = self._iter_multiprocess(idx_iter)
+        else:
+            src = (self.collate_fn([self.dataset[i] for i in idxs])
+                   for idxs in idx_iter)
+            if self.use_buffer_reader:
+                src = self._buffered(src)
+        for b in src:
+            # count the batch as consumed BEFORE handing it out: a
+            # state_dict taken between yields resumes AFTER this batch
+            self._cursor += 1
+            yield _to_tensors(b)
 
 
 def _to_tensors(batch):

@@ -327,13 +327,23 @@ class _TraceCtx:
                        "before optimizer.step)")
         return rec["lr"]
 
-    def traced_step(self, opt):
+    def traced_step(self, opt, applied=None):
+        """The traced step scalar for THIS optimizer.step() call.
+
+        ``applied`` (default 1) is this call's advance of the persistent
+        device step counter; a sentinel-guarded update passes the traced
+        ``where(found, 0, 1)`` so a skipped update does not consume a
+        step — the counter the NEXT replay's bias corrections read stays
+        at applied-updates semantics, exactly like the eager GradScaler
+        skipping the whole ``optimizer.step()`` call."""
         rec = self.opt_in.get(id(opt))
         if rec is None:
             self.abort("optimizer.step() on an optimizer not seen during "
                        "the discovery run")
+        prev = rec.get("adv", rec["calls"])
         rec["calls"] += 1
-        return rec["step"] + rec["calls"]
+        rec["adv"] = prev + (1 if applied is None else applied)
+        return rec["step"] + prev + 1
 
     # core.tensor hook during the trace: a traced value written into a
     # persistent tensor OUTSIDE the captured state set would be silently
@@ -602,7 +612,9 @@ class CapturedStep:
                         for t in state)
                     new_packs = tuple(
                         (tuple(o._states), tuple(o._masters),
-                         opt_in[id(o)]["step"] + opt_in[id(o)]["calls"])
+                         opt_in[id(o)]["step"]
+                         + opt_in[id(o)].get("adv",
+                                             opt_in[id(o)]["calls"]))
                         for o in opts)
             finally:
                 for o, (s, m) in zip(opts, saved_opt):
@@ -732,6 +744,11 @@ class CapturedStep:
             o._states = list(pack[0])
             o._masters = list(pack[1])
             if host_effects:
+                # sentinel note: whether a guarded update (and its step
+                # advance) applied is on DEVICE only — the optimizer's
+                # cumulative-skip ledger in _anomaly_t lets its next
+                # consume_anomaly() reconcile this host count exactly,
+                # however many replays happened in between
                 o._step_count += d.opt_steps.get(id(o), 0)
             self._opt_sync[id(o)] = [o._step_count, pack[2]]
         if host_effects:

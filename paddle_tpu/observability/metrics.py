@@ -77,6 +77,9 @@ METRIC_NAMES = frozenset({
     # distributed/resilience/trainer.py
     "resilience.preemptions", "resilience.rank_deaths",
     "resilience.restores", "resilience.resume_step",
+    # distributed/resilience/anomaly.py + trainer.py (numerical faults)
+    "anomaly.nonfinite_steps", "anomaly.skipped_updates",
+    "anomaly.loss_spikes", "anomaly.rewinds", "anomaly.rewind_seconds",
     # models/serving.py (ragged continuous-batching engine)
     "serving.steps", "serving.step_tokens", "serving.generated_tokens",
     "serving.prefill_tokens", "serving.admitted", "serving.finished",
@@ -89,6 +92,7 @@ METRIC_NAMES = frozenset({
     # serving/resilience/ (request journal + replay, drain, warm-start)
     "serving.resilience.journal_records",
     "serving.resilience.journal_flushes",
+    "serving.resilience.journal_compactions",
     "serving.resilience.replayed_requests",
     "serving.resilience.replayed_tokens",
     "serving.resilience.recovered_finished",

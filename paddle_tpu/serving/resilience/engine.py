@@ -111,6 +111,9 @@ class ResilientServingEngine:
         self.replayed_requests = 0
         self.recovered_finished = 0
         self.warm_blocks = 0
+        # finished requests whose output was DELIVERED (pop_output):
+        # the next rewrite-on-snapshot compaction drops them from the WAL
+        self._retired: set = set()
 
         state = self.journal.load()
         model_fp = _model_fingerprint(model)
@@ -232,12 +235,14 @@ class ResilientServingEngine:
         self._watermark.pop(req.rid, None)
 
     def pop_output(self, rid: int) -> Optional[List[int]]:
-        """Retire a delivered output from host memory (the journal
-        still holds it durably, so a relaunch re-materializes it —
-        journal compaction is the open item for retiring it from disk
-        and from recovery time too). Mirrors the inner engine's
+        """Retire a delivered output from host memory and mark it for
+        the next journal compaction, which drops its records from disk
+        (and from recovery time) too. Mirrors the inner engine's
         ``pop_result``: a long-running server pops what it has sent."""
-        return self.outputs.pop(rid, None)
+        out = self.outputs.pop(rid, None)
+        if out is not None:
+            self._retired.add(rid)
+        return out
 
     def _journal_tokens(self, req) -> None:
         have = self._watermark.get(req.rid, 0)
@@ -291,6 +296,28 @@ class ResilientServingEngine:
             _record("serving.resilience.snapshot_failed",
                     (type(e).__name__, str(e)))
             path = None
+        # rewrite-on-snapshot journal compaction: retired (finished +
+        # delivered) requests leave the WAL, bounding disk growth and
+        # recovery time on a long retire-heavy stream. Skipped when
+        # there is nothing to drop AND the segment count is small — a
+        # compaction pass rewrites the whole WAL, which is pure I/O tax
+        # when it would drop nothing
+        if self._retired or len(self.journal._segment_names()) > 64:
+            # snapshot the set first: pop_output is poller-thread API,
+            # so a rid retired DURING the slow compaction I/O must stay
+            # marked for the next pass, not vanish in a blanket clear
+            done = set(self._retired)
+            try:
+                dropped = self.journal.compact(done)
+                self._retired -= done   # their records are off disk now
+                if dropped:
+                    _record("serving.resilience.journal_compacted",
+                            (dropped, self.journal._next_seg))
+            except OSError as e:
+                # disk hiccup: the un-compacted journal stays fully
+                # valid; keep the retired set for the next attempt
+                _record("serving.resilience.compact_failed",
+                        (type(e).__name__, str(e)))
         # snapshot wall time (device gather + fsyncs) is PROGRESS, not
         # a wedged step: don't let the watchdog charge it as a hang
         self._last_progress = time.monotonic()

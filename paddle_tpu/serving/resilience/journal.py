@@ -39,6 +39,18 @@ already flushed — both land, and because replay regenerates the same
 tokens byte-identically, overlapping watermark records from the two
 writers are validated equal and merged on load (a disagreement is a
 hard integrity error: something other than this engine wrote here).
+
+Compaction (:meth:`RequestJournal.compact`): a long-running server's
+WAL would otherwise grow without bound while every record it holds is
+for a request already finished AND delivered. Rewrite-on-snapshot
+reduces the whole journal into ONE ``snap-<n>-<uid>.jsonl`` file (the
+reduction of segments ``<= n``, with retired requests dropped), then
+unlinks the superseded segment files. ``load`` applies the newest
+snapshot first and only segments numbered ABOVE its coverage after it,
+so a crash anywhere inside compaction is safe: before the snapshot
+rename nothing changed; after it, leftover old segments are simply
+ignored. Snapshot files carry the same per-incarnation uid fencing as
+segments.
 """
 
 from __future__ import annotations
@@ -56,6 +68,7 @@ from ...utils.durability import (COMMIT_FILE, fsync_write,
 __all__ = ["RequestJournal", "JournalState", "RequestRecord"]
 
 _SEG_PREFIX = "seg-"
+_SNAP_PREFIX = "snap-"
 
 
 def _seg_number(name: str) -> int:
@@ -64,12 +77,22 @@ def _seg_number(name: str) -> int:
     stem = name[len(_SEG_PREFIX):]
     return int(stem.split("-")[0].split(".")[0])
 
+
+def _snap_covered(name: str) -> int:
+    """Highest segment number a ``snap-<n>-<uid>.jsonl`` reduces."""
+    stem = name[len(_SNAP_PREFIX):]
+    return int(stem.split("-")[0].split(".")[0])
+
 _M_RECORDS = _metrics.registry().counter(
     "serving.resilience.journal_records",
     help="journal records appended (admissions, watermarks, finishes)")
 _M_FLUSHES = _metrics.registry().counter(
     "serving.resilience.journal_flushes",
     help="journal segments committed to disk (fsync + atomic rename)")
+_M_COMPACTIONS = _metrics.registry().counter(
+    "serving.resilience.journal_compactions",
+    help="rewrite-on-snapshot compactions (segments reduced into one "
+         "snapshot file, retired requests dropped)")
 
 
 class RequestRecord:
@@ -173,6 +196,9 @@ class RequestJournal:
         self._next_seg = 0
         for name in self._segment_names():
             self._next_seg = max(self._next_seg, _seg_number(name) + 1)
+        for name in self._snap_names():
+            # a snapshot reduces segments <= n; numbering continues past it
+            self._next_seg = max(self._next_seg, _snap_covered(name) + 1)
 
     # -- write side ----------------------------------------------------------
     def append(self, rec: Dict[str, Any]) -> None:
@@ -217,6 +243,68 @@ class RequestJournal:
     def pending_records(self) -> int:
         return len(self._buffer)
 
+    # -- compaction ----------------------------------------------------------
+    def compact(self, drop_rids=()) -> int:
+        """Rewrite-on-snapshot: reduce every readable record into ONE
+        ``snap-<covered>-<uid>.jsonl`` file — dropping requests that are
+        finished AND in ``drop_rids`` (retired: their output was
+        delivered, nothing will ever replay them) — then unlink the
+        superseded segment files and older snapshots. Returns the number
+        of requests dropped.
+
+        Crash-safe by construction: the snapshot lands via the shared
+        commit protocol, and ``load`` ignores segments its coverage
+        subsumes, so dying before the rename changes nothing and dying
+        mid-unlink merely leaves ignorable files for the next pass."""
+        self.flush()
+        if self._next_seg == 0:
+            return 0
+        state = self.load()
+        covered = self._next_seg - 1
+        recs: List[Dict[str, Any]] = []
+        if state.config is not None:
+            recs.append(state.config)
+        drop = set(int(r) for r in drop_rids)
+        dropped = 0
+        for rid in sorted(state.requests):
+            req = state.requests[rid]
+            if req.finished and rid in drop:
+                dropped += 1
+                continue
+            recs.append({"t": "admit", "rid": req.rid,
+                         "prompt": req.prompt,
+                         "max_new_tokens": req.max_new_tokens})
+            if req.tokens:
+                recs.append({"t": "tokens", "rid": req.rid, "from": 0,
+                             "toks": req.tokens})
+            if req.finished:
+                recs.append({"t": "finish", "rid": req.rid})
+        payload = "".join(json.dumps(r, separators=(",", ":")) + "\n"
+                          for r in recs).encode()
+        snap = f"{_SNAP_PREFIX}{covered:08d}-{self._uid}.jsonl"
+        fsync_write(os.path.join(self.root, snap),
+                    lambda f: f.write(payload))
+        for name in self._segment_names():
+            if _seg_number(name) <= covered:
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass  # already gone (concurrent compaction); load
+                    #       ignores it either way
+        for name in self._snap_names():
+            # EQUAL coverage included: a re-compaction with no new
+            # segments in between (covered unchanged) must retire the
+            # previous snapshot, or load()'s (covered, name) tie-break
+            # would pick between the two by uid — and the stale one
+            # resurrects the requests this pass just dropped
+            if name != snap and _snap_covered(name) <= covered:
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass  # same: superseded snapshots are ignorable
+        _M_COMPACTIONS.inc()
+        return dropped
+
     # -- read side -----------------------------------------------------------
     def _segment_names(self) -> List[str]:
         try:
@@ -227,10 +315,31 @@ class RequestJournal:
         return sorted(n for n in names
                       if n.startswith(_SEG_PREFIX) and n.endswith(".jsonl"))
 
+    def _snap_names(self) -> List[str]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(n for n in names
+                      if n.startswith(_SNAP_PREFIX) and n.endswith(".jsonl"))
+
     def load(self) -> JournalState:
-        """Reduce every segment, in order, to per-request state."""
+        """Reduce the newest snapshot (if any) plus every segment above
+        its coverage, in order, to per-request state."""
         state = JournalState()
+        covered = -1
+        snaps = self._snap_names()
+        if snaps:
+            best = max(snaps, key=lambda n: (_snap_covered(n), n))
+            covered = _snap_covered(best)
+            with open(os.path.join(self.root, best), encoding="utf-8") as f:
+                for line in f:
+                    if line.strip():
+                        state.apply(json.loads(line))
+            state.segments += 1
         for name in self._segment_names():
+            if _seg_number(name) <= covered:
+                continue   # reduced into the snapshot already
             with open(os.path.join(self.root, name), encoding="utf-8") as f:
                 for line in f:
                     if line.strip():

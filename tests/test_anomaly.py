@@ -1,0 +1,691 @@
+"""Numerical fault tolerance (ISSUE 10): in-capture anomaly sentinel,
+skip-or-rewind recovery, resumable data streams.
+
+The acceptance chaos test: inject NaN/Inf at an arbitrary step and
+recover through BOTH policies —
+
+* **SKIP** (in-device): the sentinel's guarded update applies an exact
+  no-op to the donated params, captured == eager bitwise across
+  SGD/Adam/GradScaler-bf16, and AMP steps capture with ZERO fallbacks
+  (GradScaler's state is traced donated state now).
+* **REWIND** (host policy): the AnomalyDetector's non-finite streak
+  triggers ResilientTrainer.rewind — restore the newest committed
+  generation, reposition the resumable DataLoader stream, skip the
+  poison data window deterministically — and the loss curve matches an
+  uninterrupted clean reference run.
+
+Satellites covered here: DataLoader state_dict round-trips (mid-epoch,
+shuffle, num_workers>0, byte-identical resume, dataset-length refusal)
+and the frozen anomaly.* metric names.
+"""
+
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.checkpoint import latest_checkpoint
+from paddle_tpu.distributed.resilience import (AnomalyAction,
+                                               AnomalyDetector,
+                                               AsyncCheckpointer,
+                                               ResilientTrainer,
+                                               TrainerAction)
+from paddle_tpu.jit.step_capture import capture_counters
+from paddle_tpu.observability import flight_recorder
+from paddle_tpu.observability.metrics import METRIC_NAMES, registry
+
+
+def _flight_ops():
+    return [e[3] for e in flight_recorder.recorder().entries()]
+
+
+def _counter(name):
+    return registry().get(name).value
+
+
+@pytest.fixture(autouse=True)
+def _sentinel_flag():
+    entry = paddle.get_flags(["FLAGS_anomaly_sentinel",
+                              "FLAGS_step_capture"])
+    yield
+    paddle.set_flags(entry)
+
+
+def _batches(n, poison=(), dim=4, batch=2, kind="nan"):
+    out = []
+    for i in range(n):
+        b = np.random.RandomState(100 + i).randn(batch, dim) \
+            .astype(np.float32)
+        if i in poison:
+            b[:] = np.nan if kind == "nan" else np.inf
+        out.append(b)
+    return out
+
+
+def _mlp_job(opt_name="adam", dtype=jnp.float32, scaler=None):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 4))
+    if dtype != jnp.float32:
+        for p in net.parameters():
+            p._set_data(p._data.astype(dtype))
+    params = net.parameters()
+    if opt_name == "adam":
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=params)
+    else:
+        opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=params)
+
+    def step(x):
+        loss = (net(x) ** 2).mean()
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+        else:
+            loss.backward()
+            opt.step()
+        opt.clear_grad()
+        return loss
+
+    return net, opt, step
+
+
+# --------------------------------------------------------- sentinel (eager)
+
+class TestSentinelEager:
+    def test_poison_step_is_exact_noop(self):
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": False})
+        net, opt, step = _mlp_job("adam")
+        step(Tensor(jnp.asarray(_batches(1)[0])))   # states materialize
+        w0 = np.asarray(net[0].weight._data).copy()
+        m0 = np.asarray(opt._states[0]["m"]).copy()
+        count0 = opt._step_count
+        step(Tensor(jnp.full((2, 4), np.nan, jnp.float32)))
+        assert np.array_equal(w0, np.asarray(net[0].weight._data))
+        assert np.array_equal(m0, np.asarray(opt._states[0]["m"]))
+        # a skipped update does not consume a step (GradScaler semantics)
+        assert opt._step_count == count0
+        skipped, gnorm = opt.consume_anomaly()
+        assert skipped is True
+        assert math.isnan(gnorm) or math.isinf(gnorm)
+
+    def test_clean_steps_identical_with_sentinel_on(self):
+        paddle.set_flags({"FLAGS_step_capture": False})
+        outs = {}
+        for flag in (False, True):
+            paddle.set_flags({"FLAGS_anomaly_sentinel": flag})
+            net, opt, step = _mlp_job("adam")
+            for b in _batches(4):
+                step(Tensor(jnp.asarray(b)))
+            outs[flag] = np.asarray(net[0].weight._data)
+        np.testing.assert_array_equal(outs[False], outs[True])
+
+    def test_consume_reports_clean_norm(self):
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": False})
+        net, opt, step = _mlp_job("sgd")
+        step(Tensor(jnp.asarray(_batches(1)[0])))
+        skipped, gnorm = opt.consume_anomaly()
+        assert skipped is False
+        assert gnorm > 0.0 and math.isfinite(gnorm)
+
+
+# ------------------------------------------------------ sentinel (captured)
+
+class TestSentinelCaptured:
+    @pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+    def test_captured_equals_eager_through_poison(self, opt_name):
+        """The acceptance equivalence: poison at an arbitrary step,
+        captured == eager with the sentinel on — loss curve AND final
+        params, exact dtype."""
+        batches = _batches(6, poison=(3,))
+        results = {}
+        for captured in (False, True):
+            paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                              "FLAGS_step_capture": captured})
+            net, opt, step = _mlp_job(opt_name)
+            fn = paddle.jit_step(step) if captured else step
+            losses = []
+            for b in batches:
+                out = fn(Tensor(jnp.asarray(b)))
+                losses.append(float(np.asarray(out._data)))
+                opt.consume_anomaly()   # per-step host reconcile
+            results[captured] = (losses, np.asarray(net[0].weight._data),
+                                 net[0].weight._data.dtype,
+                                 opt._step_count)
+        le, we, de, ce = results[False]
+        lc, wc, dc, cc = results[True]
+        assert all(math.isnan(a) == math.isnan(b) for a, b in zip(le, lc))
+        np.testing.assert_allclose(
+            [x for x in le if not math.isnan(x)],
+            [x for x in lc if not math.isnan(x)], rtol=1e-6)
+        np.testing.assert_array_equal(we, wc)
+        assert de == dc
+        assert ce == cc          # applied-updates step count reconciled
+
+    def test_donated_params_provably_untouched(self):
+        """A poison replay writes NOTHING into the donated state: every
+        param, master and moment is bitwise its pre-step value."""
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": True})
+        net, opt, step = _mlp_job("adam")
+        cap = paddle.jit_step(step)
+        for b in _batches(3):
+            cap(Tensor(jnp.asarray(b)))
+        before_p = [np.asarray(p._data).copy() for p in net.parameters()]
+        before_s = [jax.tree.map(lambda a: np.asarray(a).copy(), s)
+                    for s in opt._states]
+        cap(Tensor(jnp.full((2, 4), np.inf, jnp.float32)))
+        skipped, _ = opt.consume_anomaly()
+        assert skipped is True
+        for p, b0 in zip(net.parameters(), before_p):
+            assert np.array_equal(b0, np.asarray(p._data))
+        for s, s0 in zip(opt._states, before_s):
+            for k in s0:
+                assert np.array_equal(s0[k], np.asarray(s[k]))
+
+    def test_ledger_reconciles_multiple_skips_between_consumes(self):
+        """The cumulative-skip channel: several skipped replays with NO
+        host read in between still reconcile the host step count
+        exactly on the next consume — per-step polling is sufficient
+        but not required."""
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": True})
+        net, opt, step = _mlp_job("adam")
+        cap = paddle.jit_step(step)
+        batches = _batches(8, poison=(3, 4, 6))
+        for b in batches:
+            cap(Tensor(jnp.asarray(b)))
+        # 8 attempts, 3 skipped, nothing consumed yet
+        skipped, _ = opt.consume_anomaly()
+        assert opt._step_count == 5
+        # a second consume with no new step must not double-decrement
+        opt.consume_anomaly()
+        assert opt._step_count == 5
+
+    def test_sentinel_step_captures_without_fallback(self):
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": True})
+        net, opt, step = _mlp_job("adam")
+        cap = paddle.jit_step(step)
+        c0 = dict(capture_counters)
+        for b in _batches(5, poison=(2,)):
+            cap(Tensor(jnp.asarray(b)))
+        assert capture_counters["fallbacks"] == c0["fallbacks"]
+        assert capture_counters["captures"] == c0["captures"] + 1
+        assert capture_counters["replays"] >= c0["replays"] + 3
+
+
+# -------------------------------------------------- GradScaler under capture
+
+class TestGradScalerCapture:
+    def _run(self, captured, batches, dtype=jnp.float32):
+        paddle.set_flags({"FLAGS_step_capture": captured})
+        scaler = paddle.amp.GradScaler(init_loss_scaling=16.0,
+                                       incr_every_n_steps=3,
+                                       decr_every_n_nan_or_inf=1)
+        net, opt, step = _mlp_job("sgd", dtype=dtype, scaler=scaler)
+        fn = paddle.jit_step(step) if captured else step
+        for b in batches:
+            fn(Tensor(jnp.asarray(b).astype(dtype)))
+            opt.consume_anomaly()   # per-step host-count reconcile
+        return (np.asarray(net[0].weight._data),
+                net[0].weight._data.dtype,
+                scaler.state_dict(), opt._step_count)
+
+    def test_amp_step_captures_with_zero_fallbacks(self):
+        """The tentpole's AMP claim: GradScaler's dynamic state is
+        traced donated state now, so the captured AMP step never falls
+        back to eager on the host bool(found) branch."""
+        c0 = dict(capture_counters)
+        self._run(True, _batches(6, poison=(3,), kind="inf"))
+        assert capture_counters["fallbacks"] == c0["fallbacks"]
+        assert capture_counters["captures"] == c0["captures"] + 1
+
+    def test_captured_equals_eager_with_scale_dynamics(self):
+        batches = _batches(8, poison=(4,), kind="inf")
+        we, de, sde, ce = self._run(False, batches)
+        wc, dc, sdc, cc = self._run(True, batches)
+        np.testing.assert_array_equal(we, wc)
+        assert de == dc
+        assert sde == sdc        # scale/good/bad transitions identical
+        assert ce == cc
+        # the poison step really moved the scale (decr_every=1), and the
+        # three good steps after it really grew it back
+        assert sde["scale"] != 16.0
+
+    def test_bf16_multi_precision_equivalence(self):
+        batches = _batches(8, poison=(5,), kind="nan")
+        we, de, sde, _ = self._run(False, batches, dtype=jnp.bfloat16)
+        wc, dc, sdc, _ = self._run(True, batches, dtype=jnp.bfloat16)
+        assert de == dc == jnp.bfloat16
+        # bf16 master path: eager rounds at op boundaries, capture fuses
+        np.testing.assert_allclose(
+            np.asarray(we, np.float32), np.asarray(wc, np.float32),
+            rtol=2e-2, atol=1e-3)
+        assert sde == sdc
+
+    def test_disabled_scaler_is_passthrough(self):
+        paddle.set_flags({"FLAGS_step_capture": False})
+        scaler = paddle.amp.GradScaler(enable=False)
+        net, opt, step = _mlp_job("sgd", scaler=scaler)
+        step(Tensor(jnp.asarray(_batches(1)[0])))
+        assert scaler.get_loss_scaling() == 1.0
+        assert scaler.state_dict() == {"scale": 1.0, "good": 0, "bad": 0}
+        assert opt._step_count == 1
+
+
+# ------------------------------------------------------------ detector unit
+
+class TestAnomalyDetector:
+    def test_nonfinite_streak_escalates(self):
+        det = AnomalyDetector(nonfinite_streak=3, warmup_steps=0)
+        n0 = _counter("anomaly.nonfinite_steps")
+        assert det.observe(0, 1.0) == AnomalyAction.OK
+        assert det.observe(1, None, skipped=True) == AnomalyAction.SKIP
+        assert det.observe(2, float("nan")) == AnomalyAction.SKIP
+        assert det.first_bad_step == 1
+        assert det.observe(3, None, skipped=True) == AnomalyAction.REWIND
+        assert _counter("anomaly.nonfinite_steps") == n0 + 3
+        assert "anomaly.nonfinite" in _flight_ops()
+
+    def test_clean_step_resets_streak(self):
+        det = AnomalyDetector(nonfinite_streak=2)
+        det.observe(0, None, skipped=True)
+        assert det.observe(1, 1.0) == AnomalyAction.OK
+        assert det.first_bad_step is None
+        assert det.observe(2, None, skipped=True) == AnomalyAction.SKIP
+
+    def test_loss_spike_zscore(self):
+        det = AnomalyDetector(spike_zscore=6.0, spike_streak=2,
+                              warmup_steps=10)
+        s0 = _counter("anomaly.loss_spikes")
+        rng = np.random.RandomState(0)
+        for i in range(30):
+            assert det.observe(i, 1.0 + 0.01 * rng.randn()) \
+                == AnomalyAction.OK
+        assert det.observe(30, 50.0) == AnomalyAction.SKIP
+        assert det.observe(31, 50.0) == AnomalyAction.REWIND
+        assert _counter("anomaly.loss_spikes") == s0 + 2
+        # spikes never polluted the baseline: a normal loss is clean
+        det.reset()
+        assert det.observe(32, 1.0) == AnomalyAction.OK
+
+    def test_alternating_bad_kinds_still_escalate(self):
+        """An oscillating diverged run (inf, spike, inf, spike, ...)
+        resets the per-kind streaks against each other — the combined
+        consecutive-bad-step run must escalate anyway, or the run
+        trains on rot forever with every periodic snapshot suppressed."""
+        det = AnomalyDetector(nonfinite_streak=3, spike_zscore=6.0,
+                              spike_streak=3, warmup_steps=5)
+        rng = np.random.RandomState(0)
+        for i in range(20):
+            assert det.observe(i, 1.0 + 0.01 * rng.randn()) \
+                == AnomalyAction.OK
+        assert det.observe(20, None, skipped=True) == AnomalyAction.SKIP
+        assert det.observe(21, 500.0) == AnomalyAction.SKIP   # spike
+        act = det.observe(22, None, skipped=True)             # 3rd bad
+        assert act == AnomalyAction.REWIND
+        assert det.first_bad_step == 20
+
+    def test_warmup_suppresses_spikes(self):
+        det = AnomalyDetector(spike_zscore=3.0, warmup_steps=50)
+        for i in range(10):
+            det.observe(i, 1.0)
+        assert det.observe(10, 1000.0) == AnomalyAction.OK
+
+    def test_metric_names_frozen(self):
+        for name in ("anomaly.nonfinite_steps", "anomaly.skipped_updates",
+                     "anomaly.loss_spikes", "anomaly.rewinds",
+                     "anomaly.rewind_seconds"):
+            assert name in METRIC_NAMES, name
+            assert registry().get(name) is not None, name
+
+
+# ------------------------------------------------- skip/rewind chaos (fast)
+
+class _ArrayDS(paddle.io.Dataset):
+    def __init__(self, arrays):
+        self.arrays = arrays
+
+    def __getitem__(self, i):
+        return self.arrays[i]
+
+    def __len__(self):
+        return len(self.arrays)
+
+
+def _stream_job(batches, lr=1e-2):
+    """Model + loader over pre-built per-step batches (one dataset
+    sample = one full step batch; the loader's batch dim is squeezed by
+    ``unwrap``). ``step_on(x)`` is the capturable train step — the step
+    index stays OUT of its arguments so the capture replays ONE
+    executable for the whole stream."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 4))
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=net.parameters())
+    loader = paddle.io.DataLoader(
+        _ArrayDS([np.asarray(b, np.float32) for b in batches]),
+        batch_size=1, shuffle=False)
+
+    def step_on(x):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def unwrap(batch):
+        return Tensor(batch._data[0])
+
+    def state_fn():
+        return {"model": net.state_dict(), "opt": opt.state_dict()}
+
+    def apply_fn(rebuilt, resume):
+        opt.set_state_dict(rebuilt["opt"])
+
+    return net, opt, loader, step_on, unwrap, state_fn, apply_fn
+
+
+def _reference_params(batches, skip_steps, n_steps):
+    """Uninterrupted clean run that drops the poison window's updates —
+    the trajectory both recovery policies must reproduce."""
+    paddle.set_flags({"FLAGS_anomaly_sentinel": False,
+                      "FLAGS_step_capture": False})
+    net, opt, loader, step_on, unwrap, _, _ = _stream_job(batches)
+    it = iter(loader)
+    losses = {}
+    for s in range(n_steps):
+        b = next(it)
+        if s in skip_steps:
+            continue
+        losses[s] = float(np.asarray(step_on(unwrap(b))._data))
+    return np.asarray(net[0].weight._data), losses
+
+
+class TestChaosSkipAndRewind:
+    def test_skip_policy_matches_clean_reference(self):
+        """Poison ONE batch: the in-device sentinel skips that update and
+        the run ends bitwise-identical to a clean run that dropped the
+        same batch — no rewind, no restore."""
+        n, poison = 12, {5}
+        clean = _batches(n, dim=4, batch=2)
+        poisoned = [b.copy() for b in clean]
+        for p in poison:
+            poisoned[p][:] = np.nan
+        ref_w, _ = _reference_params(clean, poison, n)
+
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": True})
+        net, opt, loader, step_on, unwrap, state_fn, apply_fn = \
+            _stream_job(poisoned)
+        det = AnomalyDetector(nonfinite_streak=100)   # never escalates
+        cap = paddle.jit_step(step_on)
+        r0 = _counter("anomaly.rewinds")
+        it = iter(loader)
+        for s in range(n):
+            out = cap(unwrap(next(it)))
+            skipped, gnorm = opt.consume_anomaly()
+            act = det.observe(s, float(np.asarray(out._data)),
+                              skipped=skipped, grad_norm=gnorm)
+            assert act != AnomalyAction.REWIND
+        np.testing.assert_array_equal(ref_w,
+                                      np.asarray(net[0].weight._data))
+        assert _counter("anomaly.rewinds") == r0
+
+    def test_rewind_policy_matches_clean_reference(self, tmp_path):
+        """The acceptance chaos run: a 2-step poison window trips the
+        non-finite streak, the trainer rewinds to the newest committed
+        generation, replays the stream deterministically, skips the
+        poison window, and the surviving loss curve + final params match
+        the uninterrupted clean reference."""
+        n, window = 14, {6, 7}
+        clean = _batches(n, dim=4, batch=2)
+        poisoned = [b.copy() for b in clean]
+        for p in window:
+            poisoned[p][:] = np.inf
+        ref_w, ref_losses = _reference_params(clean, window, n)
+
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": True})
+        net, opt, loader, step_on, unwrap, state_fn, apply_fn = \
+            _stream_job(poisoned)
+        det = AnomalyDetector(nonfinite_streak=2)   # default warmup
+        ck = AsyncCheckpointer(str(tmp_path))
+        tr = ResilientTrainer(ck, state_fn, apply_fn, snapshot_every=4,
+                              install_signal=False, anomaly=det,
+                              optimizer=opt, data_loader=loader)
+        cap = paddle.jit_step(step_on)
+        losses = {}
+
+        def recorded(step, batch):
+            out = cap(unwrap(batch))
+            losses[step] = float(np.asarray(out._data))
+            return out
+
+        r0 = _counter("anomaly.rewinds")
+        assert tr.run_data(recorded, n) == TrainerAction.COMPLETED
+        assert _counter("anomaly.rewinds") == r0 + 1
+        assert "anomaly.rewind" in _flight_ops()
+        assert tr._skip_window == (6, 7)
+        np.testing.assert_array_equal(ref_w,
+                                      np.asarray(net[0].weight._data))
+        for s, want in ref_losses.items():
+            np.testing.assert_allclose(losses[s], want, rtol=1e-6,
+                                       err_msg=f"step {s}")
+
+    def test_periodic_snapshot_suppressed_mid_streak(self, tmp_path):
+        """A generation committed DURING a bad streak could hold
+        already-poisoned params (loss spikes do not skip the update) —
+        the very state a rewind would then restore. poll() must skip
+        the periodic save until the streak resolves."""
+        batches = _batches(10, dim=4, batch=2)
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": False})
+        net, opt, loader, step_on, unwrap, state_fn, apply_fn = \
+            _stream_job(batches)
+        det = AnomalyDetector(nonfinite_streak=100)
+        ck = AsyncCheckpointer(str(tmp_path))
+        tr = ResilientTrainer(ck, state_fn, apply_fn, snapshot_every=4,
+                              install_signal=False, anomaly=det,
+                              optimizer=opt)
+        det.observe(3, None, skipped=True)   # streak open at step 3
+        assert det.first_bad_step == 3
+        assert tr.poll(4) == TrainerAction.CONTINUE   # snapshot step
+        ck.wait()
+        assert latest_checkpoint(str(tmp_path)) is None
+        assert "anomaly.snapshot_suppressed" in _flight_ops()
+        det.observe(5, 1.0)                  # streak resolves
+        assert tr.poll(8) == TrainerAction.CONTINUE
+        ck.wait()
+        assert latest_checkpoint(str(tmp_path)) is not None
+
+    def test_rewind_without_checkpoint_continues(self, tmp_path):
+        """No committed generation yet: rewind is unavailable, the
+        sentinel's in-device skips keep the run alive, training
+        continues (and the detector resets so it can escalate again)."""
+        n = 8
+        poisoned = _batches(n, poison=(2, 3), dim=4, batch=2)
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": False})
+        net, opt, loader, step_on, unwrap, state_fn, apply_fn = \
+            _stream_job(poisoned)
+        det = AnomalyDetector(nonfinite_streak=2)
+        ck = AsyncCheckpointer(str(tmp_path / "empty"))
+        tr = ResilientTrainer(ck, state_fn, apply_fn, snapshot_every=0,
+                              install_signal=False, anomaly=det,
+                              optimizer=opt, data_loader=loader)
+        assert tr.run_data(lambda s, b: step_on(unwrap(b)), n,
+                           final_snapshot=False) == TrainerAction.COMPLETED
+        assert "anomaly.rewind_unavailable" in _flight_ops()
+        assert np.all(np.isfinite(np.asarray(net[0].weight._data)))
+
+    def test_preemption_resume_replays_exact_stream(self, tmp_path):
+        """The resumable stream closes the loop for PLAIN preemption
+        too: kill after step k, relaunch with a fresh loader — the
+        relaunch consumes exactly the batches the dead process never
+        trained on."""
+        n = 10
+        batches = _batches(n, dim=4, batch=2)
+        paddle.set_flags({"FLAGS_anomaly_sentinel": False,
+                          "FLAGS_step_capture": False})
+
+        ref_w, ref_losses = _reference_params(batches, set(), n)
+
+        net1, opt1, loader1, step1, unwrap1, state1, apply1 = \
+            _stream_job(batches)
+        ck1 = AsyncCheckpointer(str(tmp_path))
+        tr1 = ResilientTrainer(ck1, state1, apply1, snapshot_every=0,
+                               install_signal=False, data_loader=loader1)
+        assert tr1.run_data(lambda s, b: step1(unwrap1(b)),
+                            6) == TrainerAction.COMPLETED
+
+        net2, opt2, loader2, step2, unwrap2, state2, apply2 = \
+            _stream_job(batches)
+        ck2 = AsyncCheckpointer(str(tmp_path))
+        tr2 = ResilientTrainer(ck2, state2, apply2, snapshot_every=0,
+                               install_signal=False, data_loader=loader2)
+        losses2 = {}
+
+        def recorded(step, batch):
+            out = step2(unwrap2(batch))
+            losses2[step] = float(np.asarray(out._data))
+            return out
+
+        assert tr2.run_data(recorded, n) == TrainerAction.COMPLETED
+        assert sorted(losses2) == [6, 7, 8, 9]
+        np.testing.assert_array_equal(ref_w,
+                                      np.asarray(net2[0].weight._data))
+        for s in (6, 7, 8, 9):
+            np.testing.assert_allclose(losses2[s], ref_losses[s],
+                                       rtol=1e-6)
+
+
+# --------------------------------------------- resumable DataLoader (fast)
+
+class _IdxDataset(paddle.io.Dataset):
+    """Module-level so forkserver workers can unpickle it."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.asarray([i], np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+def _consume(loader, k=None):
+    out = []
+    it = iter(loader)
+    while k is None or len(out) < k:
+        try:
+            b = next(it)
+        except StopIteration:
+            break
+        out.append(b.numpy().ravel().tolist())
+    if k is not None:
+        it.close()
+    return out
+
+
+class TestDataLoaderState:
+    def test_midepoch_roundtrip_shuffle_byte_identical(self):
+        np.random.seed(11)
+        ref_loader = paddle.io.DataLoader(_IdxDataset(23), batch_size=4,
+                                          shuffle=True)
+        epoch0 = _consume(ref_loader)
+        epoch1 = _consume(ref_loader)
+        assert epoch0 != epoch1        # reshuffled per epoch
+
+        np.random.seed(11)
+        src = paddle.io.DataLoader(_IdxDataset(23), batch_size=4,
+                                   shuffle=True)
+        head = _consume(src, 3)
+        sd = src.state_dict()
+        assert sd["batch"] == 3 and sd["epoch"] == 0
+
+        np.random.seed(999)            # global RNG must not matter
+        dst = paddle.io.DataLoader(_IdxDataset(23), batch_size=4,
+                                   shuffle=True)
+        dst.load_state_dict(sd)
+        tail = _consume(dst)
+        assert head + tail == epoch0   # byte-identical resume
+        assert _consume(dst) == epoch1  # epoch sequence continues
+
+    def test_resume_with_workers_byte_identical(self):
+        np.random.seed(11)
+        ref = _consume(paddle.io.DataLoader(_IdxDataset(23), batch_size=4,
+                                            shuffle=True))
+        np.random.seed(11)
+        src = paddle.io.DataLoader(_IdxDataset(23), batch_size=4,
+                                   shuffle=True, num_workers=2)
+        head = _consume(src, 2)
+        sd = src.state_dict()
+        dst = paddle.io.DataLoader(_IdxDataset(23), batch_size=4,
+                                   shuffle=True, num_workers=2)
+        dst.load_state_dict(sd)
+        assert head + _consume(dst) == ref
+
+    def test_dataset_length_mismatch_refused(self):
+        src = paddle.io.DataLoader(_IdxDataset(10), batch_size=2)
+        _consume(src, 1)
+        sd = src.state_dict()
+        dst = paddle.io.DataLoader(_IdxDataset(11), batch_size=2)
+        with pytest.raises(ValueError, match="dataset length changed"):
+            dst.load_state_dict(sd)
+
+    def test_sampler_arrangement_mismatch_refused(self):
+        """Cursor/seed from an owned-sampler loader must not skip into a
+        custom batch_sampler's (different) index stream silently."""
+        src = paddle.io.DataLoader(_IdxDataset(10), batch_size=2,
+                                   shuffle=True)
+        _consume(src, 1)
+        sd = src.state_dict()
+        custom = paddle.io.BatchSampler(_IdxDataset(10), shuffle=False,
+                                        batch_size=2)
+        dst = paddle.io.DataLoader(_IdxDataset(10), batch_sampler=custom)
+        with pytest.raises(ValueError, match="sampler arrangement"):
+            dst.load_state_dict(sd)
+
+    def test_iterable_dataset_refused(self):
+        class Stream(paddle.io.IterableDataset):
+            def __iter__(self):
+                return iter([np.zeros(1)])
+
+        loader = paddle.io.DataLoader(Stream(), batch_size=1)
+        with pytest.raises(TypeError, match="not resumable"):
+            loader.state_dict()
+        with pytest.raises(TypeError, match="not resumable"):
+            loader.load_state_dict({"epoch": 0, "batch": 0, "seed": 0,
+                                    "dataset_len": 1,
+                                    "owns_sampler": True})
+
+    def test_state_journaled_into_host_state(self, tmp_path):
+        """The stream position rides the checkpoint's host_state.json —
+        restore repositions the loader with no extra artifact."""
+        import json
+        batches = _batches(6, dim=4, batch=2)
+        net, opt, loader, step_on, unwrap, state_fn, apply_fn = \
+            _stream_job(batches)
+        ck = AsyncCheckpointer(str(tmp_path))
+        tr = ResilientTrainer(ck, state_fn, apply_fn, snapshot_every=0,
+                              install_signal=False, data_loader=loader)
+        assert tr.run_data(lambda s, b: step_on(unwrap(b)),
+                           4) == TrainerAction.COMPLETED
+        gen = latest_checkpoint(str(tmp_path))
+        host = json.load(open(os.path.join(gen, "host_state.json")))
+        assert host["data_stream.batch"] == 4
+        assert host["data_stream.dataset_len"] == 6
+
+
+pytestmark = pytest.mark.smoke

@@ -252,6 +252,34 @@ class TestCheckpointOverlapMicro:
         assert got["FLAGS_step_capture"] is True
 
 
+class TestAnomalyOverheadMicro:
+    def test_micro_runs_and_meets_gate(self):
+        """bench.py anomaly_overhead smoke (ISSUE 10 acceptance): the
+        in-capture anomaly sentinel (fused finiteness/global-norm sweep
+        + select-guarded update inside the donated executable) must add
+        <3% to the captured step, with a well-formed artifact entry.
+        One retry absorbs a busy host."""
+        r = bench.bench_anomaly_overhead(False)
+        if r["value"] >= 3.0:       # timing gate: wall clock on a
+            r = bench.bench_anomaly_overhead(False)   # shared CI host
+        assert r["metric"] == "anomaly_sentinel_overhead_pct"
+        assert r["unit"] == "pct_added_step_time"
+        d = r["detail"]
+        assert d["captured_step_us_sentinel_off"] > 0.0
+        assert d["captured_step_us_sentinel_on"] > 0.0
+        # both variants really ran captured (no eager fallback storm)
+        assert d["counters"]["fallbacks"] == 0 or \
+            d["counters"]["replays"] > d["counters"]["fallbacks"]
+        # the acceptance gate itself
+        assert r["value"] < 3.0, r
+        # the flags the micro toggles must be restored afterwards
+        import paddle_tpu as paddle
+        got = paddle.get_flags(["FLAGS_step_capture",
+                                "FLAGS_anomaly_sentinel"])
+        assert got["FLAGS_step_capture"] is True
+        assert got["FLAGS_anomaly_sentinel"] is False
+
+
 class TestObservabilityMicro:
     def test_micro_runs_and_reports(self):
         """bench.py observability_overhead smoke: the micro must run on

@@ -205,6 +205,7 @@ class TestRequestJournal:
         for name in ("serving.queue_wait_seconds", "serving.rejected",
                      "serving.resilience.journal_records",
                      "serving.resilience.journal_flushes",
+                     "serving.resilience.journal_compactions",
                      "serving.resilience.replayed_requests",
                      "serving.resilience.replayed_tokens",
                      "serving.resilience.recovered_finished",
@@ -217,7 +218,120 @@ class TestRequestJournal:
             assert registry().get(name) is not None, name
 
 
-# ----------------------------------------------- bounded admission (fast)
+# ------------------------------------------------ journal compaction (fast)
+
+class TestJournalCompaction:
+    def _fill(self, root, n=6, finish_below=4):
+        j = RequestJournal(root)
+        j.append({"t": "config", "seed": 1, "sampling": {}, "eos": None})
+        for rid in range(n):
+            j.append({"t": "admit", "rid": rid, "prompt": [1, 2 + rid],
+                      "max_new_tokens": 4})
+            j.flush()
+            j.append({"t": "tokens", "rid": rid, "from": 0, "toks": [5, 6]})
+            if rid < finish_below:
+                j.append({"t": "finish", "rid": rid})
+            j.flush()
+        return j
+
+    def test_compact_drops_only_retired_finished(self, tmp_path):
+        j = self._fill(str(tmp_path))
+        # rid 5 is unfinished and listed retired by mistake: never dropped
+        dropped = j.compact(drop_rids={0, 1, 5})
+        assert dropped == 2
+        st = RequestJournal(str(tmp_path)).load()
+        assert set(st.requests) == {2, 3, 4, 5}
+        assert st.config["seed"] == 1                   # config survives
+        assert st.requests[2].finished                  # unretired kept
+        assert st.requests[2].tokens == [5, 6]
+        assert not st.requests[5].finished
+        names = os.listdir(str(tmp_path))
+        assert sum(n.startswith("snap-") for n in names) == 1
+        assert not any(n.startswith("seg-") for n in names)
+
+    def test_appends_after_compaction_continue_the_stream(self, tmp_path):
+        j = self._fill(str(tmp_path))
+        j.compact(drop_rids={0})
+        j.append({"t": "tokens", "rid": 4, "from": 2, "toks": [9]})
+        j.flush()
+        st = RequestJournal(str(tmp_path)).load()
+        assert st.requests[4].tokens == [5, 6, 9]
+
+    def test_recompaction_at_same_coverage_retires_old_snapshot(
+            self, tmp_path):
+        """Two compactions with no segment flushed in between share a
+        coverage number; the second must REPLACE the first (equal
+        coverage included in the unlink), or load()'s tie-break would
+        pick between them by uid and could resurrect requests the later
+        pass dropped."""
+        j = self._fill(str(tmp_path))
+        j.compact(drop_rids={0})
+        j.compact(drop_rids={1})       # no new segments in between
+        snaps = [n for n in os.listdir(str(tmp_path))
+                 if n.startswith("snap-")]
+        assert len(snaps) == 1, snaps
+        st = RequestJournal(str(tmp_path)).load()
+        assert 0 not in st.requests and 1 not in st.requests
+
+    def test_leftover_old_segment_is_subsumed(self, tmp_path):
+        """Crash mid-unlink: segments at or below the snapshot's
+        coverage load as if deleted — the snapshot wins, and a retired
+        request can never resurrect through a stale segment."""
+        j = self._fill(str(tmp_path))
+        seg0 = [n for n in os.listdir(str(tmp_path))
+                if n.startswith("seg-")][0]
+        body = open(tmp_path / seg0, encoding="utf-8").read()
+        j.compact(drop_rids={0, 1, 2, 3})
+        (tmp_path / seg0).write_text(body)   # "unlink never happened"
+        st = RequestJournal(str(tmp_path)).load()
+        assert set(st.requests) == {4, 5}
+
+    def test_repeated_compaction_bounds_disk(self, tmp_path):
+        """The satellite's disk-growth bound: a long retire-heavy stream
+        compacted on the snapshot cadence keeps the journal directory at
+        one snapshot + the tail segments, regardless of how many
+        requests have retired."""
+        j = RequestJournal(str(tmp_path))
+        j.append({"t": "config", "seed": 1, "sampling": {}, "eos": None})
+        sizes, counts = [], []
+        rid = 0
+        for round_ in range(6):
+            for _ in range(20):
+                j.append({"t": "admit", "rid": rid, "prompt": [1, 2],
+                          "max_new_tokens": 4})
+                j.flush()
+                j.append({"t": "tokens", "rid": rid, "from": 0,
+                          "toks": [3, 4, 5]})
+                j.append({"t": "finish", "rid": rid})
+                j.flush()
+                rid += 1
+            j.compact(drop_rids=set(range(rid)))   # everything delivered
+            names = os.listdir(str(tmp_path))
+            counts.append(len(names))
+            sizes.append(sum(os.path.getsize(tmp_path / n) for n in names))
+        assert all(c == 1 for c in counts), counts    # one snapshot file
+        assert max(sizes) <= 2 * min(sizes), sizes    # no growth trend
+        st = RequestJournal(str(tmp_path)).load()
+        assert st.requests == {} and st.config["seed"] == 1
+
+    def test_engine_snapshot_compacts_retired(self, model, tmp_path):
+        """pop_output marks delivery; the next snapshot drops those
+        requests from the WAL, and a relaunch neither recovers them nor
+        replays them."""
+        eng = ResilientServingEngine(model, str(tmp_path / "c"), **ENG)
+        prompts = _requests(3)
+        rids = [eng.add_request(p, max_new_tokens=3) for p in prompts]
+        assert eng.run() == ServingAction.COMPLETED
+        assert eng.pop_output(rids[0]) is not None
+        assert eng.pop_output(rids[1]) is not None
+        c0 = _counter("serving.resilience.journal_compactions")
+        eng.snapshot()
+        assert _counter("serving.resilience.journal_compactions") == c0 + 1
+        eng.close()
+        e2 = ResilientServingEngine(model, str(tmp_path / "c"), **ENG)
+        assert set(e2.outputs) == {rids[2]}       # undelivered one only
+        assert e2.replayed_requests == 0
+        e2.close()
 
 class TestBoundedQueue:
     def test_queue_full_rejects_explicitly(self, model):
