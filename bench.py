@@ -1316,6 +1316,191 @@ def bench_serving_recovery(on_tpu: bool, quick: bool = False):
     }
 
 
+def bench_serving_fleet(on_tpu: bool, quick: bool = False):
+    """ISSUE 12 acceptance micro: the multi-replica fleet end to end.
+
+    One two-replica ThreadReplicaHandle fleet (shared weights, shared
+    engine seed — token streams are a pure function of the global id)
+    driven open-loop through three phases:
+
+    * base rate: Poisson arrivals under capacity → goodput-under-SLO
+      (the headline: fraction of OFFERED requests completed with TTFT
+      inside the SLO — sheds and drops count against it);
+    * 2x overload burst: tiny per-replica admission queues + a short
+      submit deadline → the router must SHED (FleetShed with a
+      retry-after hint) instead of queueing, keeping admitted TTFT p99
+      bounded;
+    * rolling drain under open requests: drain + restart each replica
+      in turn (same root — its own journal replays the preempted work)
+      with zero dropped requests.
+
+    Every delivered stream is then replayed on a single plain
+    ContinuousBatchingEngine under the same gids: ``byte_identical``
+    proves routing/failover/drain never changed a single token.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving.fleet import (FleetShed, ReplicaRouter,
+                                          ThreadReplicaHandle)
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=4, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            dtype="bfloat16")
+        max_batch, bs, max_new, b_new = 4, 64, 16, 64
+        n_a, n_b, n_c = 16, 24, 8
+        gap_a, gap_b = 0.05, 0.002
+        paddle.set_default_dtype("bfloat16")
+    else:
+        cfg = LlamaConfig.tiny()
+        max_batch, bs = 2, 16
+        # overload outputs are LONGER: the burst must outrun service
+        # (arrivals in ~n_b*gap_b vs ~b_new steps of work per row) or
+        # nothing sheds and phase B proves nothing
+        max_new, b_new = (8, 32) if quick else (16, 48)
+        n_a, n_b, n_c = (6, 12, 4) if quick else (12, 24, 8)
+        gap_a, gap_b = 0.06, 0.002
+    slo_ttft_s = 2.0
+
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+    finally:
+        if on_tpu:
+            paddle.set_default_dtype("float32")
+
+    rng = np.random.RandomState(7)
+    # a few prompt FAMILIES sharing a first block: the affinity digest
+    # keys on it, so same-family requests should land together
+    heads = [rng.randint(0, cfg.vocab_size, bs).tolist()
+             for _ in range(3)]
+
+    def mk_prompt(i):
+        return (heads[i % len(heads)]
+                + rng.randint(0, cfg.vocab_size, 4 + i % 9).tolist())
+
+    nb = max_batch * (-(-(bs + 12 + max(max_new, b_new)) // bs) + 1) + 16
+    eng_kw = dict(max_batch=max_batch, num_blocks=nb, block_size=bs,
+                  temperature=0.8, seed=11)
+
+    work = tempfile.mkdtemp(prefix="ptpu_fleet_")
+    try:
+        replicas = [
+            ThreadReplicaHandle(
+                f"rep{i}", lambda: model, os.path.join(work, f"rep{i}"),
+                max_queue=2, journal_flush_every=1, **eng_kw)
+            for i in range(2)]
+        router = ReplicaRouter(replicas, block_size=bs,
+                               submit_deadline_s=0.25, seed=3)
+        router.start()
+        router.wait_ready(timeout_s=600.0)
+
+        def arrive(n, base, mean_gap, deadline_s, n_tok=max_new):
+            admitted, sheds, hints = [], 0, []
+            for i in range(n):
+                time.sleep(float(rng.exponential(mean_gap)))
+                try:
+                    admitted.append(router.submit(
+                        mk_prompt(base + i), max_new_tokens=n_tok,
+                        deadline_s=deadline_s))
+                except FleetShed as e:
+                    sheds += 1
+                    if e.retry_after_s is not None:
+                        hints.append(e.retry_after_s)
+            return admitted, sheds, hints
+
+        def ttfts_ms(gids):
+            out = [router.finished_meta[g].ttft_s * 1e3 for g in gids
+                   if g in router.finished_meta
+                   and router.finished_meta[g].ttft_s is not None]
+            return np.asarray(sorted(out))
+
+        # phase A: Poisson base rate, generous deadline — goodput
+        a_gids, a_sheds, _ = arrive(n_a, 0, gap_a, 1.0)
+        router.drain_all(timeout_s=600.0)
+        a_ttft = ttfts_ms(a_gids)
+        good = sum(1 for g in a_gids
+                   if g in router.outputs
+                   and router.finished_meta[g].ttft_s is not None
+                   and router.finished_meta[g].ttft_s <= slo_ttft_s)
+        goodput = good / n_a
+
+        # phase B: 2x-overload burst, short deadline — must shed, and
+        # the ADMITTED requests' TTFT tail must stay bounded
+        b_gids, b_sheds, b_hints = arrive(n_b, 100, gap_b, 0.02,
+                                          n_tok=b_new)
+        router.drain_all(timeout_s=600.0)
+        b_ttft = ttfts_ms(b_gids)
+
+        # phase C: rolling deploy with requests in flight — zero drops
+        c_gids, c_sheds, _ = arrive(n_c, 200, gap_a, 1.0)
+        t0 = time.perf_counter()
+        router.rolling_drain(ready_timeout_s=600.0)
+        roll_s = time.perf_counter() - t0
+        router.drain_all(timeout_s=600.0)
+
+        delivered = dict(router.outputs)   # nothing was popped
+        dropped = router.dropped_requests
+
+        # byte-identity: one plain engine, same gids, same seed
+        ref = ContinuousBatchingEngine(model, **eng_kw)
+        for g in sorted(delivered):
+            p, n = router.requests[g]
+            ref.add_request(p, max_new_tokens=n, rid=g)
+        ref.run()
+        byte_identical = all(
+            list(ref.results[g].out_tokens) == list(delivered[g])
+            for g in delivered)
+        router.close()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    pct = (lambda a, q: round(float(np.percentile(a, q)), 2)
+           if len(a) else None)
+    return {
+        "metric": "serving_fleet_goodput",
+        "value": round(goodput, 4),
+        "unit": "fraction of offered base-rate requests in TTFT SLO",
+        "vs_baseline": round(goodput, 4),
+        "detail": {
+            "replicas": 2, "max_batch": max_batch, "max_queue": 2,
+            "block_size": bs, "num_blocks": nb,
+            "max_new_tokens": max_new,
+            "overload_max_new_tokens": b_new,
+            "slo_ttft_s": slo_ttft_s,
+            "base_offered": n_a, "base_delivered": len(a_gids),
+            "base_sheds": a_sheds,
+            "base_ttft_p50_ms": pct(a_ttft, 50),
+            "base_ttft_p99_ms": pct(a_ttft, 99),
+            "overload_offered": n_b, "overload_admitted": len(b_gids),
+            "overload_sheds": b_sheds,
+            "overload_retry_after_ms": (
+                round(float(np.mean(b_hints)) * 1e3, 2)
+                if b_hints else None),
+            "overload_ttft_p99_ms": pct(b_ttft, 99),
+            "rolling_requests": len(c_gids), "rolling_sheds": c_sheds,
+            "rolling_drain_s": round(roll_s, 3),
+            "dropped_requests": dropped,
+            "rerouted_requests": router.rerouted_requests,
+            "submit_retries": router.retries,
+            "byte_identical": byte_identical,
+            "baseline": "every delivered stream replayed on one plain "
+                        "engine under the same gids must match byte-"
+                        "for-byte"
+                        + ("" if on_tpu else
+                           " (CPU proxy: Pallas runs interpreted)"),
+        },
+    }
+
+
 # --------------------------------------------------------------------------
 # deviceless v5p-64 AOT: the BASELINE north-star job compiled for 64 chips
 # --------------------------------------------------------------------------
@@ -2180,7 +2365,8 @@ def main():
     which = os.environ.get(
         "PTPU_BENCH_CONFIGS",
         "llama,llamapeak,llama4k,llamalong,resnet,bert,ocr,moe,serving,"
-        "cbatch,serving_ragged,serving_recovery,aot,tp_attention,micro,"
+        "cbatch,serving_ragged,serving_recovery,serving_fleet,aot,"
+        "tp_attention,micro,"
         "dispatch,observability,step_capture,checkpoint_overlap,"
         "anomaly_overhead")
     which = [w.strip() for w in which.split(",") if w.strip()]
@@ -2267,6 +2453,7 @@ def main():
                      ("serving", bench_serving), ("cbatch", bench_cbatch),
                      ("serving_ragged", bench_serving_ragged),
                      ("serving_recovery", bench_serving_recovery),
+                     ("serving_fleet", bench_serving_fleet),
                      ("aot", bench_aot),
                      ("tp_attention", bench_tp_attention)):
         r = guard(name, fn, on_tpu)

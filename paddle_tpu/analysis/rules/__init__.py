@@ -12,4 +12,5 @@ from . import donation        # noqa: F401
 from . import durability      # noqa: F401
 from . import hygiene         # noqa: F401
 from . import taxonomy        # noqa: F401
+from . import timeouts        # noqa: F401
 from . import trace_purity    # noqa: F401

@@ -1,0 +1,96 @@
+"""timeouts: fleet code must bound every blocking cross-thread/process
+wait.
+
+The router exists to survive dead replicas — but only if it never
+blocks forever ON one. A bare ``queue.get()``, ``thread.join()``,
+``event.wait()``, ``lock.acquire()``, ``future.result()``, or
+``proc.communicate()`` under ``serving/fleet/`` turns a SIGKILL'd
+replica into a wedged ROUTER: the failure domain this package was
+built to contain swallows the containment layer. Every such call must
+carry an explicit timeout so the health machine gets its turn.
+
+Mechanics — tuned to the call shapes that actually block:
+
+* ``.get()`` / ``.join()`` / ``.wait()`` / ``.acquire()`` /
+  ``.result()`` / ``.communicate()`` with ZERO positional arguments and
+  no ``timeout=`` keyword are flagged. A positional argument exempts
+  the call: ``d.get(key)``, ``",".join(xs)``, ``t.join(2.0)`` are not
+  blocking-forever shapes (dict lookups and string joins are the
+  classic false positives this guard exists for).
+* ``.wait_for(...)`` (condition predicates) must pass ``timeout=``
+  regardless of positionals — its first positional is the predicate,
+  so the zero-positional exemption does not apply.
+
+Code outside ``serving/fleet/`` is untouched: single-process serving
+may legitimately block on itself, and the engines' own waits are
+deadline-managed by their drain/watchdog machinery.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, Rule, SourceFile, attr_chain, register
+
+_CONFINED_PATH = "serving/fleet/"
+
+# terminal attribute names whose zero-positional call shape blocks
+# until the other side acts
+_BLOCKING_TERMINALS = {
+    "get": "a bare `.get()` blocks until a producer appears",
+    "join": "a bare `.join()` waits forever on a thread/process that "
+            "may never exit",
+    "wait": "a bare `.wait()` blocks until someone signals",
+    "acquire": "a bare `.acquire()` deadlocks if the holder died",
+    "result": "a bare `.result()` blocks on a future that may never "
+              "resolve",
+    "communicate": "a bare `.communicate()` blocks until the child "
+                   "closes its pipes",
+}
+
+
+def _has_timeout_kwarg(node: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in node.keywords)
+
+
+@register
+class TimeoutsRule(Rule):
+    id = "timeouts"
+    help = ("fleet code (serving/fleet/) must pass an explicit timeout "
+            "to blocking calls (.get/.join/.wait/.acquire/.result/"
+            ".communicate/.wait_for) — a router that can block forever "
+            "on a dead replica defeats the failover it implements")
+    profiles = ("src",)
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        if _CONFINED_PATH not in sf.rel:
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain is None or "." not in chain:
+                continue           # bare names (open, print) can't be
+                                   # the method shapes this rule covers
+            term = chain.rsplit(".", 1)[-1]
+            if term == "wait_for":
+                if not _has_timeout_kwarg(node):
+                    yield self.finding(
+                        sf, node.lineno,
+                        "`.wait_for(predicate)` without `timeout=` in "
+                        "fleet code: the predicate may never hold once "
+                        "its replica dies — pass an explicit timeout")
+                continue
+            if term not in _BLOCKING_TERMINALS:
+                continue
+            if node.args or _has_timeout_kwarg(node):
+                # a positional arg means it is not the zero-arg
+                # blocking shape (dict.get(k), ",".join(xs),
+                # t.join(2.0)); a timeout kwarg is the fix itself
+                continue
+            yield self.finding(
+                sf, node.lineno,
+                f"`.{term}()` without a timeout in fleet code: "
+                f"{_BLOCKING_TERMINALS[term]} — pass `timeout=` so a "
+                f"dead replica cannot wedge the router")

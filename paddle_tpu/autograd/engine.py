@@ -24,6 +24,7 @@ import contextlib
 import functools
 import heapq
 import sys
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -34,32 +35,41 @@ from ..core.tensor import Tensor
 from ..observability import metrics as _obs_metrics
 
 # -- grad mode ----------------------------------------------------------------
+#
+# Thread-local, not process-global: serving replicas run their step loops
+# under no_grad() on background threads, and a shared flag would let the
+# save/restore pairs of concurrent contexts interleave and strand the whole
+# process with grads off. Each thread starts with grads enabled.
 
-_grad_enabled = True
+_grad_mode = threading.local()
 
 
 def is_grad_enabled() -> bool:
-    return _grad_enabled
+    return getattr(_grad_mode, "enabled", True)
+
+
+def _set_grad_enabled(mode: bool) -> None:
+    _grad_mode.enabled = bool(mode)
 
 
 @contextlib.contextmanager
 def no_grad():
-    global _grad_enabled
-    prev, _grad_enabled = _grad_enabled, False
+    prev = is_grad_enabled()
+    _grad_mode.enabled = False
     try:
         yield
     finally:
-        _grad_enabled = prev
+        _grad_mode.enabled = prev
 
 
 @contextlib.contextmanager
 def enable_grad():
-    global _grad_enabled
-    prev, _grad_enabled = _grad_enabled, True
+    prev = is_grad_enabled()
+    _grad_mode.enabled = True
     try:
         yield
     finally:
-        _grad_enabled = prev
+        _grad_mode.enabled = prev
 
 
 # -- graph nodes --------------------------------------------------------------
@@ -225,7 +235,7 @@ def _run_vjp_create_graph(node: "GradNode", ct_tensors):
             results.append(gt)
             out_tensors.append(gt)
             diff_slots.append(i)
-    if out_tensors and _grad_enabled:
+    if out_tensors and is_grad_enabled():
         vjp2 = _second_order_vjp(fn, len(primals), tuple(diff_slots))
         record_node("grad::" + node.op_name, vjp2,
                     tuple(primals) + cts,

@@ -44,6 +44,7 @@ equivalence reference for tests and `bench.py serving_ragged`.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -65,7 +66,17 @@ __all__ = ["Request", "ContinuousBatchingEngine", "GangScheduledEngine",
 class QueueFull(RuntimeError):
     """Admission queue is at ``max_queue``: the server must shed load
     explicitly (HTTP 429 / retry-after) instead of buffering without
-    bound — an unbounded `pending` deque turns overload into OOM."""
+    bound — an unbounded `pending` deque turns overload into OOM.
+
+    ``retry_after_hint`` (seconds, None when the engine has served no
+    traffic yet) is the median observed queue wait — the engine's own
+    estimate of when a slot opens, for the caller's backoff/Retry-After
+    header instead of a guessed constant."""
+
+    def __init__(self, msg: str,
+                 retry_after_hint: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_hint = retry_after_hint
 
 _M = _metrics_mod.registry()
 _M_STEPS = _M.counter(
@@ -343,6 +354,10 @@ class ContinuousBatchingEngine:
         # drain hook (serving/resilience): a paused engine keeps
         # stepping its in-flight rows but admits nothing new
         self.admission_paused = False
+        # finish signal for cross-thread pollers: step() notifies after
+        # the on_finish dispatch, so a blocking pop_result(timeout=)
+        # wakes instead of busy-spinning on an idle engine
+        self.finish_cv = threading.Condition()
 
     # -- request intake ------------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32, *,
@@ -365,7 +380,8 @@ class ContinuousBatchingEngine:
                 _M_REJECTED.inc()
                 raise QueueFull(
                     f"admission queue is full ({len(self.pending)}/"
-                    f"{self.max_queue} pending): shed load or retry later")
+                    f"{self.max_queue} pending): shed load or retry later",
+                    retry_after_hint=_M_QWAIT.quantile(0.5))
             rid = self._next_rid
         elif rid in self.results:
             raise ValueError(f"rid {rid} already journaled to this engine")
@@ -732,16 +748,34 @@ class ContinuousBatchingEngine:
             for req in finished:
                 self.results.pop(req.rid, None)
                 self.on_finish(req)
+        if finished:
+            with self.finish_cv:
+                self.finish_cv.notify_all()
         return finished
 
-    def pop_result(self, rid: int) -> Optional[Request]:
+    def pop_result(self, rid: int,
+                   timeout: Optional[float] = None) -> Optional[Request]:
         """Retire a finished request from ``results`` (long-running
         server memory: poll-style callers hand finished outputs off
-        instead of retaining every Request forever)."""
-        req = self.results.get(rid)
-        if req is None or not req.done:
-            return None
-        return self.results.pop(rid)
+        instead of retaining every Request forever). With ``timeout``,
+        block on the finish condition until the request completes or the
+        deadline lands — the stepping thread notifies after each step's
+        finishes, so waiters never busy-spin."""
+        if timeout is None:
+            req = self.results.get(rid)
+            if req is None or not req.done:
+                return None
+            return self.results.pop(rid)
+        deadline = time.monotonic() + float(timeout)
+        with self.finish_cv:
+            while True:
+                req = self.results.get(rid)
+                if req is not None and req.done:
+                    return self.results.pop(rid)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self.finish_cv.wait(timeout=left)
 
     def run(self) -> Dict[int, List[int]]:
         """Drive until every request (queued + active) completes (a

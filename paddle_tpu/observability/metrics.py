@@ -99,6 +99,11 @@ METRIC_NAMES = frozenset({
     "serving.resilience.drains", "serving.resilience.drain_seconds",
     "serving.resilience.snapshots", "serving.resilience.warm_blocks",
     "serving.resilience.step_hangs",
+    # serving/fleet/ (multi-replica router: health, failover, shedding)
+    "fleet.replicas_ready", "fleet.replicas_dead", "fleet.queue_depth",
+    "fleet.submitted", "fleet.completed", "fleet.retries", "fleet.sheds",
+    "fleet.rerouted_requests", "fleet.replica_deaths", "fleet.drains",
+    "fleet.restarts", "fleet.affinity_hits", "fleet.handoff_seconds",
     # this module's ambient gauges + jax.monitoring listener
     "device.live_array_bytes", "device.live_arrays", "device.count",
     "jit.compiles", "jit.compile_seconds",
@@ -215,6 +220,28 @@ class Histogram:
     @property
     def sum(self) -> float:
         return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper-bound estimate of the ``q``-quantile: the smallest
+        bucket bound whose cumulative count reaches ``q * count``,
+        clamped to the observed max (the overflow bucket has no finite
+        bound). None when nothing has been observed. Coarse by design —
+        bounds are geometric — but monotone and cheap, which is what a
+        retry-after hint or an SLO gate needs."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0:
+                return None
+            need = q * self._count
+            cum = 0
+            for i, n in enumerate(self._buckets):
+                cum += n
+                if cum >= need and n:
+                    if i < len(self._bounds):
+                        return min(self._bounds[i], self._max)
+                    return self._max
+            return self._max
 
     def _reset(self) -> None:
         with self._lock:

@@ -823,7 +823,7 @@ def _dispatch_binary_fast(schema, attrs_key, a: Tensor, b):
                        ((p0.shape, p0.dtype), (p1.shape, p1.dtype)),
                        (schema.kernel, attrs_key))
 
-    if (schema.differentiable and engine._grad_enabled
+    if (schema.differentiable and engine.is_grad_enabled()
             and (not a._stop_gradient or not b._stop_gradient)):
         dmask = (not a._stop_gradient
                  and jnp.issubdtype(p0.dtype, jnp.inexact),

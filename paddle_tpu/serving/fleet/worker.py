@@ -1,0 +1,155 @@
+"""Subprocess replica worker: ``python -m paddle_tpu.serving.fleet.worker``.
+
+One engine replica behind a JSON-lines protocol. The FIRST stdin line
+is the config::
+
+    {"root": ..., "factory": "module:callable",   # builds the model
+     "engine": {...},          # ResilientServingEngine kwargs
+     "max_queue": int|null,    # handle-level non-handoff bound
+     "hb_interval_s": 0.2, "step_sleep_s": 0.0}
+
+then ops, one per line: ``{"op":"submit","gid":G,"prompt":[...],
+"n":N,"handoff":bool,"toks":[...]?}`` | ``{"op":"drain"}`` |
+``{"op":"stop"}``. Events go to stdout, one JSON per line:
+
+* ``{"ev":"ready","phase":...}`` — warmup (or recovery's first step)
+  done; the parent's health machine flips STARTING→READY on it
+* ``{"ev":"hb","phase":...,"qd":N}`` — periodic heartbeat
+* ``{"ev":"ack","gid":G}`` — admission DURABLY journaled (the router's
+  exactly-once ack point); ``{"ev":"full","gid":G,"hint":h}`` —
+  bounded admission refused, hint = median observed queue wait
+* ``{"ev":"finish","gid":G,"toks":[...],"ttft":...,"tpot":...}``
+* ``{"ev":"drained"}`` — drain committed; exit 64 follows
+
+stdin EOF means the parent died: drain and exit (an orphaned replica
+must not serve forever). A SIGKILL needs no protocol — the parent sees
+process death, and the journal under ``root`` is the handoff artifact.
+
+Exit codes mirror the chaos-worker convention: 0 completed/stopped,
+64 drained.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import queue
+import sys
+import threading
+import time
+
+
+def _build_model(spec: str):
+    mod, _, attr = spec.partition(":")
+    return getattr(importlib.import_module(mod), attr)()
+
+
+def main() -> int:
+    cfg = json.loads(sys.stdin.readline())
+    hb_interval = float(cfg.get("hb_interval_s", 0.2))
+    step_sleep = float(cfg.get("step_sleep_s", 0.0))
+    max_queue = cfg.get("max_queue")
+
+    from ...models.serving import QueueFull
+    from ...observability import metrics as _metrics
+    from ..resilience.engine import ResilientServingEngine
+    from .replica import _finish_timing
+
+    finish_meta = {}
+    eng = ResilientServingEngine(
+        _build_model(cfg["factory"]), cfg["root"],
+        finish_hook=lambda req: finish_meta.__setitem__(
+            req.rid, _finish_timing(req)),
+        **cfg.get("engine", {}))
+
+    ops: "queue.Queue" = queue.Queue()
+
+    def read_ops() -> None:
+        for line in sys.stdin:
+            try:
+                ops.put(json.loads(line))
+            except ValueError:
+                continue   # torn/garbage line: skip, don't die serving
+        ops.put({"op": "drain", "_eof": True})
+
+    threading.Thread(target=read_ops, daemon=True,
+                     name="fleet-worker-stdin").start()
+
+    def emit(ev) -> None:
+        sys.stdout.write(json.dumps(ev) + "\n")
+        sys.stdout.flush()
+
+    def flush_finished() -> None:
+        for rid in list(eng.outputs):
+            toks = eng.pop_output(rid)
+            if toks is None:
+                continue
+            ttft, tpot = finish_meta.pop(rid, (None, None))
+            emit({"ev": "finish", "gid": rid, "toks": toks,
+                  "ttft": ttft, "tpot": tpot})
+
+    eng.warmup()
+    emit({"ev": "ready", "phase": eng.phase})
+    # recovery may have loaded finished outputs straight from the
+    # journal — deliver them before any traffic arrives
+    flush_finished()
+
+    last_hb = 0.0
+    while True:
+        drain_req = stop_req = False
+        while True:
+            try:
+                op = ops.get_nowait()
+            except queue.Empty:
+                break
+            kind = op.get("op")
+            if kind == "submit":
+                gid = int(op["gid"])
+                handoff = bool(op.get("handoff")) or bool(op.get("toks"))
+                if (not handoff and max_queue is not None
+                        and len(eng.engine.pending) >= max_queue):
+                    qw = _metrics.registry().get(
+                        "serving.queue_wait_seconds")
+                    emit({"ev": "full", "gid": gid,
+                          "hint": qw.quantile(0.5)
+                          if qw is not None else None})
+                    continue
+                try:
+                    eng.add_request(op["prompt"],
+                                    max_new_tokens=int(op["n"]),
+                                    rid=gid,
+                                    out_tokens=op.get("toks") or None)
+                except QueueFull as e:
+                    emit({"ev": "full", "gid": gid,
+                          "hint": e.retry_after_hint})
+                    continue
+                emit({"ev": "ack", "gid": gid})
+            elif kind == "drain":
+                drain_req = True
+            elif kind == "stop":
+                stop_req = True
+        if stop_req:
+            eng.close()
+            return 0
+        if drain_req:
+            eng.drain()
+            flush_finished()
+            emit({"ev": "drained"})
+            eng.close()
+            return 64
+        if eng.has_work:
+            eng.step()
+            flush_finished()
+            if step_sleep:
+                time.sleep(step_sleep)
+        else:
+            time.sleep(0.005)
+        now = time.monotonic()
+        if now - last_hb >= hb_interval:
+            last_hb = now
+            emit({"ev": "hb", "phase": eng.phase,
+                  "qd": len(eng.engine.pending)})
+
+
+if __name__ == "__main__":
+    sys.exit(main())

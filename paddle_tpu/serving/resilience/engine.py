@@ -99,6 +99,7 @@ class ResilientServingEngine:
                  hang_exit: bool = False,
                  install_signal: bool = False,
                  elastic=None, signum: Optional[int] = None,
+                 finish_hook: Optional[Callable[[Any], None]] = None,
                  **engine_kwargs: Any):
         self.root = root
         self.journal = RequestJournal(os.path.join(root, "journal"))
@@ -108,6 +109,12 @@ class ResilientServingEngine:
         self.snapshot_every = max(0, int(snapshot_every))
         self.outputs: Dict[int, List[int]] = {}
         self.drained = False
+        self._draining = False
+        # fleet transport side-channel: called with each finished Request
+        # (timing fields included) right after its output journals —
+        # outputs[] only carries tokens, but a router's SLO accounting
+        # needs TTFT/TPOT per finish
+        self._finish_hook = finish_hook
         self.replayed_requests = 0
         self.recovered_finished = 0
         self.warm_blocks = 0
@@ -169,11 +176,17 @@ class ResilientServingEngine:
             # an incarnation's FIRST step pays the ragged XLA compile
             # (tens of seconds cold), so a steady-state timeout would
             # os._exit a healthy relaunch into a permanent crash loop:
-            # compile → watchdog kill → relaunch → same compile
+            # compile → watchdog kill → relaunch → same compile. By
+            # default (first_step_timeout_s=None) the pre-first-step
+            # window is exempt entirely: it is the NOT_READY health
+            # phase (see :attr:`phase`) — readiness gating is the
+            # router's job, not a guessed grace multiplier. An explicit
+            # first_step_timeout_s still caps the compile for
+            # deployments that want a hard bound.
             self._start_watchdog(
                 float(step_timeout_s),
-                float(first_step_timeout_s) if first_step_timeout_s
-                is not None else 10.0 * float(step_timeout_s))
+                None if first_step_timeout_s is None
+                else float(first_step_timeout_s))
         self.handler = None
         if install_signal:
             from ...distributed.fleet.elastic import PreemptionHandler
@@ -208,22 +221,63 @@ class ResilientServingEngine:
                  self.recovered_finished, self.warm_blocks))
 
     # -- intake --------------------------------------------------------------
-    def add_request(self, prompt, max_new_tokens: int = 32) -> int:
+    def add_request(self, prompt, max_new_tokens: int = 32, *,
+                    rid: Optional[int] = None,
+                    out_tokens: Optional[List[int]] = None) -> int:
         """Admit + journal durably: the flushed admission record is the
         ack point — a request this method returned an rid for survives
         any crash. Raises ``QueueFull`` when bounded admission rejects
-        (nothing is journaled for a rejected request)."""
+        (nothing is journaled for a rejected request).
+
+        ``rid``/``out_tokens`` are the CROSS-replica handoff hooks
+        (serving/fleet): a router re-routing a dead replica's journaled
+        request admits it here under its original rid with the dead
+        journal's committed watermark — same-seed sampling streams then
+        continue the output byte-identically, and THIS journal records
+        the inherited tokens so a second failure replays from the full
+        watermark, not from zero. A rid-given admission bypasses the
+        queue bound exactly like local journal replay: it was already
+        durably acked somewhere."""
         if self.drained:
             raise RuntimeError("engine is drained: relaunch to serve")
-        rid = self.engine.add_request(prompt, max_new_tokens=max_new_tokens)
+        rid = self.engine.add_request(prompt, max_new_tokens=max_new_tokens,
+                                      rid=rid, out_tokens=out_tokens)
         req = self.engine.results[rid]
         self.journal.append({
             "t": "admit", "rid": rid,
             "prompt": [int(x) for x in req.prompt],
             "max_new_tokens": int(max_new_tokens)})
+        if out_tokens:
+            self.journal.append({
+                "t": "tokens", "rid": rid, "from": 0,
+                "toks": [int(t) for t in out_tokens]})
         self.journal.flush()
-        self._watermark[rid] = 0
+        self._watermark[rid] = len(out_tokens) if out_tokens else 0
         return rid
+
+    def warmup(self) -> bool:
+        """Pay the cold ragged-step XLA compile before serving traffic:
+        run one throwaway single-token request straight through the
+        INNER engine with journaling and finish hand-off detached —
+        a journaled warmup would write a finish record with no matching
+        admit (an integrity error on the next replay), and its output
+        must not surface as a served result. No-op (False) unless the
+        engine is completely idle with zero steps served — a recovering
+        replica warms up by serving its replayed work instead."""
+        if (self.drained or self.engine.steps > 0
+                or self.engine.num_active > 0 or self.engine.pending):
+            return False
+        hook = self.engine.on_finish
+        self.engine.on_finish = None
+        try:
+            rid = self.engine.add_request([1, 1], max_new_tokens=1)
+            while not self.engine.results[rid].done:
+                self.engine.step()
+            self.engine.results.pop(rid, None)
+        finally:
+            self.engine.on_finish = hook
+        self._last_progress = time.monotonic()
+        return True
 
     # -- finished hand-off ---------------------------------------------------
     def _on_finish(self, req) -> None:
@@ -233,12 +287,33 @@ class ResilientServingEngine:
         # finished this step, not one fsync dance per callback
         self.journal.append({"t": "finish", "rid": req.rid})
         self._watermark.pop(req.rid, None)
+        if self._finish_hook is not None:
+            try:
+                self._finish_hook(req)
+            except Exception as e:
+                # a transport/observer bug must not poison the journal
+                # path: the finish record above is already appended, so
+                # delivery + replay stay correct without the hook
+                _record("serving.resilience.finish_hook_error",
+                        (type(e).__name__, str(e)))
 
-    def pop_output(self, rid: int) -> Optional[List[int]]:
+    def pop_output(self, rid: int,
+                   timeout: Optional[float] = None) -> Optional[List[int]]:
         """Retire a delivered output from host memory and mark it for
         the next journal compaction, which drops its records from disk
         (and from recovery time) too. Mirrors the inner engine's
-        ``pop_result``: a long-running server pops what it has sent."""
+        ``pop_result``: a long-running server pops what it has sent.
+        With ``timeout``, block on the engine's finish condition until
+        the output lands or the deadline passes — pollers on another
+        thread wait instead of busy-spinning."""
+        if timeout is not None and rid not in self.outputs:
+            deadline = time.monotonic() + float(timeout)
+            with self.engine.finish_cv:
+                while rid not in self.outputs:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self.engine.finish_cv.wait(timeout=left)
         out = self.outputs.pop(rid, None)
         if out is not None:
             self._retired.add(rid)
@@ -325,6 +400,20 @@ class ResilientServingEngine:
 
     # -- poll / serve loop ---------------------------------------------------
     @property
+    def phase(self) -> str:
+        """Health phase for the fleet router's state machine:
+        ``not_ready`` (no step served yet — the first step pays the cold
+        XLA compile, so a router must hold traffic), ``ready``,
+        ``draining`` (drain in progress), ``drained``."""
+        if self.drained:
+            return "drained"
+        if self._draining:
+            return "draining"
+        if self.engine.steps == 0:
+            return "not_ready"
+        return "ready"
+
+    @property
     def has_work(self) -> bool:
         # queued requests are not workable under paused admission (the
         # inner run() guards the same way): counting them would make a
@@ -367,6 +456,7 @@ class ResilientServingEngine:
         deadline = self.drain_deadline_s if deadline_s is None \
             else float(deadline_s)
         t0 = time.monotonic()
+        self._draining = True
         # the watchdog's job is over: this IS the clean exit, and the
         # commit+snapshot tail below must not be misread as a hang
         # (with hang_exit that would os._exit a server mid-drain)
@@ -398,10 +488,18 @@ class ResilientServingEngine:
 
     # -- step-hang watchdog --------------------------------------------------
     def _start_watchdog(self, timeout_s: float,
-                        first_step_timeout_s: float) -> None:
+                        first_step_timeout_s: Optional[float]) -> None:
         def scan():
             while not self._watchdog_stop.wait(min(timeout_s / 4, 1.0)):
                 if not self.has_work:
+                    self._last_progress = time.monotonic()
+                    continue
+                if self.engine.steps == 0 and first_step_timeout_s is None:
+                    # NOT_READY: the first step's compile window is
+                    # health-gated (routers withhold traffic until
+                    # phase == ready), not hang-policed — a fixed grace
+                    # multiplier either kills slow cold compiles or
+                    # ignores real steady-state hangs for 10x too long
                     self._last_progress = time.monotonic()
                     continue
                 limit = (timeout_s if self.engine.steps > 0

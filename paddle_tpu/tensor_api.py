@@ -336,13 +336,13 @@ class set_grad_enabled:
 
     def __init__(self, mode: bool):
         self._prev = _engine.is_grad_enabled()
-        _engine._grad_enabled = builtins.bool(mode)
+        _engine._set_grad_enabled(builtins.bool(mode))
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
-        _engine._grad_enabled = self._prev
+        _engine._set_grad_enabled(self._prev)
         return False
 
 

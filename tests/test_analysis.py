@@ -348,6 +348,67 @@ class TestDurabilityRule:
 
 
 # ---------------------------------------------------------------------------
+# timeouts (serving/fleet/: blocking calls must pass explicit timeouts)
+# ---------------------------------------------------------------------------
+
+class TestTimeoutsRule:
+    REL = "paddle_tpu/serving/fleet/router.py"
+
+    def test_bare_blocking_calls_fire(self):
+        src = ("import queue\n"
+               "def f(q, t, ev, lk, fut, proc):\n"
+               "    a = q.get()\n"
+               "    t.join()\n"
+               "    ev.wait()\n"
+               "    lk.acquire()\n"
+               "    r = fut.result()\n"
+               "    out = proc.communicate()\n")
+        fs = check_src(src, ["timeouts"], rel=self.REL)
+        assert len(fs) == 6
+        assert all("timeout" in f.message for f in fs)
+
+    def test_wait_for_needs_timeout_kwarg_despite_positional(self):
+        # .wait_for's first positional is the PREDICATE, so the
+        # zero-positional exemption must not apply to it
+        src = ("def f(cv):\n"
+               "    with cv:\n"
+               "        cv.wait_for(lambda: done())\n")
+        fs = check_src(src, ["timeouts"], rel=self.REL)
+        assert len(fs) == 1 and "wait_for" in fs[0].message
+        ok = ("def f(cv):\n"
+              "    with cv:\n"
+              "        cv.wait_for(lambda: done(), timeout=1.0)\n")
+        assert check_src(ok, ["timeouts"], rel=self.REL) == []
+
+    def test_positional_args_and_timeout_kwarg_are_clean(self):
+        # dict.get(k) / ','.join(xs) / t.join(2.0) are the classic
+        # false-positive shapes: a positional argument exempts the call
+        src = ("def f(q, t, ev, d, xs, lk, proc):\n"
+               "    a = q.get(timeout=1.0)\n"
+               "    b = d.get('k')\n"
+               "    s = ','.join(xs)\n"
+               "    t.join(2.0)\n"
+               "    ev.wait(timeout=0.5)\n"
+               "    lk.acquire(timeout=1.0)\n"
+               "    out = proc.communicate(timeout=10.0)\n")
+        assert check_src(src, ["timeouts"], rel=self.REL) == []
+
+    def test_outside_fleet_tree_is_exempt(self):
+        src = ("def f(ev):\n"
+               "    ev.wait()\n")
+        assert check_src(src, ["timeouts"],
+                         rel="paddle_tpu/serving/resilience/engine.py") == []
+        assert check_src(src, ["timeouts"],
+                         rel="paddle_tpu/models/serving.py") == []
+
+    def test_suppression_with_justification_works(self):
+        src = ("def f(ev):\n"
+               "    ev.wait()  "
+               "# graftcheck: disable=timeouts -- parent supervises\n")
+        assert check_src(src, ["timeouts"], rel=self.REL) == []
+
+
+# ---------------------------------------------------------------------------
 # compat-shim (migrated from the PR-4 standalone lint)
 # ---------------------------------------------------------------------------
 
@@ -655,7 +716,7 @@ class TestCli:
         out = capsys.readouterr().out
         for rid in ("capture-safety", "donation-safety", "trace-purity",
                     "compat-shim", "taxonomy", "silent-except",
-                    "test-flag-restore", "durability"):
+                    "test-flag-restore", "durability", "timeouts"):
             assert rid in out
 
     @pytest.mark.heavy

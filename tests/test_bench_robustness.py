@@ -208,6 +208,36 @@ class TestServingRecoveryMicro:
         assert r["value"] > 1.0, r
 
 
+class TestServingFleetMicro:
+    def test_micro_runs_and_meets_gate(self):
+        """bench.py serving_fleet smoke (ISSUE 12 acceptance): the
+        two-replica fleet round trip must produce a well-formed
+        artifact — base-rate goodput, overload sheds with a retry-after
+        hint, a rolling drain, zero dropped requests, and every
+        delivered stream byte-identical to the single-engine reference.
+        Goodput is a wall-clock gate: one retry absorbs a busy host."""
+        r = bench.bench_serving_fleet(False, quick=True)
+        d = r["detail"]
+        if r["value"] < 1.0 or d["overload_sheds"] == 0:  # timing gates
+            r = bench.bench_serving_fleet(False, quick=True)
+            d = r["detail"]
+        assert r["metric"] == "serving_fleet_goodput"
+        assert d["replicas"] == 2
+        assert d["base_delivered"] == d["base_offered"]
+        assert d["base_ttft_p50_ms"] > 0.0
+        # shedding engaged under the 2x burst, with a usable hint,
+        # and the admitted tail stayed bounded (not an SLO collapse)
+        assert d["overload_sheds"] > 0
+        assert (d["overload_admitted"] + d["overload_sheds"]
+                == d["overload_offered"])
+        assert d["overload_ttft_p99_ms"] is not None
+        assert d["overload_ttft_p99_ms"] < d["slo_ttft_s"] * 1e3
+        # the exactly-once invariants are hard gates, not timing
+        assert d["dropped_requests"] == 0
+        assert d["byte_identical"] is True
+        assert r["value"] == 1.0, r
+
+
 class TestStepCaptureMicro:
     def test_micro_runs_and_reports(self):
         """bench.py step_capture smoke (ISSUE 5): captured vs eager

@@ -1,0 +1,592 @@
+"""Fleet serving (ISSUE 12): multi-replica router with exactly-once
+retry, health-driven failover, and SLO-aware load shedding.
+
+Fast tier-1 covers the routing primitives (first-block affinity digest,
+rendezvous stability under membership change), the per-replica health
+state machine (STARTING exempt from heartbeat staleness, sticky DEAD,
+died-once semantics), the engine-side satellites (NOT_READY readiness
+phase replacing the watchdog compile-grace multiplier, blocking
+``pop_output``/``pop_result`` with timeouts, ``QueueFull.
+retry_after_hint``, ``Histogram.quantile``), and the router end to end
+on thread-hosted replicas: byte-identity vs a single-engine reference,
+failover of a replica killed right after the durable ack, shed-then-
+retry, a rolling drain racing live submits, and zero dropped requests
+throughout.
+
+The slow-marked chaos tranche runs REAL subprocess replicas and lands a
+genuine SIGKILL mid-stream: every victim request must complete
+byte-identically on a survivor (journal watermark handoff under the
+original gid — same-seed sampling streams make the token stream a pure
+function of the global id).
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import ContinuousBatchingEngine, QueueFull
+from paddle_tpu.observability.metrics import (METRIC_NAMES, Histogram,
+                                              registry)
+from paddle_tpu.serving.fleet import (FleetShed, ReplicaRouter,
+                                      ReplicaHealth, ReplicaState,
+                                      ReplicaUnavailable,
+                                      SubprocessReplicaHandle,
+                                      ThreadReplicaHandle)
+from paddle_tpu.serving.fleet.router import (_affinity_digest,
+                                             _rendezvous_order)
+from paddle_tpu.serving.resilience import (ResilientServingEngine,
+                                           ServingAction)
+
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=160, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=256)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+ENG = dict(max_batch=4, num_blocks=64, block_size=16, temperature=0.9,
+           seed=17)
+
+
+def _prompts(n=6, rng_seed=3, bs=16):
+    """Mixed stream: even indices share a one-block head (affinity +
+    prefix-cache food), odd ones are short singletons."""
+    rng = np.random.RandomState(rng_seed)
+    head = rng.randint(0, 128, bs).tolist()
+    out = []
+    for i in range(n):
+        body = rng.randint(0, 128, 3 + 2 * i).tolist()
+        out.append((head + body) if i % 2 == 0 else body)
+    return out
+
+
+def _mk_fleet(model, tmp_path, n=2, max_queue=None, eng=None,
+              **router_kw):
+    e = {**ENG, **(eng or {})}
+    reps = [ThreadReplicaHandle(f"rep{i}", lambda: model,
+                                str(tmp_path / f"rep{i}"),
+                                max_queue=max_queue,
+                                journal_flush_every=1, **e)
+            for i in range(n)]
+    router = ReplicaRouter(reps, block_size=e["block_size"], **router_kw)
+    router.start()
+    router.wait_ready(timeout_s=180.0)
+    return router, reps
+
+
+def _reference(model, requests):
+    """The byte-identity oracle: ONE plain engine serving every request
+    under its fleet gid — token streams are a pure function of (seed,
+    rid, index), so whatever the fleet routed/failed-over/drained must
+    match this run byte for byte."""
+    ref = ContinuousBatchingEngine(model, **ENG)
+    for gid in sorted(requests):
+        p, mx = requests[gid]
+        ref.add_request(p, max_new_tokens=mx, rid=gid)
+    ref.run()
+    return {g: list(ref.results[g].out_tokens) for g in requests}
+
+
+def _assert_byte_identical(router, model):
+    ref = _reference(model, router.requests)
+    got = {g: list(router.outputs[g]) for g in router.requests}
+    assert got == ref
+
+
+# ------------------------------------------------- routing primitives (fast)
+
+class TestAffinityDigest:
+    def test_first_block_keys_the_family(self):
+        head = list(range(16))
+        a = _affinity_digest(head + [1, 2, 3], 16)
+        b = _affinity_digest(head + [9] * 40, 16)
+        assert a == b                      # same head, different tails
+        assert _affinity_digest([7] + head, 16) != a
+
+    def test_short_prompt_keys_full_content(self):
+        assert (_affinity_digest([1, 2, 3], 16)
+                == _affinity_digest([1, 2, 3], 16))
+        assert (_affinity_digest([1, 2, 3], 16)
+                != _affinity_digest([1, 2, 4], 16))
+
+    def test_rendezvous_stable_under_membership_change(self):
+        """HRW's point: removing one replica must not reshuffle the
+        relative order of the survivors (only the dead one's traffic
+        moves)."""
+        key = _affinity_digest(list(range(16)), 16)
+        names = ["a", "b", "c", "d"]
+        order = _rendezvous_order(key, names)
+        for gone in names:
+            survivors = [n for n in names if n != gone]
+            assert (_rendezvous_order(key, survivors)
+                    == [n for n in order if n != gone])
+
+    def test_distinct_keys_spread_over_the_fleet(self):
+        rng = np.random.RandomState(0)
+        names = ["a", "b", "c"]
+        firsts = {
+            _rendezvous_order(
+                _affinity_digest(rng.randint(0, 128, 20).tolist(), 16),
+                names)[0]
+            for _ in range(60)}
+        assert firsts == set(names)        # no degenerate hot spot
+
+
+# ------------------------------------------------- health machine (fast)
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestReplicaHealth:
+    def _mk(self, **kw):
+        clk = _Clock()
+        return ReplicaHealth("r", clock=clk, **kw), clk
+
+    def test_starting_to_ready_on_phase(self):
+        h, _ = self._mk()
+        st, died = h.observe(
+            {"alive": True, "phase": "not_ready", "beat_age_s": 0.0})
+        assert st == ReplicaState.STARTING and not died
+        st, _ = h.observe(
+            {"alive": True, "phase": "ready", "beat_age_s": 0.0})
+        assert st == ReplicaState.READY
+
+    def test_starting_exempt_from_heartbeat_staleness(self):
+        # the whole STARTING window is one cold compile producing no
+        # beats — staleness must not kill it
+        h, clk = self._mk(heartbeat_timeout_s=1.0)
+        clk.t = 500.0
+        st, died = h.observe(
+            {"alive": True, "phase": "not_ready", "beat_age_s": 400.0})
+        assert st == ReplicaState.STARTING and not died
+
+    def test_start_deadline_bounds_the_compile(self):
+        h, clk = self._mk(start_deadline_s=10.0)
+        clk.t = 9.0
+        assert h.observe({"alive": True, "phase": "not_ready",
+                          "beat_age_s": 9.0})[0] == ReplicaState.STARTING
+        clk.t = 11.0
+        st, died = h.observe(
+            {"alive": True, "phase": "not_ready", "beat_age_s": 11.0})
+        assert st == ReplicaState.DEAD and died
+
+    def test_stale_heartbeat_kills_ready_exactly_once(self):
+        h, _ = self._mk(heartbeat_timeout_s=1.0)
+        h.observe({"alive": True, "phase": "ready", "beat_age_s": 0.0})
+        st, died = h.observe(
+            {"alive": True, "phase": "ready", "beat_age_s": 2.0})
+        assert st == ReplicaState.DEAD and died
+        st, died = h.observe(
+            {"alive": True, "phase": "ready", "beat_age_s": 2.0})
+        assert st == ReplicaState.DEAD and not died   # failover fires once
+
+    def test_dead_is_sticky_until_reset(self):
+        h, _ = self._mk()
+        assert h.mark_dead()
+        assert not h.mark_dead()           # second mark is a no-op
+        st, died = h.observe(
+            {"alive": True, "phase": "ready", "beat_age_s": 0.0})
+        assert st == ReplicaState.DEAD and not died   # zombies stay dead
+        h.reset()
+        assert h.state == ReplicaState.STARTING
+
+    def test_dead_cannot_drain(self):
+        h, _ = self._mk()
+        h.observe({"alive": True, "phase": "ready", "beat_age_s": 0.0})
+        h.mark_draining()
+        assert h.state == ReplicaState.DRAINING
+        h.mark_dead()
+        h.mark_draining()
+        assert h.state == ReplicaState.DEAD
+
+    def test_ready_back_to_starting_on_not_ready_phase(self):
+        h, _ = self._mk()
+        h.observe({"alive": True, "phase": "ready", "beat_age_s": 0.0})
+        st, died = h.observe(
+            {"alive": True, "phase": "not_ready", "beat_age_s": 0.0})
+        assert st == ReplicaState.STARTING and not died
+
+
+# --------------------------------------------- readiness gating (satellite)
+
+class TestReadinessGating:
+    def test_phase_tracks_lifecycle(self, model, tmp_path):
+        eng = ResilientServingEngine(model, str(tmp_path / "p"), **ENG)
+        assert eng.phase == "not_ready"
+        eng.add_request([1, 2, 3], max_new_tokens=2)
+        assert eng.phase == "not_ready"    # admitted, zero steps served
+        eng.run()
+        assert eng.phase == "ready"
+        eng.drain()
+        assert eng.phase == "drained"
+        eng.close()
+
+    def test_zero_step_window_is_not_hang_policed(self, model, tmp_path):
+        """The old 10x-first_step compile grace is gone: without an
+        explicit first_step_timeout_s a zero-step engine is NOT_READY
+        (routers withhold traffic) — never a watchdog hang, no matter
+        how long the compile takes."""
+        eng = ResilientServingEngine(model, str(tmp_path / "w"),
+                                     step_timeout_s=0.1, **ENG)
+        eng.add_request([1, 2, 3], max_new_tokens=2)
+        time.sleep(0.5)                    # way past step_timeout
+        assert eng.poll() == ServingAction.CONTINUE
+        assert eng.phase == "not_ready"
+        eng.close()
+
+    def test_explicit_first_step_deadline_still_caps(self, model,
+                                                     tmp_path):
+        eng = ResilientServingEngine(model, str(tmp_path / "w2"),
+                                     step_timeout_s=5.0,
+                                     first_step_timeout_s=0.1, **ENG)
+        eng.add_request([1, 2, 3], max_new_tokens=2)
+        deadline = time.time() + 5.0
+        while (eng.poll() != ServingAction.RESTART
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert eng.poll() == ServingAction.RESTART
+        eng.close()
+
+
+# ------------------------------------------ blocking pops (satellite)
+
+class TestBlockingPops:
+    def test_pop_result_blocks_until_finish(self, model):
+        eng = ContinuousBatchingEngine(model, **ENG)
+        rid = eng.add_request([5, 3, 1], max_new_tokens=3)
+        t = threading.Thread(target=eng.run)
+        t.start()
+        req = eng.pop_result(rid, timeout=60.0)
+        t.join()
+        assert req is not None and len(req.out_tokens) == 3
+
+    def test_pop_result_timeout_expires_to_none(self, model):
+        eng = ContinuousBatchingEngine(model, **ENG)
+        rid = eng.add_request([5, 3, 1], max_new_tokens=3)
+        t0 = time.monotonic()
+        assert eng.pop_result(rid, timeout=0.1) is None  # nobody steps
+        assert time.monotonic() - t0 < 5.0
+
+    def test_resilient_pop_output_blocks_and_times_out(self, model,
+                                                       tmp_path):
+        eng = ResilientServingEngine(model, str(tmp_path / "b"), **ENG)
+        rid = eng.add_request([5, 3, 1], max_new_tokens=3)
+        assert eng.pop_output(rid, timeout=0.05) is None
+        t = threading.Thread(target=eng.run)
+        t.start()
+        toks = eng.pop_output(rid, timeout=60.0)
+        t.join()
+        assert toks is not None and len(toks) == 3
+        eng.close()
+
+
+# ------------------------------------- QueueFull hint + quantile (satellite)
+
+class TestShedSignals:
+    def test_queue_full_carries_retry_after_hint(self):
+        err = QueueFull("admission queue is full (2/2 pending)",
+                        retry_after_hint=0.25)
+        assert err.retry_after_hint == 0.25
+        assert QueueFull("full").retry_after_hint is None
+
+    def test_engine_raise_site_sets_hint(self, model):
+        eng = ContinuousBatchingEngine(model, max_queue=1, **ENG)
+        eng.add_request([1, 2, 3], max_new_tokens=2)
+        with pytest.raises(QueueFull) as ei:
+            for _ in range(8):             # overfill without stepping
+                eng.add_request([4, 5, 6], max_new_tokens=2)
+        hint = ei.value.retry_after_hint
+        assert hint is None or hint >= 0.0  # None only pre-histogram
+
+    def test_histogram_quantile(self):
+        h = Histogram("t.q")
+        assert h.quantile(0.5) is None      # empty: no estimate
+        for v in (0.001, 0.002, 0.003, 0.004, 0.1):
+            h.observe(v)
+        p50 = h.quantile(0.5)
+        assert p50 is not None and 0.0 < p50 <= 0.1
+        assert h.quantile(1.0) >= p50
+        assert h.quantile(0.0) is not None
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+
+# ------------------------------------------------- fleet router (fast)
+
+class TestFleetRouter:
+    def test_two_replicas_byte_identical(self, model, tmp_path):
+        router, _ = _mk_fleet(model, tmp_path)
+        try:
+            for p in _prompts(6):
+                router.submit(p, max_new_tokens=6)
+            router.drain_all(timeout_s=120.0)
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_same_head_prompts_land_together(self, model, tmp_path):
+        """The affinity-digest 'collision' is the DESIGN: prompts
+        sharing a first block but differing after it must key to the
+        same replica (warm KV), while staying distinct requests."""
+        router, _ = _mk_fleet(model, tmp_path)
+        try:
+            head = list(range(16))
+            gids = [router.submit(head + [50 + i, 60 + i],
+                                  max_new_tokens=2) for i in range(4)]
+            # submit() never polls on success, so placement is still
+            # recorded even if the request already finished
+            placed = {router._outstanding[g].replica for g in gids}
+            assert len(placed) == 1
+            assert len(set(gids)) == 4     # distinct requests, one key
+            router.drain_all(timeout_s=120.0)
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_kill_after_ack_before_first_step(self, model, tmp_path):
+        """Death in the gap between the durable ack and the victim's
+        first step: the journal holds the admission (and possibly zero
+        tokens) — the survivor regenerates the whole stream under the
+        original gid, byte-identically."""
+        router, reps = _mk_fleet(model, tmp_path)
+        try:
+            gids = [router.submit(p, max_new_tokens=5)
+                    for p in _prompts(5, rng_seed=11)]
+            # the LAST ack'd request cannot have finished yet: killing
+            # its replica now guarantees a real mid-flight handoff
+            victim = router._outstanding[gids[-1]].replica
+            next(r for r in reps if r.name == victim).kill()
+            router.drain_all(timeout_s=120.0)
+            assert router.rerouted_requests >= 1
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_submit_routes_around_dead_transport(self, model, tmp_path):
+        router, reps = _mk_fleet(model, tmp_path)
+        try:
+            reps[0].kill()
+            gids = [router.submit(p, max_new_tokens=3)
+                    for p in _prompts(4, rng_seed=2)]
+            assert all(router._outstanding[g].replica == reps[1].name
+                       for g in gids)
+            router.drain_all(timeout_s=120.0)
+            assert router._health[reps[0].name].state == ReplicaState.DEAD
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_shed_then_retry(self, model, tmp_path):
+        """Overload sheds with a retry-after; the SAME prompts admitted
+        after backoff complete normally — shedding rejects work, it
+        never loses any."""
+        router, _ = _mk_fleet(model, tmp_path, max_queue=1,
+                              eng=dict(max_batch=1, num_blocks=32))
+        try:
+            prompts = _prompts(8, rng_seed=5)
+            admitted, shed = [], []
+            for p in prompts:
+                try:
+                    admitted.append(router.submit(
+                        p, max_new_tokens=24, deadline_s=0.02))
+                except FleetShed as e:
+                    assert e.retry_after_s is not None
+                    assert e.retry_after_s > 0.0
+                    shed.append(p)
+            assert shed                    # the burst really overloaded
+            assert admitted                # but capacity was served
+            router.drain_all(timeout_s=120.0)
+            for p in shed:                 # the retry path
+                admitted.append(router.submit(
+                    p, max_new_tokens=24, deadline_s=30.0))
+            router.drain_all(timeout_s=120.0)
+            assert router.sheds == len(shed)
+            assert router.dropped_requests == 0
+            assert len(router.outputs) == len(admitted)
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_rolling_drain_zero_dropped(self, model, tmp_path):
+        router, reps = _mk_fleet(model, tmp_path)
+        try:
+            for p in _prompts(8, rng_seed=21):
+                router.submit(p, max_new_tokens=8)
+            router.rolling_drain(ready_timeout_s=120.0)
+            assert all(r._incarnation == 1 for r in reps)
+            router.drain_all(timeout_s=120.0)
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_rolling_drain_racing_live_submits(self, model, tmp_path):
+        """A deploy drains the fleet while traffic keeps arriving:
+        DRAINING replicas leave the routing set, racing submits either
+        land on whoever is READY or shed-and-retry here — and nothing
+        is dropped or altered."""
+        router, _ = _mk_fleet(model, tmp_path)
+        try:
+            for p in _prompts(4, rng_seed=8):
+                router.submit(p, max_new_tokens=8)
+            errs = []
+
+            def roll():
+                try:
+                    router.rolling_drain(ready_timeout_s=120.0)
+                except Exception as e:     # surfaces in the assert below
+                    errs.append(e)
+
+            t = threading.Thread(target=roll)
+            t.start()
+            # deadline_s=0 sheds without polling internally: the drain
+            # thread owns poll(), this thread only submits
+            placed, i = 0, 0
+            rng = np.random.RandomState(99)
+            deadline = time.time() + 60.0
+            while placed < 6 and time.time() < deadline:
+                prompt = rng.randint(0, 128, 5 + i % 7).tolist()
+                try:
+                    router.submit(prompt, max_new_tokens=4,
+                                  deadline_s=0.0)
+                    placed += 1
+                except FleetShed:
+                    time.sleep(0.01)
+                i += 1
+            t.join(timeout=120.0)
+            assert not t.is_alive() and not errs
+            assert placed == 6
+            router.drain_all(timeout_s=120.0)
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_fleet_metric_names_frozen(self):
+        for name in ("fleet.replicas_ready", "fleet.replicas_dead",
+                     "fleet.queue_depth", "fleet.submitted",
+                     "fleet.completed", "fleet.retries", "fleet.sheds",
+                     "fleet.rerouted_requests", "fleet.replica_deaths",
+                     "fleet.drains", "fleet.restarts",
+                     "fleet.affinity_hits", "fleet.handoff_seconds"):
+            assert name in METRIC_NAMES, name
+            assert registry().get(name) is not None, name
+
+
+# ------------------------------------------------------- chaos (slow)
+
+@pytest.mark.slow
+@pytest.mark.heavy
+class TestSubprocessFleetChaos:
+    def test_sigkill_midstream_byte_identical(self, model, tmp_path):
+        """The acceptance chaos: two REAL worker processes, a genuine
+        SIGKILL mid-stream, and every victim request completing
+        byte-identically on the survivor from the dead journal's
+        committed watermark."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(
+                       [_TESTS_DIR, os.path.dirname(_TESTS_DIR)]))
+        config = {"factory": "serving_chaos_worker:build_model",
+                  "engine": {**ENG, "journal_flush_every": 1},
+                  "max_queue": 8, "hb_interval_s": 0.1,
+                  # per-step sleep keeps streams long enough that the
+                  # kill lands mid-generation, not post-finish
+                  "step_sleep_s": 0.02}
+        reps = [SubprocessReplicaHandle(
+                    f"sub{i}", str(tmp_path / f"sub{i}"), dict(config),
+                    spawn_env=env)
+                for i in range(2)]
+        router = ReplicaRouter(reps, block_size=ENG["block_size"],
+                               heartbeat_timeout_s=5.0,
+                               submit_deadline_s=30.0)
+        try:
+            router.start()
+            router.wait_ready(timeout_s=300.0)
+            gids = [router.submit(p, max_new_tokens=8)
+                    for p in _prompts(6, rng_seed=13)]
+            victim = router._outstanding[gids[-1]].replica
+            next(r for r in reps if r.name == victim).kill()  # SIGKILL
+            router.drain_all(timeout_s=300.0)
+            assert router.rerouted_requests >= 1
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+
+class TestGradModeThreadIsolation:
+    """Replica step loops run under no_grad() on background threads; a
+    process-global grad flag would let concurrent save/restore pairs
+    interleave (A saves True, B saves False, A restores, B restores)
+    and strand the whole process with grads off — silently breaking
+    every later autograd test. Grad mode must be per-thread."""
+
+    def test_concurrent_no_grad_threads_cannot_disable_main_thread(self):
+        from paddle_tpu.autograd.engine import is_grad_enabled, no_grad
+
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                with no_grad():
+                    pass
+
+        workers = [threading.Thread(target=churn, daemon=True)
+                   for _ in range(4)]
+        for w in workers:
+            w.start()
+        try:
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                assert is_grad_enabled()
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=10.0)
+        assert is_grad_enabled()
+        x = paddle.to_tensor(np.ones((2, 2), np.float32),
+                             stop_gradient=False)
+        (x * x).sum().backward()
+        assert x.grad is not None
+
+    def test_fresh_thread_defaults_to_grads_enabled(self):
+        from paddle_tpu.autograd.engine import is_grad_enabled, no_grad
+
+        seen = {}
+
+        def probe():
+            seen["default"] = is_grad_enabled()
+            with no_grad():
+                seen["inside"] = is_grad_enabled()
+            seen["after"] = is_grad_enabled()
+
+        with no_grad():
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join(timeout=10.0)
+        assert seen == {"default": True, "inside": False, "after": True}
+
+
+pytestmark = pytest.mark.smoke
