@@ -252,6 +252,14 @@ class ThreadReplicaHandle(ReplicaHandle):
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=60.0)
+            if self._thread.is_alive():
+                # wedged inside eng.step() and still holding the lock:
+                # acquiring it here would hang the whole rolling drain.
+                # Surface as a transport failure so the router fails
+                # this replica over instead.
+                raise ReplicaUnavailable(
+                    f"replica {self.name} worker did not stop for "
+                    f"drain (wedged mid-step)")
         with self._lock:
             return self.eng.drain()
 
@@ -322,7 +330,12 @@ class SubprocessReplicaHandle(ReplicaHandle):
         self._killed = False
         self._drained.clear()
         self._acks = {}
-        self._finished = []
+        # _finished deliberately survives incarnations: finishes the
+        # reader buffered but the router has not popped (e.g. flushed
+        # during a drain, then restart) are real deliveries — clearing
+        # them here would lose them for good on a fresh_root restart,
+        # where no journal replay can re-produce them. Same-root
+        # replays re-deliver too; the router's _delivered set dedupes.
         os.makedirs(self.root, exist_ok=True)
         env = dict(os.environ if self._spawn_env is None
                    else self._spawn_env)

@@ -45,6 +45,7 @@ import hashlib
 import os
 import random
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -190,6 +191,12 @@ class ReplicaRouter:
         self.rerouted_requests = 0
         self.sheds = 0
         self.retries = 0
+        # delivery timestamps inside the SLO gate's sliding window:
+        # the service-rate half of the queue-wait estimate (see
+        # _est_queue_wait_s — windowed so the gate decays when the
+        # fleet catches up, router-side so it sees subprocess fleets)
+        self._slo_window_s = 5.0
+        self._completions: deque = deque(maxlen=512)
 
     @property
     def dropped_requests(self) -> int:
@@ -268,9 +275,14 @@ class ReplicaRouter:
                             hints.append(float(e.retry_after_hint))
                         continue
                     except ReplicaUnavailable:
-                        # transport died under us: the health machine
-                        # settles its work on the next poll
-                        self._health[name].mark_dead()
+                        # transport died under us. poll() only fails
+                        # over on a died-NOW transition, and observe()
+                        # reports (DEAD, False) for a replica already
+                        # DEAD — so if this mark performs the
+                        # transition, settle the victim's journaled
+                        # work here or it never gets settled at all
+                        if self._health[name].mark_dead():
+                            self._failover(name)
                         continue
                     self._next_gid = gid + 1
                     self._outstanding[gid] = _Outstanding(
@@ -305,13 +317,29 @@ class ReplicaRouter:
             retry_after_s=after)
 
     def _est_queue_wait_s(self) -> Optional[float]:
-        """Median queue wait the fleet has actually delivered — the
-        engines' own histogram, so the estimate tracks load. None until
-        enough admissions have been observed to mean anything."""
-        qw = _metrics.registry().get("serving.queue_wait_seconds")
-        if qw is None or qw.count < 20:
+        """Expected wait if admitted now: fleet queue depth over the
+        recent delivery rate. Both halves are router-side and windowed
+        on purpose — the engines' ``serving.queue_wait_seconds``
+        histogram is cumulative over the process lifetime (one
+        sustained overload would poison its median and shed forever
+        after recovery) and lives in the CHILD for subprocess fleets,
+        where the parent's registry is empty. None until the window
+        holds enough deliveries to mean anything."""
+        now = time.monotonic()
+        comps = self._completions
+        while comps and now - comps[0] > self._slo_window_s:
+            comps.popleft()
+        if len(comps) < 8:
             return None
-        return qw.quantile(0.5)
+        rate = len(comps) / max(now - comps[0], 1e-3)
+        # a DEAD replica's snapshot is its last heartbeat — counting
+        # that stale depth would double the work failover already
+        # moved onto the survivors' queues
+        qdepth = sum(
+            int(self._replicas[n].status().get("queue_depth") or 0)
+            for n, h in self._health.items()
+            if h.state != ReplicaState.DEAD)
+        return qdepth / rate
 
     # -- poll / delivery -----------------------------------------------------
     def poll(self) -> List[FinishedInfo]:
@@ -331,6 +359,7 @@ class ReplicaRouter:
                 self.outputs[fi.gid] = fi.tokens
                 self.finished_meta[fi.gid] = fi
                 self._outstanding.pop(fi.gid, None)
+                self._completions.append(now)
                 _M_COMPLETED.inc()
                 done.append(fi)
             st = handle.status()
@@ -409,6 +438,7 @@ class ReplicaRouter:
                     self.outputs[info.gid] = toks
                     self.finished_meta[info.gid] = FinishedInfo(
                         info.gid, toks)
+                    self._completions.append(time.monotonic())
                     _M_COMPLETED.inc()
                 self._outstanding.pop(info.gid, None)
             else:
@@ -462,7 +492,16 @@ class ReplicaRouter:
                 continue               # deploys don't resurrect: restart policy owns that
             health.mark_draining()
             self.poll()
-            handle.drain()
+            try:
+                handle.drain()
+            except ReplicaUnavailable:
+                # the drain found the replica dead or wedged: settle
+                # its journaled work on survivors instead of deploying
+                # it (restart policy owns resurrection, same as DEAD
+                # replicas skipped above)
+                if health.mark_dead():
+                    self._failover(name)
+                continue
             _M_DRAINS.inc()
             _record("fleet.drain", (name,))
             handle.restart()           # same root: recovers own journal

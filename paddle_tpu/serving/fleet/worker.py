@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import os
 import queue
 import sys
 import threading
@@ -76,8 +77,21 @@ def main() -> int:
                      name="fleet-worker-stdin").start()
 
     def emit(ev) -> None:
-        sys.stdout.write(json.dumps(ev) + "\n")
-        sys.stdout.flush()
+        # a dead parent (stdin EOF -> orphan drain) leaves stdout a
+        # broken pipe: events are advisory — the journal under root is
+        # the durable record — so drop them rather than crash out of
+        # the shutdown path. Redirect to devnull so the interpreter's
+        # exit-time stdout flush cannot re-raise and turn the
+        # documented exit code (64) into 120.
+        try:
+            sys.stdout.write(json.dumps(ev) + "\n")
+            sys.stdout.flush()
+        except (BrokenPipeError, ValueError, OSError):
+            try:
+                sys.stdout.close()
+            except Exception:
+                pass       # the pipe is already broken; close is best-effort
+            sys.stdout = open(os.devnull, "w")
 
     def flush_finished() -> None:
         for rid in list(eng.outputs):

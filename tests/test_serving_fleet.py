@@ -20,7 +20,10 @@ original gid — same-seed sampling streams make the token stream a pure
 function of the global id).
 """
 
+import io
+import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -330,6 +333,51 @@ class TestShedSignals:
             h.quantile(1.5)
 
 
+class _FakeHandle:
+    """status()-only stand-in so the SLO estimator can be unit-tested
+    without a fleet."""
+
+    def __init__(self, name, qd):
+        self.name = name
+        self.root = ""
+        self.qd = qd
+
+    def status(self):
+        return {"alive": True, "phase": "ready",
+                "queue_depth": self.qd, "beat_age_s": 0.0}
+
+
+class TestSloGateEstimate:
+    def test_estimate_is_windowed_and_decays(self):
+        """The gate must read CURRENT load (fleet queue depth over the
+        recent delivery rate), not a process-lifetime histogram: after
+        an overload ends, the estimate has to fall back under the SLO
+        instead of shedding forever."""
+        r = ReplicaRouter([_FakeHandle("a", 8)])
+        assert r._est_queue_wait_s() is None   # no deliveries yet
+        now = time.monotonic()
+        for i in range(16):                    # ~16 deliveries/s window
+            r._completions.append(now - 1.0 + i * 0.01)
+        est = r._est_queue_wait_s()
+        assert est is not None and 0.0 < est < 5.0
+        # the same deliveries aged out of the window: gate goes inert
+        # (decay) rather than remembering the overload
+        r._completions.clear()
+        for _ in range(16):
+            r._completions.append(now - 60.0)
+        assert r._est_queue_wait_s() is None
+
+    def test_estimate_scales_with_fleet_queue_depth(self):
+        idle = ReplicaRouter([_FakeHandle("a", 0)])
+        busy = ReplicaRouter([_FakeHandle("a", 64)])
+        now = time.monotonic()
+        for r in (idle, busy):
+            for i in range(16):
+                r._completions.append(now - 1.0 + i * 0.01)
+        assert idle._est_queue_wait_s() == 0.0   # empty queues: no wait
+        assert busy._est_queue_wait_s() > idle._est_queue_wait_s()
+
+
 # ------------------------------------------------- fleet router (fast)
 
 class TestFleetRouter:
@@ -393,6 +441,62 @@ class TestFleetRouter:
                        for g in gids)
             router.drain_all(timeout_s=120.0)
             assert router._health[reps[0].name].state == ReplicaState.DEAD
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_submit_discovered_death_settles_outstanding(self, model,
+                                                         tmp_path):
+        """A replica dying BETWEEN polls can be discovered by submit()
+        tripping over the dead transport rather than by poll() — and
+        observe() reports died_now only on the transition, so submit's
+        mark_dead must run the same failover or the victim's acked
+        requests stay outstanding forever (drain_all would time out)."""
+        router, reps = _mk_fleet(model, tmp_path)
+        try:
+            gids = [router.submit(p, max_new_tokens=6)
+                    for p in _prompts(6, rng_seed=31)]
+            victim = router._outstanding[gids[-1]].replica
+            next(r for r in reps if r.name == victim).kill()
+            # no poll between the kill and these submits: the candidate
+            # walk must be the one to find the corpse (rendezvous order
+            # is per-key, so a few distinct prompts guarantee a hit)
+            rng = np.random.RandomState(77)
+            for i in range(64):
+                if router._health[victim].state == ReplicaState.DEAD:
+                    break
+                router.submit(rng.randint(0, 128, 6 + i % 5).tolist(),
+                              max_new_tokens=2)
+            assert router._health[victim].state == ReplicaState.DEAD
+            router.drain_all(timeout_s=120.0)
+            assert router.rerouted_requests >= 1
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+
+    def test_rolling_drain_survives_undrainable_replica(self, model,
+                                                        tmp_path):
+        """drain() raising ReplicaUnavailable (wedged worker, broken
+        pipe) must fail the replica over — journaled work lands on a
+        survivor — instead of hanging or aborting the deploy."""
+        router, reps = _mk_fleet(model, tmp_path)
+        try:
+            gids = [router.submit(p, max_new_tokens=5)
+                    for p in _prompts(5, rng_seed=41)]
+            victim_name = router._outstanding[gids[-1]].replica
+            victim = next(r for r in reps if r.name == victim_name)
+
+            def wedged_drain():
+                victim.kill()              # a wedged worker serves nothing
+                raise ReplicaUnavailable("wedged mid-step")
+
+            victim.drain = wedged_drain
+            router.rolling_drain(ready_timeout_s=120.0)
+            assert (router._health[victim_name].state
+                    == ReplicaState.DEAD)
+            router.drain_all(timeout_s=120.0)
             assert router.dropped_requests == 0
             _assert_byte_identical(router, model)
         finally:
@@ -484,6 +588,65 @@ class TestFleetRouter:
         finally:
             router.close()
 
+    def test_thread_drain_raises_on_wedged_worker(self, model,
+                                                  tmp_path):
+        """A worker wedged inside eng.step() still holds the engine
+        lock: drain() must surface ReplicaUnavailable after the join
+        times out instead of blocking forever on that lock."""
+
+        class _WedgedThread:
+            def join(self, timeout=None):
+                pass                       # the join "times out"
+
+            def is_alive(self):
+                return True
+
+        h = ThreadReplicaHandle("w", lambda: model,
+                                str(tmp_path / "w"), **ENG)
+        h.start()
+        real = h._thread
+        try:
+            h._thread = _WedgedThread()
+            with pytest.raises(ReplicaUnavailable):
+                h.drain()
+        finally:
+            h._thread = real
+            h.stop()
+
+    def test_subprocess_restart_preserves_buffered_finishes(
+            self, tmp_path, monkeypatch):
+        """Finishes the reader buffered but the router never popped
+        must survive restart() — on a fresh_root restart there is no
+        journal replay to re-produce them, so clearing the buffer
+        would lose a delivered request for good."""
+        from paddle_tpu.serving.fleet import replica as replica_mod
+        from paddle_tpu.serving.fleet.replica import FinishedInfo
+
+        class _FakeProc:
+            def __init__(self, *a, **k):
+                self.stdin = io.StringIO()
+                self.stdout = io.StringIO()
+                self.pid = 0
+
+            def poll(self):
+                return None
+
+            def wait(self, timeout=None):
+                return 0
+
+            def kill(self):
+                pass
+
+        monkeypatch.setattr(replica_mod.subprocess, "Popen",
+                            lambda *a, **k: _FakeProc())
+        h = SubprocessReplicaHandle("s", str(tmp_path / "s"),
+                                    {"factory": "x:y"})
+        h.start()
+        h._finished.append(FinishedInfo(7, [1, 2, 3]))
+        h.restart(fresh_root=True)
+        assert [fi.gid for fi in h.pop_finished()] == [7]
+        assert h.pop_finished() == []      # popped exactly once
+
     def test_fleet_metric_names_frozen(self):
         for name in ("fleet.replicas_ready", "fleet.replicas_dead",
                      "fleet.queue_depth", "fleet.submitted",
@@ -534,6 +697,44 @@ class TestSubprocessFleetChaos:
             _assert_byte_identical(router, model)
         finally:
             router.close()
+
+    def test_orphaned_worker_drains_and_exits_64(self, tmp_path):
+        """Parent death = stdin EOF with stdout a broken pipe. The
+        worker's orphan shutdown must survive its own (now-undeliverable)
+        emits: drain, close the engine, and exit with the documented
+        code 64 — not a BrokenPipeError traceback."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(
+                       [_TESTS_DIR, os.path.dirname(_TESTS_DIR)]))
+        cfg = {"root": str(tmp_path / "orph"),
+               "factory": "serving_chaos_worker:build_model",
+               "engine": {**ENG, "journal_flush_every": 1},
+               "hb_interval_s": 0.1}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.fleet.worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=env, text=True)
+        try:
+            proc.stdin.write(json.dumps(cfg) + "\n")
+            proc.stdin.flush()
+            ready = False
+            for line in proc.stdout:       # wait out warmup
+                if json.loads(line).get("ev") == "ready":
+                    ready = True
+                    break
+            assert ready
+            proc.stdin.write(json.dumps(
+                {"op": "submit", "gid": 0, "prompt": [1, 2, 3],
+                 "n": 4}) + "\n")
+            proc.stdin.flush()
+            # the parent "dies": EOF on the worker's stdin, and nobody
+            # holds the read end of its stdout anymore
+            proc.stdin.close()
+            proc.stdout.close()
+            assert proc.wait(timeout=300) == 64
+        finally:
+            if proc.poll() is None:
+                proc.kill()
 
 
 class TestGradModeThreadIsolation:
