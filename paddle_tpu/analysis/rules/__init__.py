@@ -11,6 +11,7 @@ from . import compat_shim     # noqa: F401
 from . import donation        # noqa: F401
 from . import durability      # noqa: F401
 from . import hygiene         # noqa: F401
+from . import spans           # noqa: F401
 from . import taxonomy        # noqa: F401
 from . import timeouts        # noqa: F401
 from . import trace_purity    # noqa: F401

@@ -1,0 +1,106 @@
+"""spans: tracing span/event names must come from the frozen taxonomy.
+
+The span timeline (observability/tracing.py) has the same label
+discipline problem as metric names: a typo'd
+``span("serving.admitt")`` forks the taxonomy — tests, dashboards and
+trace tooling keyed on ``SPAN_NAMES`` then silently miss the event.
+The runtime half of the defense is ``_check_name``'s ValueError on the
+span hot path; this rule is the static half, catching the typo (and
+un-registered additions) at lint time, over every call site at once.
+
+Mechanics mirror the ``taxonomy`` rule: a cross-file ``begin`` pass
+collects the module-level ``SPAN_NAMES = frozenset({...})`` literal;
+``check`` then verifies every STRING LITERAL in the name position of a
+span-bearing call (``span``/``start_span``/``record_span``/``instant``
+/``event`` — module functions and ``Span.event`` alike, matched by
+terminal callee name) is a member. F-strings in that position are
+flagged too: the name is a grouping key, so the varying part belongs
+in ``attrs``, not the name. Non-literal names are skipped — they were
+literals somewhere else, where this rule saw them. User code tracing
+its own names is out of scope (src profile only).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Sequence, Set
+
+from ..core import Finding, Rule, SourceFile, register, terminal_name
+
+# callee terminal names whose FIRST positional (or name=) argument is a
+# frozen span/event name
+SPAN_CALLEES = {"span", "start_span", "record_span", "instant", "event"}
+
+
+def _frozenset_literal(node: ast.AST) -> Optional[Set[str]]:
+    if not (isinstance(node, ast.Call) and terminal_name(node.func) ==
+            "frozenset" and len(node.args) == 1):
+        return None
+    arg = node.args[0]
+    elts = arg.elts if isinstance(arg, (ast.Set, ast.Tuple, ast.List)) \
+        else None
+    if elts is None:
+        return None
+    out = set()
+    for e in elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.add(e.value)
+    return out
+
+
+@register
+class SpansRule(Rule):
+    id = "spans"
+    help = ("tracing span/event name string literals must be members of "
+            "the frozen observability.tracing.SPAN_NAMES constant")
+    profiles = ("src",)
+
+    def __init__(self):
+        self.span_names: Set[str] = set()
+        self.saw_span_set = False
+
+    def begin(self, files: Sequence[SourceFile]) -> None:
+        for sf in files:
+            for node in sf.tree.body:
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                t = node.targets[0]
+                if not isinstance(t, ast.Name) or t.id != "SPAN_NAMES":
+                    continue
+                vals = _frozenset_literal(node.value)
+                if vals is not None:
+                    self.span_names |= vals
+                    self.saw_span_set = True
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        if not self.saw_span_set:
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name not in SPAN_CALLEES:
+                continue
+            arg = node.args[0] if node.args else None
+            if arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        arg = kw.value
+                        break
+            if arg is None:
+                continue
+            if isinstance(arg, ast.JoinedStr):
+                yield self.finding(
+                    sf, arg.lineno,
+                    f"f-string in the span-name position of {name}() — "
+                    f"span names are frozen grouping keys; pass a "
+                    f"SPAN_NAMES member and put the varying part in attrs")
+            elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if arg.value not in self.span_names:
+                    yield self.finding(
+                        sf, arg.lineno,
+                        f"span name {arg.value!r} passed to {name}() is "
+                        f"not a member of observability.tracing."
+                        f"SPAN_NAMES — taxonomy fork (typo?) or a "
+                        f"missing registration")

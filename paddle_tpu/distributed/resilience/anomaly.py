@@ -36,6 +36,7 @@ from typing import Optional
 
 from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
+from ...observability import tracing as _tracing
 
 __all__ = ["AnomalyAction", "AnomalyDetector"]
 
@@ -144,10 +145,18 @@ class AnomalyDetector:
             self._bad_run += 1
             if self.first_bad_step is None:
                 self.first_bad_step = step
-            if self._nf_run >= self.nonfinite_streak \
-                    or self._spike_run >= self.spike_streak \
-                    or self._bad_run >= max(self.nonfinite_streak,
-                                            self.spike_streak):
+            rewind = (self._nf_run >= self.nonfinite_streak
+                      or self._spike_run >= self.spike_streak
+                      or self._bad_run >= max(self.nonfinite_streak,
+                                              self.spike_streak))
+            # non-OK verdicts only: OK is the hot path, and the training
+            # timeline needs the decision points, not every clean step
+            _tracing.instant("anomaly.verdict", attrs={
+                "step": step,
+                "action": (AnomalyAction.REWIND if rewind
+                           else AnomalyAction.SKIP),
+                "streak": self._bad_run})
+            if rewind:
                 return AnomalyAction.REWIND
             return AnomalyAction.SKIP
         self._bad_run = 0

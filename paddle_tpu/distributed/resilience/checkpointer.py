@@ -41,6 +41,7 @@ import numpy as np
 from ...core.tensor import Tensor
 from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
+from ...observability import tracing as _tracing
 from ..checkpoint.save_load import (collect_shards, latest_checkpoint,
                                     load_state_dict, read_committed_marker,
                                     write_committed_marker, write_shards,
@@ -263,13 +264,18 @@ class AsyncCheckpointer:
         self.wait()
         self.last_error = None   # reflects THIS save from here on
         t0 = time.perf_counter()
-        arrays, host = flatten_state(state)
-        payload, md = collect_shards(arrays, rank=self.rank)
+        with _tracing.span("checkpoint.snapshot",
+                           attrs={"step": int(step)}) as _sp:
+            arrays, host = flatten_state(state)
+            payload, md = collect_shards(arrays, rank=self.rank)
         _M_SNAPSHOT.observe(time.perf_counter() - t0)
         path = self.generation_path(step)
+        # hand the snapshot span's context to the writer thread: the
+        # background commit joins the step's trace, not a fresh root
+        tc = _sp.context if _sp.trace_id else None
         worker = threading.Thread(
             target=self._write_generation,
-            args=(payload, md, dict(host), path, int(step)),
+            args=(payload, md, dict(host), path, int(step), tc),
             name=f"ckpt-writer-{step}", daemon=True)
         self._pending = worker
         worker.start()
@@ -277,8 +283,15 @@ class AsyncCheckpointer:
             self.wait()
         return path
 
-    def _write_generation(self, payload, md, host, path, step) -> None:
+    def _write_generation(self, payload, md, host, path, step,
+                          tc=None) -> None:
         t0 = time.perf_counter()
+        with _tracing.span("checkpoint.commit", trace=tc,
+                           attrs={"step": step, "path": path}):
+            self._write_generation_inner(payload, md, host, path, step, t0)
+
+    def _write_generation_inner(self, payload, md, host, path, step,
+                                t0) -> None:
         try:
             write_shards(payload, md, path, rank=self.rank,
                          coordinator_rank=self.coordinator_rank)

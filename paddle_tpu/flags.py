@@ -204,6 +204,18 @@ define_flag("flight_recorder_size", 256,
             "flight recorder ring capacity (op dispatches)")
 define_flag("flight_recorder_path", "",
             "crash-dump destination for the flight recorder; empty = stderr")
+define_flag("tracing", True,
+            "always-on request/step tracing (observability/tracing.py): "
+            "trace_id/span_id spans with contextvars propagation over a "
+            "bounded per-process ring, exported as Chrome-trace JSON via "
+            "observability.dump_trace(); False short-circuits every span "
+            "to a single flag read")
+define_flag("tracing_ring_size", 4096,
+            "tracing ring capacity (completed spans + instant events)")
+define_flag("tracing_path", "",
+            "crash-dump destination for the span trace (Chrome-trace "
+            "JSON, written next to the flight recorder dump on uncaught "
+            "exception); empty = human-readable listing to stderr")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")
 define_flag("rng_impl", "rbg",

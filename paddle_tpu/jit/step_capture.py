@@ -73,6 +73,7 @@ from ..core import tensor as tensor_mod
 from ..core.tensor import Tensor
 from ..observability import flight_recorder as _flight_mod
 from ..observability import metrics as _metrics_mod
+from ..observability import tracing as _tracing
 from ..ops import dispatcher
 from ..optimizer import lr as lr_mod
 from ..optimizer import optimizer as optimizer_mod
@@ -814,7 +815,10 @@ class CapturedStep:
             return self._probe_and_prime(args, kwargs, arg_sig)
         if ent is _PRIMED:
             try:
-                out = self._attempt_capture(key, dyn_arrays, rebuild)
+                # the span survives CaptureAbort (the with-block ends
+                # it) so an aborted capture's cost is still attributed
+                with _tracing.span("step_capture.capture"):
+                    out = self._attempt_capture(key, dyn_arrays, rebuild)
             except CaptureAbort as e:
                 self._put_entry(key, ("unfusable", e.reason, e.detail))
                 self._disc = None   # a stale discovery gets one re-probe
@@ -829,7 +833,8 @@ class CapturedStep:
         # compiled: refresh FIFO age, replay
         self._entries.pop(key)
         self._entries[key] = ent
-        out = self._replay(ent, dyn_arrays)
+        with _tracing.span("step_capture.replay"):
+            out = self._replay(ent, dyn_arrays)
         if out is None:                 # baked-constant invalidation
             return self._probe_and_prime(args, kwargs, arg_sig)
         self._streak = 0

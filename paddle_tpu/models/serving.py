@@ -56,6 +56,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..observability import metrics as _metrics_mod
+from ..observability import tracing as _tracing
 from ..ops.dispatcher import call_op
 from .generation import PagedKVCache
 
@@ -145,6 +146,19 @@ class Request:
     t_done: Optional[float] = None
     n_replayed: int = 0                # tokens emitted by a previous process
     _registered_upto: int = 0          # prompt blocks published to the cache
+    # -- tracing (observability/tracing.py): the ambient trace context at
+    # intake plus perf_counter_ns edge stamps, so the engine records the
+    # request's queue/prefill/decode phases as RETROACTIVE spans instead
+    # of holding a span object open across scheduler steps
+    trace_id: int = 0
+    span_parent: int = 0
+    t_arrive_ns: int = 0
+    t_admit_ns: int = 0
+    t_first_ns: int = 0
+
+
+def _req_trace(req: "Request"):
+    return (req.trace_id, req.span_parent) if req.trace_id else None
 
 
 class PrefixCache:
@@ -409,6 +423,10 @@ class ContinuousBatchingEngine:
                 f"pool has {self._total_blocks} and a sequence may hold at "
                 f"most max_blocks_per_seq={mb}: it could never be admitted")
         req.t_arrive = time.time()
+        req.t_arrive_ns = _tracing.now_ns()
+        tc = _tracing.current()
+        if tc is not None:
+            req.trace_id, req.span_parent = tc
         # sha256 chain digests, NOT builtin hash(): a 64-bit hash()
         # collision would silently serve another request's KV blocks
         # (and salted-hash keys are constructible when the seed leaks) —
@@ -517,6 +535,10 @@ class ContinuousBatchingEngine:
                 # arrival-to-now span includes on-device decode
                 # residency, which is not queue wait
                 _M_QWAIT.observe(time.time() - req.t_arrive)
+                req.t_admit_ns = _tracing.now_ns()
+                _tracing.record_span(
+                    "serving.queue", req.t_arrive_ns, req.t_admit_ns,
+                    trace=_req_trace(req), attrs={"rid": req.rid})
             req.slot = i
             req.admit_order = self._admit_seq
             self._admit_seq += 1
@@ -574,6 +596,9 @@ class ContinuousBatchingEngine:
         victim.preemptions += 1
         self.preempt_count += 1
         _M_PREEMPTIONS.inc()
+        _tracing.instant("serving.preempt", trace=_req_trace(victim),
+                         attrs={"rid": victim.rid,
+                                "preemptions": victim.preemptions})
         self.pending.insert(1, victim)  # right behind the starved head
 
     def _register_blocks(self, req: Request, i: int, new_ctx: int):
@@ -594,6 +619,16 @@ class ContinuousBatchingEngine:
         if req.t_first is None:
             req.t_first = now
             _M_TTFT.observe(now - req.t_arrive)
+            req.t_first_ns = _tracing.now_ns()
+            # slot admission -> first token: with serving.queue before it
+            # and jit.compile/serving.step beside it, TTFT decomposes
+            # into queue vs compile vs kernel time on one timeline
+            _tracing.record_span(
+                "serving.prefill",
+                req.t_admit_ns or req.t_arrive_ns, req.t_first_ns,
+                trace=_req_trace(req), attrs={"rid": req.rid})
+            _tracing.instant("serving.first_token", trace=_req_trace(req),
+                             attrs={"rid": req.rid})
         self.tok[i] = tok
         if (len(req.out_tokens) >= req.max_new_tokens
                 or (self.eos is not None and tok == self.eos)):
@@ -612,6 +647,13 @@ class ContinuousBatchingEngine:
             # result), so drop the prompt+generated copy with it
             req.full_seq = None
             _M_FINISHED.inc()
+            _tracing.record_span(
+                "serving.decode",
+                req.t_first_ns or req.t_admit_ns or req.t_arrive_ns,
+                _tracing.now_ns(), trace=_req_trace(req),
+                attrs={"rid": req.rid, "tokens": len(req.out_tokens)})
+            _tracing.instant("serving.finish", trace=_req_trace(req),
+                             attrs={"rid": req.rid})
             finished.append(req)
 
     # -- the ragged step -----------------------------------------------------
@@ -707,6 +749,7 @@ class ContinuousBatchingEngine:
         cu = np.zeros((R + 1,), np.int32)
         np.cumsum(qlen, out=cu[1:])
 
+        _t0_ns = _tracing.now_ns()
         view = _RaggedView(
             self.cache,
             Tensor(jnp.asarray(slot_vec, jnp.int32)),
@@ -727,6 +770,12 @@ class ContinuousBatchingEngine:
         _M_STEPS.inc()
         _M_STEP_TOKENS.inc(t)
         sampled = np.asarray(nxt._data).reshape(-1)
+        # retroactive, on the thread timeline (untraced: one ragged step
+        # serves many requests): model call through the host sync above
+        _tracing.record_span(
+            "serving.step", _t0_ns, _tracing.now_ns(),
+            attrs={"tokens": t, "decode_rows": len(decode_rows),
+                   "prefill_rows": len(prefill_rows)})
         now = time.time()
         finished: List[Request] = []
         for i, is_decode, n in post:
@@ -737,6 +786,9 @@ class ContinuousBatchingEngine:
                 self._append_token(req, i, int(sampled[i]), now, finished)
             else:
                 _M_PREFILL_TOKENS.inc(n)
+                _tracing.instant(
+                    "serving.prefill_chunk", trace=_req_trace(req),
+                    attrs={"rid": req.rid, "tokens": n, "ctx": req.ctx})
                 self._register_blocks(req, i, req.ctx)
                 if req.ctx == req.target:
                     if req.out_tokens:  # resumed: next input pre-sampled

@@ -1,4 +1,4 @@
-"""paddle_tpu.observability — always-on runtime metrics + flight recorder.
+"""paddle_tpu.observability — always-on metrics, flight recorder, tracing.
 
 The opt-in span tracing in ``paddle_tpu.profiler`` answers "how long did
 this step take?" when a Profiler is open; this package answers "what has
@@ -12,7 +12,16 @@ the process been doing?" at ALL times, at near-zero cost:
 * an **always-on flight recorder** (:mod:`.flight_recorder`) — a bounded
   ring of the last N op dispatches (op name, input shapes/dtypes,
   exec-cache key, thread) that dumps on uncaught exception or explicit
-  :func:`dump_flight_recorder`, gated by ``FLAGS_flight_recorder``.
+  :func:`dump_flight_recorder`, gated by ``FLAGS_flight_recorder``;
+* **end-to-end request/step tracing** (:mod:`.tracing`) — one trace_id
+  from the fleet router through a replica's engine to the compiled step,
+  propagated via contextvars in-process and the fleet submit frame
+  cross-process, recorded into a bounded ring and exported as
+  Chrome-trace JSON (:func:`dump_trace`), gated by ``FLAGS_tracing``.
+  Span names are frozen in :data:`tracing.SPAN_NAMES` exactly like the
+  metric names below (graftcheck rule ``spans``).
+
+``python -m paddle_tpu.observability`` prints all three dumps.
 
 Instrumented layers and their STABLE metric names (tests pin these):
 
@@ -64,7 +73,7 @@ Typical use::
 
 from __future__ import annotations
 
-from . import flight_recorder, metrics  # noqa: F401
+from . import flight_recorder, metrics, tracing  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     dump as dump_flight_recorder,
@@ -78,6 +87,17 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     format_metrics,
     registry,
+)
+from .tracing import (  # noqa: F401
+    SPAN_NAMES,
+    Span,
+    current_trace_id,
+    dump_trace,
+    event,
+    instant,
+    record_span,
+    span,
+    start_span,
 )
 
 
@@ -104,4 +124,6 @@ __all__ = [
     "registry", "snapshot", "dump_json", "dump_prometheus",
     "format_metrics", "flight_recorder_instance", "dump_flight_recorder",
     "install_excepthook", "metrics", "flight_recorder",
+    "tracing", "SPAN_NAMES", "Span", "span", "start_span", "record_span",
+    "instant", "event", "dump_trace", "current_trace_id",
 ]

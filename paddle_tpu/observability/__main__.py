@@ -1,0 +1,40 @@
+"""``python -m paddle_tpu.observability`` — scrape-and-debug entry point.
+
+Prints the process-wide observability dumps: Prometheus text exposition
+(``prometheus``), the JSON metrics snapshot (``json``), the Chrome-trace
+span dump (``trace``), or all three (default). Mostly useful under
+``-i`` / in a notebook kernel or subprocess that has already imported
+paddle_tpu and done work — a fresh interpreter only shows import-time
+activity, which is still a handy smoke test that the registries and the
+taxonomy are wired.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import dump_json, dump_prometheus, dump_trace
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability",
+        description="print paddle_tpu observability dumps")
+    p.add_argument("what", nargs="?", default="all",
+                   choices=("prometheus", "json", "trace", "all"),
+                   help="which dump to print (default: all)")
+    p.add_argument("--indent", type=int, default=2,
+                   help="JSON indent for json/trace dumps (default: 2)")
+    args = p.parse_args(argv)
+    if args.what in ("prometheus", "all"):
+        sys.stdout.write(dump_prometheus())
+    if args.what in ("json", "all"):
+        sys.stdout.write(dump_json(indent=args.indent) + "\n")
+    if args.what in ("trace", "all"):
+        sys.stdout.write(dump_trace(indent=args.indent) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

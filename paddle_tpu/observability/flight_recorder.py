@@ -115,8 +115,13 @@ class FlightRecorder:
         f = file if file is not None else sys.stderr
         ents = self.entries()
         n = len(ents)
+        # the ambient trace_id (tracing.py) correlates this op-level ring
+        # with the request/step span timeline in a crash report
+        from . import tracing as _tracing
+        tid = _tracing.current_trace_id()
+        tid_s = f" trace_id={tid:016x}" if tid else ""
         f.write(f"[paddle_tpu flight recorder] last {n} of "
-                f"{self._i} op dispatches (newest last):\n")
+                f"{self._i} op dispatches{tid_s} (newest last):\n")
         for seq, ts, tid, op, args_info, key in ents:
             args_s = ", ".join(_fmt_arg(a) for a in args_info) \
                 if args_info else "-"
@@ -219,6 +224,11 @@ def _excepthook(exc_type, exc_value, exc_tb) -> None:
             _crash_dump()
         except Exception:
             pass  # the original traceback must always still print
+        try:
+            from . import tracing as _tracing
+            _tracing._crash_dump()
+        except Exception:
+            pass  # same contract: the traceback outranks the span dump
     (_prev_excepthook or sys.__excepthook__)(exc_type, exc_value, exc_tb)
 
 

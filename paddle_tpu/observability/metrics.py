@@ -104,6 +104,8 @@ METRIC_NAMES = frozenset({
     "fleet.submitted", "fleet.completed", "fleet.retries", "fleet.sheds",
     "fleet.rerouted_requests", "fleet.replica_deaths", "fleet.drains",
     "fleet.restarts", "fleet.affinity_hits", "fleet.handoff_seconds",
+    # observability/tracing.py (end-to-end span subsystem)
+    "tracing.spans", "tracing.events",
     # this module's ambient gauges + jax.monitoring listener
     "device.live_array_bytes", "device.live_arrays", "device.count",
     "jit.compiles", "jit.compile_seconds",

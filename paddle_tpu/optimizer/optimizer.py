@@ -20,6 +20,7 @@ import numpy as np
 
 from .. import flags as _flags
 from ..core.tensor import Tensor
+from ..observability import tracing as _tracing
 from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
@@ -186,6 +187,7 @@ class Optimizer:
             idxs.append(i)
         if not params:
             return
+        _t0_ns = _tracing.now_ns()
         if self._grad_clip is not None:
             pg = self._grad_clip(list(zip(params, grads)))
             grads = [g for _, g in pg]
@@ -280,6 +282,12 @@ class Optimizer:
                     arr = jax.device_put(arr, orig)
             p._set_data(arr)
             self._states[i] = new_s[k]
+        # retroactive (a with-block would re-indent the whole rule):
+        # under step-capture this lands inside the step_capture span
+        _tracing.record_span(
+            "optimizer.update", _t0_ns, _tracing.now_ns(),
+            trace=_tracing.current(),
+            attrs={"params": len(params), "step": self._step_count})
 
     def _update_static_key(self):
         """Hashable config that changes the compiled update rule."""

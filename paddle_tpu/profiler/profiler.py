@@ -46,6 +46,7 @@ class TracerEventType(Enum):
     PythonUserDefined = 8
     UserDefined = 9
     StepCapture = 10   # whole-step captured executable (jit/step_capture)
+    Trace = 11         # observability.tracing spans merged into the window
 
 
 # -- host event recorder ------------------------------------------------------
@@ -138,6 +139,14 @@ def _op_span_hook(op_name: str):
     else:
         et = TracerEventType.Operator
     return RecordEvent(op_name, et)
+
+
+def _trace_span_sink(sp):
+    # completed observability.tracing spans land in the open window as
+    # host events; instants become zero-width spans (visible as marks)
+    _recorder.record(_HostEvent(
+        sp.name, sp.t0_ns, sp.t1_ns if sp.t1_ns is not None else sp.t0_ns,
+        sp.tid, TracerEventType.Trace))
 
 
 # -- scheduler ----------------------------------------------------------------
@@ -374,8 +383,12 @@ class Profiler:
     # -- tracer control ------------------------------------------------------
     def _start_tracers(self):
         from ..ops import dispatcher
+        from ..observability import tracing
         _recorder.start()
         dispatcher.set_op_span_hook(_op_span_hook)
+        # merge always-on request/step spans into this window's timeline
+        # (same perf_counter_ns timebase as RecordEvent spans)
+        tracing.set_span_sink(_trace_span_sink)
         if ProfilerTarget.TPU in self.targets or \
                 ProfilerTarget.GPU in self.targets:
             try:
@@ -389,6 +402,8 @@ class Profiler:
 
     def _stop_tracers(self):
         from ..ops import dispatcher
+        from ..observability import tracing
+        tracing.set_span_sink(None)
         dispatcher.set_op_span_hook(None)
         events = _recorder.stop()
         had_device_trace = self._device_tracing

@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ...models.serving import QueueFull
 from ...observability import metrics as _metrics
+from ...observability import tracing as _tracing
 from ..resilience.engine import ResilientServingEngine
 
 __all__ = ["FinishedInfo", "ReplicaHandle", "ReplicaUnavailable",
@@ -392,6 +393,12 @@ class SubprocessReplicaHandle(ReplicaHandle):
               "n": int(max_new_tokens), "handoff": bool(handoff)}
         if out_tokens:
             op["toks"] = [int(t) for t in out_tokens]
+        tc = _tracing.inject()
+        if tc is not None:
+            # carry the router's ambient trace context across the
+            # process boundary: the worker re-activates it around
+            # add_request, so the child's spans share our trace_id
+            op["tc"] = tc
         try:
             self._proc.stdin.write(json.dumps(op) + "\n")
             self._proc.stdin.flush()

@@ -53,6 +53,7 @@ import numpy as np
 
 from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
+from ...observability import tracing as _tracing
 from ..resilience.journal import RequestJournal
 from .health import ReplicaHealth, ReplicaState
 from .replica import FinishedInfo, QueueFull, ReplicaHandle, \
@@ -113,6 +114,10 @@ class _Outstanding:
     replica: str
     t_submit: float
     handoffs: int = 0
+    # the submit span's (trace_id, span_id): failover re-activates it
+    # around the re-submission so the replayed request keeps its
+    # ORIGINAL trace across replicas and processes
+    trace: Optional[Tuple[int, int]] = None
 
 
 def _affinity_digest(prompt, block_size: int) -> bytes:
@@ -257,61 +262,74 @@ class ReplicaRouter:
         hints: List[float] = []
         attempt = 0
         key = _affinity_digest(prompt, self._block_size)
-        while True:
-            ready = self._ready_names()
-            if ready:
-                est = self._est_queue_wait_s()
-                if (self._slo_ttft_s is not None and est is not None
-                        and est > self._slo_ttft_s):
-                    self._shed(hints, est)
-                order = _rendezvous_order(key, ready)
-                for pick, name in enumerate(order):
-                    gid = self._next_gid
-                    try:
-                        self._replicas[name].submit(
-                            gid, prompt, max_new_tokens)
-                    except QueueFull as e:
-                        if e.retry_after_hint:
-                            hints.append(float(e.retry_after_hint))
-                        continue
-                    except ReplicaUnavailable:
-                        # transport died under us. poll() only fails
-                        # over on a died-NOW transition, and observe()
-                        # reports (DEAD, False) for a replica already
-                        # DEAD — so if this mark performs the
-                        # transition, settle the victim's journaled
-                        # work here or it never gets settled at all
-                        if self._health[name].mark_dead():
-                            self._failover(name)
-                        continue
-                    self._next_gid = gid + 1
-                    self._outstanding[gid] = _Outstanding(
-                        gid, [int(t) for t in prompt],
-                        int(max_new_tokens), name, time.monotonic())
-                    self.requests[gid] = ([int(t) for t in prompt],
-                                          int(max_new_tokens))
-                    _M_SUBMITTED.inc()
-                    if pick == 0:
-                        _M_AFF_HITS.inc()
-                    return gid
-            attempt += 1
-            now = time.monotonic()
-            if now >= deadline:
-                self._shed(hints, None)
-            self.retries += 1
-            _M_RETRIES.inc()
-            # poll while waiting: finishes free slots, deaths fail over
-            self.poll()
-            sleep = min(self._backoff_max_s,
-                        self._backoff_base_s * (2 ** (attempt - 1)))
-            sleep *= 0.5 + self._rng.random()          # jitter
-            time.sleep(max(0.0, min(sleep, deadline - now)))
+        # ACTIVATED root span: handle.submit below runs inside it, so
+        # the replica's admission (same thread or via the injected
+        # frame) parents onto THIS trace — the one id that follows the
+        # request through every process it touches
+        with _tracing.span("fleet.submit") as _sp:
+            while True:
+                ready = self._ready_names()
+                if ready:
+                    est = self._est_queue_wait_s()
+                    if (self._slo_ttft_s is not None and est is not None
+                            and est > self._slo_ttft_s):
+                        self._shed(hints, est)
+                    order = _rendezvous_order(key, ready)
+                    for pick, name in enumerate(order):
+                        gid = self._next_gid
+                        try:
+                            self._replicas[name].submit(
+                                gid, prompt, max_new_tokens)
+                        except QueueFull as e:
+                            _sp.event("fleet.queue_full", replica=name)
+                            if e.retry_after_hint:
+                                hints.append(float(e.retry_after_hint))
+                            continue
+                        except ReplicaUnavailable:
+                            # transport died under us. poll() only fails
+                            # over on a died-NOW transition, and observe()
+                            # reports (DEAD, False) for a replica already
+                            # DEAD — so if this mark performs the
+                            # transition, settle the victim's journaled
+                            # work here or it never gets settled at all
+                            if self._health[name].mark_dead():
+                                self._failover(name)
+                            continue
+                        self._next_gid = gid + 1
+                        self._outstanding[gid] = _Outstanding(
+                            gid, [int(t) for t in prompt],
+                            int(max_new_tokens), name, time.monotonic(),
+                            trace=(_sp.context if _sp.trace_id else None))
+                        self.requests[gid] = ([int(t) for t in prompt],
+                                              int(max_new_tokens))
+                        _M_SUBMITTED.inc()
+                        if pick == 0:
+                            _M_AFF_HITS.inc()
+                        _sp.set(gid=gid, replica=name, pick=pick)
+                        return gid
+                attempt += 1
+                now = time.monotonic()
+                if now >= deadline:
+                    self._shed(hints, None)
+                self.retries += 1
+                _M_RETRIES.inc()
+                _sp.event("fleet.retry", attempt=attempt)
+                # poll while waiting: finishes free slots, deaths fail over
+                self.poll()
+                sleep = min(self._backoff_max_s,
+                            self._backoff_base_s * (2 ** (attempt - 1)))
+                sleep *= 0.5 + self._rng.random()          # jitter
+                time.sleep(max(0.0, min(sleep, deadline - now)))
 
     def _shed(self, hints: List[float], est: Optional[float]) -> None:
         self.sheds += 1
         _M_SHEDS.inc()
         after = max(hints) if hints else (est if est is not None
                                           else self._backoff_max_s)
+        # annotates the ambient fleet.submit span (submit is the only
+        # caller), so a shed trace shows WHY: deadline vs SLO estimate
+        _tracing.event("fleet.shed", retry_after_s=round(after, 4),
+                       slo_est=None if est is None else round(est, 4))
         raise FleetShed(
             f"fleet is at capacity: retry after ~{after:.3f}s",
             retry_after_s=after)
@@ -415,6 +433,8 @@ class ReplicaRouter:
         victims = sorted((o for o in self._outstanding.values()
                           if o.replica == name), key=lambda o: o.gid)
         _record("fleet.replica_death", (name, len(victims)))
+        _tracing.instant("fleet.replica_dead",
+                         attrs={"replica": name, "victims": len(victims)})
         if not victims:
             _M_HANDOFF.observe(time.monotonic() - t0)
             return
@@ -441,8 +461,17 @@ class ReplicaRouter:
                     self._completions.append(time.monotonic())
                     _M_COMPLETED.inc()
                 self._outstanding.pop(info.gid, None)
+                _tracing.instant(
+                    "fleet.failover", trace=info.trace,
+                    attrs={"gid": info.gid, "replica": name,
+                           "disposition": "delivered_from_journal"})
             else:
                 self._parked.append((info, toks))
+                _tracing.instant(
+                    "fleet.failover", trace=info.trace,
+                    attrs={"gid": info.gid, "replica": name,
+                           "disposition": "parked",
+                           "watermark": len(toks)})
         self._place_parked()
         _M_HANDOFF.observe(time.monotonic() - t0)
 
@@ -458,21 +487,32 @@ class ReplicaRouter:
         for info, toks in self._parked:
             key = _affinity_digest(info.prompt, self._block_size)
             placed = False
-            for name in _rendezvous_order(key, ready):
-                try:
-                    self._replicas[name].submit(
-                        info.gid, info.prompt, info.max_new_tokens,
-                        out_tokens=toks or None, handoff=True)
-                except (QueueFull, ReplicaUnavailable):
-                    continue
-                info.replica = name
-                info.handoffs += 1
-                self.rerouted_requests += 1
-                _M_REROUTED.inc()
-                _record("fleet.handoff",
-                        (info.gid, name, len(toks)))
-                placed = True
-                break
+            # re-activate the ORIGINAL submit trace around the
+            # re-submission: the survivor's admission spans carry the
+            # request's one trace_id, not a fresh root
+            _tok = _tracing.activate(info.trace)
+            try:
+                for name in _rendezvous_order(key, ready):
+                    try:
+                        self._replicas[name].submit(
+                            info.gid, info.prompt, info.max_new_tokens,
+                            out_tokens=toks or None, handoff=True)
+                    except (QueueFull, ReplicaUnavailable):
+                        continue
+                    info.replica = name
+                    info.handoffs += 1
+                    self.rerouted_requests += 1
+                    _M_REROUTED.inc()
+                    _record("fleet.handoff",
+                            (info.gid, name, len(toks)))
+                    _tracing.instant(
+                        "fleet.handoff", trace=info.trace,
+                        attrs={"gid": info.gid, "replica": name,
+                               "watermark": len(toks)})
+                    placed = True
+                    break
+            finally:
+                _tracing.deactivate(_tok)
             if not placed:
                 still.append((info, toks))
         self._parked = still
@@ -504,9 +544,11 @@ class ReplicaRouter:
                 continue
             _M_DRAINS.inc()
             _record("fleet.drain", (name,))
+            _tracing.instant("fleet.drain", attrs={"replica": name})
             handle.restart()           # same root: recovers own journal
             health.reset()
             _M_RESTARTS.inc()
+            _tracing.instant("fleet.restart", attrs={"replica": name})
             deadline = time.monotonic() + ready_timeout_s
             ok = False
             while time.monotonic() < deadline:

@@ -9,8 +9,11 @@ is the config::
      "hb_interval_s": 0.2, "step_sleep_s": 0.0}
 
 then ops, one per line: ``{"op":"submit","gid":G,"prompt":[...],
-"n":N,"handoff":bool,"toks":[...]?}`` | ``{"op":"drain"}`` |
-``{"op":"stop"}``. Events go to stdout, one JSON per line:
+"n":N,"handoff":bool,"toks":[...]?,"tc":[hex,hex]?}`` |
+``{"op":"drain"}`` | ``{"op":"stop"}``. ``tc`` is the router's trace
+context (observability.tracing.inject): the worker re-activates it
+around the admission so one trace_id spans both processes. Events go
+to stdout, one JSON per line:
 
 * ``{"ev":"ready","phase":...}`` — warmup (or recovery's first step)
   done; the parent's health machine flips STARTING→READY on it
@@ -45,6 +48,19 @@ def _build_model(spec: str):
     return getattr(importlib.import_module(mod), attr)()
 
 
+def _dump_trace_file(root: str) -> None:
+    """Land this replica's span ring as ``<root>/trace.json`` on clean
+    exit, next to the journal — the router-side merge/debug artifact
+    (a SIGKILLed replica leaves no dump, exactly like its journal tail:
+    the survivors' dumps carry the handed-off trace)."""
+    from ...observability import tracing
+    try:
+        if tracing.enabled():
+            tracing.dump_trace(os.path.join(root, "trace.json"))
+    except OSError:
+        pass               # the dump is advisory; exit codes stay honest
+
+
 def main() -> int:
     cfg = json.loads(sys.stdin.readline())
     hb_interval = float(cfg.get("hb_interval_s", 0.2))
@@ -53,6 +69,7 @@ def main() -> int:
 
     from ...models.serving import QueueFull
     from ...observability import metrics as _metrics
+    from ...observability import tracing as _tracing
     from ..resilience.engine import ResilientServingEngine
     from .replica import _finish_timing
 
@@ -128,6 +145,10 @@ def main() -> int:
                           "hint": qw.quantile(0.5)
                           if qw is not None else None})
                     continue
+                # re-establish the router's trace context (the "tc"
+                # frame field) so this admission's spans — and the
+                # request's whole engine-side life — carry ITS trace_id
+                tc_tok = _tracing.activate(_tracing.extract(op.get("tc")))
                 try:
                     eng.add_request(op["prompt"],
                                     max_new_tokens=int(op["n"]),
@@ -137,6 +158,8 @@ def main() -> int:
                     emit({"ev": "full", "gid": gid,
                           "hint": e.retry_after_hint})
                     continue
+                finally:
+                    _tracing.deactivate(tc_tok)
                 emit({"ev": "ack", "gid": gid})
             elif kind == "drain":
                 drain_req = True
@@ -144,12 +167,14 @@ def main() -> int:
                 stop_req = True
         if stop_req:
             eng.close()
+            _dump_trace_file(cfg["root"])
             return 0
         if drain_req:
             eng.drain()
             flush_finished()
             emit({"ev": "drained"})
             eng.close()
+            _dump_trace_file(cfg["root"])
             return 64
         if eng.has_work:
             eng.step()

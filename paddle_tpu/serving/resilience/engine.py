@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ...models.serving import ContinuousBatchingEngine
 from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
+from ...observability import tracing as _tracing
 from .journal import RequestJournal
 from .warm_cache import (_model_fingerprint, last_generation,
                          load_prefix_cache, snapshot_prefix_cache)
@@ -194,6 +195,12 @@ class ResilientServingEngine:
 
     # -- recovery ------------------------------------------------------------
     def _recover(self, state, warm_start: bool) -> None:
+        with _tracing.span("serving.recover") as _sp:
+            self._recover_inner(state, warm_start)
+            _sp.set(replayed=self.replayed_requests,
+                    finished=self.recovered_finished)
+
+    def _recover_inner(self, state, warm_start: bool) -> None:
         if warm_start:
             self.warm_blocks = load_prefix_cache(self.engine, self.warm_root)
         for rec in sorted(state.requests.values(), key=lambda r: r.rid):
@@ -240,18 +247,25 @@ class ResilientServingEngine:
         durably acked somewhere."""
         if self.drained:
             raise RuntimeError("engine is drained: relaunch to serve")
-        rid = self.engine.add_request(prompt, max_new_tokens=max_new_tokens,
-                                      rid=rid, out_tokens=out_tokens)
-        req = self.engine.results[rid]
-        self.journal.append({
-            "t": "admit", "rid": rid,
-            "prompt": [int(x) for x in req.prompt],
-            "max_new_tokens": int(max_new_tokens)})
-        if out_tokens:
+        # ACTIVATED span: the inner Request captures this context (its
+        # queue/prefill/decode phases join the trace) and the journal's
+        # fsync span nests under it — together they place the durable
+        # ack point on the request's timeline
+        with _tracing.span("serving.admit") as _sp:
+            rid = self.engine.add_request(prompt,
+                                          max_new_tokens=max_new_tokens,
+                                          rid=rid, out_tokens=out_tokens)
             self.journal.append({
-                "t": "tokens", "rid": rid, "from": 0,
-                "toks": [int(t) for t in out_tokens]})
-        self.journal.flush()
+                "t": "admit", "rid": rid,
+                "prompt": [int(x)
+                           for x in self.engine.results[rid].prompt],
+                "max_new_tokens": int(max_new_tokens)})
+            if out_tokens:
+                self.journal.append({
+                    "t": "tokens", "rid": rid, "from": 0,
+                    "toks": [int(t) for t in out_tokens]})
+            self.journal.flush()
+            _sp.set(rid=rid, resumed=bool(out_tokens))
         self._watermark[rid] = len(out_tokens) if out_tokens else 0
         return rid
 
@@ -456,6 +470,7 @@ class ResilientServingEngine:
         deadline = self.drain_deadline_s if deadline_s is None \
             else float(deadline_s)
         t0 = time.monotonic()
+        _sp_drain = _tracing.start_span("serving.drain")
         self._draining = True
         # the watchdog's job is over: this IS the clean exit, and the
         # commit+snapshot tail below must not be misread as a hang
@@ -480,6 +495,8 @@ class ResilientServingEngine:
             self.snapshot()
         self.drained = True
         dt = time.monotonic() - t0
+        _sp_drain.set(remaining=remaining,
+                      pending=len(self.engine.pending)).end()
         _M_DRAINS.inc()
         _M_DRAIN_SECONDS.observe(dt)
         _record("serving.resilience.drain",
@@ -508,9 +525,11 @@ class ResilientServingEngine:
                     if not self._hang.is_set():
                         self._hang.set()
                         _M_HANGS.inc()
-                        _record("serving.resilience.step_hang",
-                                (round(time.monotonic()
-                                       - self._last_progress, 3),))
+                        stalled = round(time.monotonic()
+                                        - self._last_progress, 3)
+                        _record("serving.resilience.step_hang", (stalled,))
+                        _tracing.instant("serving.step_hang",
+                                         attrs={"stalled_s": stalled})
                     if self._hang_exit:
                         # the main thread is wedged inside a device call
                         # and can never poll(): the journal already holds

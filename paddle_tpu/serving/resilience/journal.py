@@ -61,6 +61,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ...observability import metrics as _metrics
+from ...observability import tracing as _tracing
 from ...utils.durability import (COMMIT_FILE, fsync_write,
                                  read_committed_marker,
                                  write_committed_marker)
@@ -211,16 +212,18 @@ class RequestJournal:
         tmp+fsync+rename — all-or-nothing, never a prefix."""
         if not self._buffer:
             return
-        lines = "".join(json.dumps(r, separators=(",", ":")) + "\n"
-                        for r in self._buffer)
-        payload = lines.encode()
-        path = os.path.join(
-            self.root,
-            f"{_SEG_PREFIX}{self._next_seg:08d}-{self._uid}.jsonl")
-        fsync_write(path, lambda f: f.write(payload))
-        self._next_seg += 1
-        self._buffer.clear()
-        _M_FLUSHES.inc()
+        with _tracing.span("serving.journal_fsync",
+                           attrs={"records": len(self._buffer)}):
+            lines = "".join(json.dumps(r, separators=(",", ":")) + "\n"
+                            for r in self._buffer)
+            payload = lines.encode()
+            path = os.path.join(
+                self.root,
+                f"{_SEG_PREFIX}{self._next_seg:08d}-{self._uid}.jsonl")
+            fsync_write(path, lambda f: f.write(payload))
+            self._next_seg += 1
+            self._buffer.clear()
+            _M_FLUSHES.inc()
 
     def commit(self, **extra: Any) -> None:
         """Flush, then mark the journal cleanly drained (COMMITTED

@@ -508,6 +508,72 @@ class TestTaxonomyRule:
 
 
 # ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class TestSpansRule:
+    SPANS = ('SPAN_NAMES = frozenset({"fleet.submit", '
+             '"serving.first_token"})\n',)
+
+    def _check(self, body):
+        return check_src(
+            body, ["spans"],
+            extra_files=[("tracing.py", self.SPANS[0])])
+
+    def test_member_literal_is_clean(self):
+        assert self._check(
+            'import t\nwith t.span("fleet.submit"):\n    pass\n') == []
+
+    def test_typo_fires(self):
+        fs = self._check(
+            'import t\nwith t.span("fleet.submt"):\n    pass\n')
+        assert len(fs) == 1 and "taxonomy fork" in fs[0].message
+
+    def test_every_callee_is_covered(self):
+        for call in ('t.start_span("nope.x")',
+                     't.record_span("nope.x", 0, 1)',
+                     't.instant("nope.x")',
+                     'sp.event("nope.x")'):
+            fs = self._check(f'import t\n{call}\n')
+            assert len(fs) == 1, call
+
+    def test_fstring_in_name_position_fires(self):
+        fs = self._check(
+            'import t\ndef f(g):\n    t.instant(f"fleet.{g}")\n')
+        assert len(fs) == 1 and "f-string" in fs[0].message
+
+    def test_name_keyword_is_checked(self):
+        fs = self._check('import t\nt.instant(name="nope.x")\n')
+        assert len(fs) == 1
+
+    def test_attrs_are_not_checked(self):
+        assert self._check(
+            'import t\ndef f(e):\n'
+            '    t.instant("serving.first_token", '
+            'attrs={"why": f"bad {e}"})\n') == []
+
+    def test_unrelated_span_callables_checked_by_terminal_name_only(self):
+        # threading.Event() etc. don't collide: the terminal names are
+        # case-sensitive and the argument must be a string literal
+        assert self._check(
+            'import threading\nev = threading.Event()\nev.set()\n') == []
+
+    def test_suppression_with_justification(self):
+        assert self._check(
+            'import t\nt.instant("nope.x")'
+            '  # graftcheck: disable=spans -- exercising the validator\n'
+        ) == []
+
+    def test_frozen_set_actually_exists_in_package(self):
+        from paddle_tpu.observability.tracing import SPAN_NAMES
+        for name in ("fleet.submit", "serving.admit",
+                     "serving.journal_fsync", "serving.first_token",
+                     "step_capture.replay", "optimizer.update",
+                     "checkpoint.commit", "jit.compile"):
+            assert name in SPAN_NAMES, name
+
+
+# ---------------------------------------------------------------------------
 # hygiene: silent-except + test-flag-restore
 # ---------------------------------------------------------------------------
 
@@ -715,7 +781,7 @@ class TestCli:
         assert cli_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rid in ("capture-safety", "donation-safety", "trace-purity",
-                    "compat-shim", "taxonomy", "silent-except",
+                    "compat-shim", "taxonomy", "spans", "silent-except",
                     "test-flag-restore", "durability", "timeouts"):
             assert rid in out
 
