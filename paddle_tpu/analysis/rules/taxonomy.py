@@ -20,7 +20,18 @@ verifies
   flagged too (parameterize via the ``detail`` argument instead);
 * every literal metric name registered through
   ``...registry().counter/gauge/histogram("name", ...)`` is a member of
-  ``METRIC_NAMES``.
+  ``METRIC_NAMES``;
+* every METRIC_NAMES entry is registered SOMEWHERE in the analyzed
+  sources — a frozen name nothing registers is a dead scrape series
+  (the taxonomy rotted past the code). Liveness collection is
+  deliberately liberal: any ``.counter/.gauge/.histogram("name", ...)``
+  call counts (whatever the receiver is spelled as), and a
+  ``"prefix." + var`` first argument marks every taxonomy member with
+  that prefix live (the loop-registration idiom in jit/step_capture.py
+  and autograd/engine.py). The dead check only arms when the run
+  includes registration sites in at least two files besides the one
+  defining METRIC_NAMES — scoping a run to a file or two must not
+  spray false "dead" findings.
 
 Non-literal arguments are skipped: they were literals somewhere else,
 where this rule saw them. User code registering its own metrics is out
@@ -82,11 +93,21 @@ class TaxonomyRule(Rule):
             "constant")
     profiles = ("src",)
 
+    # files (beyond the METRIC_NAMES definer) that must carry
+    # registration sites before the dead-entry check arms
+    MIN_REG_FILES = 2
+
     def __init__(self):
         self.reasons: Set[str] = set()
         self.metric_names: Set[str] = set()
         self.saw_reason_set = False
         self.saw_metric_set = False
+        # liveness state for the dead-entry check
+        self.registered: Set[str] = set()          # literal names
+        self.registered_prefixes: Set[str] = set()  # "prefix." + var sites
+        self.reg_files: Set[str] = set()
+        # METRIC_NAMES definition sites: sf.path -> {name: lineno}
+        self.metric_defs: Dict[str, Dict[str, int]] = {}
 
     def begin(self, files: Sequence[SourceFile]) -> None:
         for sf in files:
@@ -105,6 +126,26 @@ class TaxonomyRule(Rule):
                 elif t.id == "METRIC_NAMES":
                     self.metric_names |= vals
                     self.saw_metric_set = True
+                    defs = self.metric_defs.setdefault(sf.path, {})
+                    for e in node.value.args[0].elts:
+                        defs[e.value] = e.lineno
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    self._collect_registration(sf, node)
+
+    def _collect_registration(self, sf: SourceFile, call: ast.Call) -> None:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _METRIC_METHODS):
+            return
+        arg = call.args[0] if call.args else None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            self.registered.add(arg.value)
+            self.reg_files.add(sf.path)
+        elif (isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add)
+                and isinstance(arg.left, ast.Constant)
+                and isinstance(arg.left.value, str)):
+            self.registered_prefixes.add(arg.left.value)
+            self.reg_files.add(sf.path)
 
     def check(self, sf: SourceFile) -> Iterator[Finding]:
         for node in ast.walk(sf.tree):
@@ -112,6 +153,7 @@ class TaxonomyRule(Rule):
                 continue
             yield from self._check_reason_site(sf, node)
             yield from self._check_metric_site(sf, node)
+        yield from self._check_dead_entries(sf)
 
     def _check_reason_site(self, sf, call) -> Iterator[Finding]:
         if not self.saw_reason_set:
@@ -141,6 +183,26 @@ class TaxonomyRule(Rule):
                     f"reason {arg.value!r} passed to {name}() is not a "
                     f"member of any *_REASONS frozen set — taxonomy fork "
                     f"(typo?) or a missing registration")
+
+    def _check_dead_entries(self, sf: SourceFile) -> Iterator[Finding]:
+        """Emitted against the file DEFINING METRIC_NAMES (each dead
+        entry's own line), once the run plausibly spans the framework
+        tree — see the module docstring's arming condition."""
+        defs = self.metric_defs.get(sf.path)
+        if not defs:
+            return
+        if len(self.reg_files - {sf.path}) < self.MIN_REG_FILES:
+            return
+        for name in sorted(defs):
+            if name in self.registered:
+                continue
+            if any(name.startswith(p) for p in self.registered_prefixes):
+                continue
+            yield self.finding(
+                sf, defs[name],
+                f"METRIC_NAMES entry {name!r} is registered by no "
+                f"analyzed source — dead taxonomy entry: delete it or "
+                f"register the instrument it promises")
 
     def _check_metric_site(self, sf, call) -> Iterator[Finding]:
         if not self.saw_metric_set or not _is_metric_registration(call):

@@ -216,6 +216,12 @@ define_flag("tracing_path", "",
             "crash-dump destination for the span trace (Chrome-trace "
             "JSON, written next to the flight recorder dump on uncaught "
             "exception); empty = human-readable listing to stderr")
+define_flag("telemetry_port", -1,
+            "ops endpoint (observability/exporter.py): port for the "
+            "stdlib-http /metrics /healthz /statusz /trace server; "
+            "-1 (default) = off, 0 = pick a free port, >0 = bind that "
+            "port. The server starts on the first fleet/engine attach "
+            "(or explicit observability.serve_telemetry())")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")
 define_flag("rng_impl", "rbg",

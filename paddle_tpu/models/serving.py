@@ -48,7 +48,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -124,6 +124,23 @@ _M_QWAIT = _M.histogram(
 _M_REJECTED = _M.counter(
     "serving.rejected", "requests rejected at intake (queue full)")
 
+# per-tenant children of the admission counters, cached so the hot path
+# pays one dict hit instead of the registry lock. Tenant cardinality is
+# the caller's contract — these are billing/SLO attribution labels, not
+# a per-request id.
+_TENANT_COUNTERS: Dict[Tuple[str, str], Any] = {}
+
+
+def _inc_tenant(name: str, tenant: Optional[str]) -> None:
+    if tenant is None:
+        return
+    key = (name, tenant)
+    c = _TENANT_COUNTERS.get(key)
+    if c is None:
+        c = _M.counter(name, labels={"tenant": tenant})
+        _TENANT_COUNTERS[key] = c
+    c.inc()
+
 
 @dataclass
 class Request:
@@ -145,6 +162,7 @@ class Request:
     t_first: Optional[float] = None
     t_done: Optional[float] = None
     n_replayed: int = 0                # tokens emitted by a previous process
+    tenant: Optional[str] = None       # labels the admission counters
     _registered_upto: int = 0          # prompt blocks published to the cache
     # -- tracing (observability/tracing.py): the ambient trace context at
     # intake plus perf_counter_ns edge stamps, so the engine records the
@@ -376,13 +394,16 @@ class ContinuousBatchingEngine:
     # -- request intake ------------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32, *,
                     rid: Optional[int] = None,
-                    out_tokens: Optional[List[int]] = None) -> int:
+                    out_tokens: Optional[List[int]] = None,
+                    tenant: Optional[str] = None) -> int:
         """Queue a request. ``rid``/``out_tokens`` are the journal-replay
         re-admission hooks (serving/resilience): a recovered request must
         keep its ORIGINAL rid (the sampling stream folds it — a fresh rid
         would draw a different continuation) and resumes from its already
         committed output tokens exactly like a preempted row
-        (recompute-on-resume re-derives the lost KV by prefill)."""
+        (recompute-on-resume re-derives the lost KV by prefill).
+        ``tenant`` additionally counts the admission/rejection on a
+        tenant-labeled child of the serving counters."""
         if rid is None:
             # the queue bound governs NEW traffic only: a journal-replay
             # re-admission (rid given) was already durably acked by a
@@ -392,6 +413,7 @@ class ContinuousBatchingEngine:
             if (self.max_queue is not None
                     and len(self.pending) >= self.max_queue):
                 _M_REJECTED.inc()
+                _inc_tenant("serving.rejected", tenant)
                 raise QueueFull(
                     f"admission queue is full ({len(self.pending)}/"
                     f"{self.max_queue} pending): shed load or retry later",
@@ -401,7 +423,7 @@ class ContinuousBatchingEngine:
             raise ValueError(f"rid {rid} already journaled to this engine")
         self._next_rid = max(self._next_rid, rid + 1)
         req = Request(rid, np.asarray(prompt, np.int32).reshape(-1),
-                      max_new_tokens)
+                      max_new_tokens, tenant=tenant)
         if out_tokens:
             if len(out_tokens) >= max_new_tokens:
                 raise ValueError(
@@ -553,6 +575,7 @@ class ContinuousBatchingEngine:
             req.ctx = n_use * self.block_size
             self.cache.context_lens[i] = req.ctx
             _M_ADMITTED.inc()
+            _inc_tenant("serving.admitted", req.tenant)
             if n_use:
                 _M_PC_HIT.inc(n_use)
                 _M_PC_SHARED.inc(n_use * self.block_size)

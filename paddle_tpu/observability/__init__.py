@@ -19,7 +19,15 @@ the process been doing?" at ALL times, at near-zero cost:
   cross-process, recorded into a bounded ring and exported as
   Chrome-trace JSON (:func:`dump_trace`), gated by ``FLAGS_tracing``.
   Span names are frozen in :data:`tracing.SPAN_NAMES` exactly like the
-  metric names below (graftcheck rule ``spans``).
+  metric names below (graftcheck rule ``spans``);
+* a **live ops endpoint** (:mod:`.exporter`) — a stdlib-HTTP thread
+  serving ``/metrics`` (Prometheus text), ``/healthz`` (fleet/engine
+  readiness), ``/statusz`` (flags, versions, replica table, flight-
+  recorder tail) and ``/trace`` (Chrome-trace JSON), gated by
+  ``FLAGS_telemetry_port`` (-1 off, 0 free port). On a fleet router
+  one scrape shows every replica: workers piggyback registry deltas on
+  their heartbeats and the router merges them under a
+  ``replica="<name>"`` label.
 
 ``python -m paddle_tpu.observability`` prints all three dumps.
 
@@ -74,6 +82,14 @@ Typical use::
 from __future__ import annotations
 
 from . import flight_recorder, metrics, tracing  # noqa: F401
+from . import exporter  # noqa: F401  (after its siblings: it uses all three)
+from .exporter import (  # noqa: F401
+    TelemetryServer,
+    attach_engine as attach_telemetry_engine,
+    attach_fleet as attach_telemetry_fleet,
+    serve as serve_telemetry,
+    shutdown as shutdown_telemetry,
+)
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     dump as dump_flight_recorder,
@@ -126,4 +142,6 @@ __all__ = [
     "install_excepthook", "metrics", "flight_recorder",
     "tracing", "SPAN_NAMES", "Span", "span", "start_span", "record_span",
     "instant", "event", "dump_trace", "current_trace_id",
+    "exporter", "TelemetryServer", "serve_telemetry", "shutdown_telemetry",
+    "attach_telemetry_fleet", "attach_telemetry_engine",
 ]

@@ -104,6 +104,11 @@ METRIC_NAMES = frozenset({
     "fleet.submitted", "fleet.completed", "fleet.retries", "fleet.sheds",
     "fleet.rerouted_requests", "fleet.replica_deaths", "fleet.drains",
     "fleet.restarts", "fleet.affinity_hits", "fleet.handoff_seconds",
+    "fleet.replica_state",
+    # observability/exporter.py (scrape-time RED SLIs + self-instrumentation)
+    "fleet.sli.availability", "fleet.sli.shed_rate",
+    "fleet.sli.ttft_p99_seconds", "fleet.sli.tpot_p99_seconds",
+    "telemetry.scrapes", "telemetry.scrape_seconds",
     # observability/tracing.py (end-to-end span subsystem)
     "tracing.spans", "tracing.events",
     # this module's ambient gauges + jax.monitoring listener
@@ -115,16 +120,51 @@ METRIC_NAMES = frozenset({
 # observations in seconds (compile times, backward plan/exec times)
 _TIMING_BOUNDS = tuple(1e-6 * 2 ** i for i in range(27))
 
+# Labels: instruments may carry a small frozen label set
+# (``labels={"replica": "r0", "tenant": "acme"}``). A labeled
+# instrument is an ordinary child of its *family* (the bare name): same
+# class, own lock, registered under the rendered key ``name{k="v"}``.
+# Exposition emits one HELP/TYPE pair per family and one sample line
+# per child. Label sets freeze at registration time into sorted
+# (key, value) str tuples; the cap keeps cardinality honest — fleet
+# attribution needs replica + tenant, not a dimension explosion.
+_MAX_LABELS = 4
+
+
+def _freeze_labels(labels) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    if len(labels) > _MAX_LABELS:
+        raise ValueError(
+            f"at most {_MAX_LABELS} labels per instrument, got {len(labels)}")
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _label_value(v: str) -> str:
+    # Prometheus label-value escaping; also used for registry keys so a
+    # rendered key is exactly the exposition series identity
+    return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _label_suffix(lt: Tuple[Tuple[str, str], ...],
+                  extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = list(lt) + ([extra] if extra is not None else [])
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_label_value(v)}"' for k, v in pairs) + "}"
+
 
 class Counter:
     """Monotonic counter. ``inc`` is the hot-path API."""
 
     kind = "counter"
-    __slots__ = ("name", "help", "_n", "_lock")
+    __slots__ = ("name", "help", "labels", "_n", "_lock")
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "",
+                 labels: Tuple[Tuple[str, str], ...] = ()):
         self.name = name
         self.help = help
+        self.labels = labels  # frozen ((key, value), ...); () = unlabeled
         self._n = 0
         self._lock = threading.Lock()
 
@@ -142,7 +182,11 @@ class Counter:
             self._n = 0
 
     def snapshot(self) -> Dict[str, Any]:
-        return {"type": "counter", "value": self._n}
+        s = {"type": "counter", "value": self._n}
+        if self.labels:
+            s["name"] = self.name
+            s["labels"] = dict(self.labels)
+        return s
 
 
 class Gauge:
@@ -150,12 +194,14 @@ class Gauge:
     evaluate lazily at snapshot time (zero hot-path cost)."""
 
     kind = "gauge"
-    __slots__ = ("name", "help", "_v", "_fn", "_lock")
+    __slots__ = ("name", "help", "labels", "_v", "_fn", "_lock")
 
     def __init__(self, name: str, help: str = "",
-                 fn: Optional[Callable[[], float]] = None):
+                 fn: Optional[Callable[[], float]] = None,
+                 labels: Tuple[Tuple[str, str], ...] = ()):
         self.name = name
         self.help = help
+        self.labels = labels
         self._v = 0.0
         self._fn = fn
         self._lock = threading.Lock()
@@ -179,7 +225,11 @@ class Gauge:
             self._v = 0.0
 
     def snapshot(self) -> Dict[str, Any]:
-        return {"type": "gauge", "value": self.value}
+        s = {"type": "gauge", "value": self.value}
+        if self.labels:
+            s["name"] = self.name
+            s["labels"] = dict(self.labels)
+        return s
 
 
 class Histogram:
@@ -187,13 +237,15 @@ class Histogram:
     observations in seconds (geometric 1µs..67s default bounds)."""
 
     kind = "histogram"
-    __slots__ = ("name", "help", "_bounds", "_buckets", "_count", "_sum",
-                 "_min", "_max", "_lock")
+    __slots__ = ("name", "help", "labels", "_bounds", "_buckets", "_count",
+                 "_sum", "_min", "_max", "_lock")
 
     def __init__(self, name: str, help: str = "",
-                 bounds: Optional[Tuple[float, ...]] = None):
+                 bounds: Optional[Tuple[float, ...]] = None,
+                 labels: Tuple[Tuple[str, str], ...] = ()):
         self.name = name
         self.help = help
+        self.labels = labels
         self._bounds = tuple(bounds) if bounds is not None else _TIMING_BOUNDS
         self._buckets = [0] * (len(self._bounds) + 1)
         self._count = 0
@@ -257,45 +309,72 @@ class Histogram:
         with self._lock:
             nonzero = [(le, n) for le, n in zip(
                 self._bounds + (float("inf"),), self._buckets) if n]
-            return {"type": "histogram", "count": self._count,
-                    "sum": self._sum, "min": self._min, "max": self._max,
-                    "avg": (self._sum / self._count) if self._count else None,
-                    "buckets": nonzero}
+            s = {"type": "histogram", "count": self._count,
+                 "sum": self._sum, "min": self._min, "max": self._max,
+                 "avg": (self._sum / self._count) if self._count else None,
+                 "buckets": nonzero}
+        if self.labels:
+            s["name"] = self.name
+            s["labels"] = dict(self.labels)
+        return s
 
 
 class MetricsRegistry:
     """Name -> instrument map. get-or-create semantics: registering the
-    same name twice returns the existing instrument (kind-checked)."""
+    same (name, labels) twice returns the existing instrument
+    (kind-checked across the whole family — a counter family cannot
+    grow a gauge child)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, Any] = {}
+        self._metrics: Dict[str, Any] = {}   # rendered key -> instrument
+        self._family_kind: Dict[str, type] = {}  # bare name -> class
 
-    def _get_or_create(self, cls, name, **kwargs):
+    def _get_or_create(self, cls, name, labels=None, **kwargs):
+        lt = _freeze_labels(labels)
+        key = name + _label_suffix(lt)
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is not None:
                 if not isinstance(m, cls):
                     raise TypeError(
-                        f"metric '{name}' already registered as {m.kind}")
+                        f"metric '{key}' already registered as {m.kind}")
                 return m
-            m = cls(name, **kwargs)
-            self._metrics[name] = m
+            fam = self._family_kind.get(name)
+            if fam is not None and fam is not cls:
+                raise TypeError(
+                    f"metric family '{name}' already registered as "
+                    f"{fam.kind}")
+            m = cls(name, labels=lt, **kwargs)
+            self._metrics[key] = m
+            self._family_kind[name] = cls
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help=help)
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get_or_create(Counter, name, labels=labels, help=help)
 
     def gauge(self, name: str, help: str = "",
-              fn: Optional[Callable[[], float]] = None) -> Gauge:
-        return self._get_or_create(Gauge, name, help=help, fn=fn)
+              fn: Optional[Callable[[], float]] = None,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, labels=labels, help=help,
+                                   fn=fn)
 
     def histogram(self, name: str, help: str = "",
-                  bounds: Optional[Tuple[float, ...]] = None) -> Histogram:
-        return self._get_or_create(Histogram, name, help=help, bounds=bounds)
+                  bounds: Optional[Tuple[float, ...]] = None,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, labels=labels, help=help,
+                                   bounds=bounds)
 
-    def get(self, name: str):
-        return self._metrics.get(name)
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None):
+        return self._metrics.get(name + _label_suffix(_freeze_labels(labels)))
+
+    def children(self, name: str) -> List[Any]:
+        """Every instrument of the family ``name`` (unlabeled parent
+        first, labeled children in label order)."""
+        with self._lock:
+            kids = [m for m in self._metrics.values() if m.name == name]
+        return sorted(kids, key=lambda m: m.labels)
 
     def names(self) -> List[str]:
         return list(self._metrics)
@@ -315,42 +394,169 @@ class MetricsRegistry:
         for m in items:
             m._reset()
 
+    # -- mergeable deltas -----------------------------------------------------
+    #
+    # The fleet wire format: a worker calls delta_update(state) at each
+    # heartbeat and ships the (usually tiny) result; the router calls
+    # merge_delta(delta, labels={"replica": name}) to fold it into
+    # labeled children of its own registry. Counters ship increments
+    # (merge adds), gauges ship current values (merge overwrites),
+    # histograms ship changed buckets by index (merge adds bucket-wise,
+    # same bounds required). Callback gauges are skipped — they are
+    # recomputable wherever a registry lives and may be expensive.
+
+    def delta_update(self, state: Dict[str, Any],
+                     prefixes: Optional[Tuple[str, ...]] = None
+                     ) -> Dict[str, Any]:
+        """Compact delta of every instrument's change since the last
+        call with the same ``state`` dict (mutated in place). Only
+        instruments whose name starts with one of ``prefixes`` are
+        considered when given. Returns {} when nothing moved."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, Any] = {}
+        for key, m in items:
+            if prefixes is not None and not m.name.startswith(prefixes):
+                continue
+            rec = None
+            if isinstance(m, Counter):
+                with m._lock:
+                    n = m._n
+                prev = state.get(key, 0)
+                if n != prev:
+                    state[key] = n
+                    rec = {"k": "c", "n": m.name, "v": n - prev}
+            elif isinstance(m, Gauge):
+                if m._fn is not None:
+                    continue
+                with m._lock:
+                    v = m._v
+                if state.get(key, 0.0) != v:
+                    state[key] = v
+                    rec = {"k": "g", "n": m.name, "v": v}
+            else:  # Histogram
+                with m._lock:
+                    buckets = list(m._buckets)
+                    cnt, tot = m._count, m._sum
+                    mn, mx = m._min, m._max
+                pb, pc, ps = state.get(key, (None, 0, 0.0))
+                if cnt != pc:
+                    if pb is None:
+                        pb = [0] * len(buckets)
+                    db = [[i, b - p] for i, (b, p)
+                          in enumerate(zip(buckets, pb)) if b != p]
+                    rec = {"k": "h", "n": m.name, "c": cnt - pc,
+                           "s": tot - ps, "b": db, "mn": mn, "mx": mx}
+                    if m._bounds != _TIMING_BOUNDS:
+                        rec["bd"] = list(m._bounds)
+                    state[key] = (buckets, cnt, tot)
+            if rec is not None:
+                if m.labels:
+                    rec["l"] = dict(m.labels)
+                out[key] = rec
+        return out
+
+    def merge_delta(self, delta: Dict[str, Any],
+                    labels: Optional[Dict[str, str]] = None) -> None:
+        """Fold a :meth:`delta_update` result into this registry,
+        get-or-creating children under ``labels`` (merged over any
+        labels the record itself carries). Writes go straight to the
+        instrument internals under the child lock — merging is
+        control-plane work and must land even when ``FLAGS_metrics``
+        is off locally. Histogram merges require identical bounds
+        (ValueError otherwise)."""
+        extra = dict(labels or {})
+        for rec in delta.values():
+            lab = dict(rec.get("l") or {})
+            lab.update(extra)
+            name, child_labels = rec["n"], (lab or None)
+            if rec["k"] == "c":
+                c = self.counter(name, labels=child_labels)
+                with c._lock:
+                    c._n += int(rec["v"])
+            elif rec["k"] == "g":
+                g = self.gauge(name, labels=child_labels)
+                with g._lock:
+                    g._v = rec["v"]
+            else:
+                bounds = tuple(rec["bd"]) if "bd" in rec else None
+                h = self.histogram(name, labels=child_labels, bounds=bounds)
+                if h._bounds != (bounds if bounds is not None
+                                 else _TIMING_BOUNDS):
+                    raise ValueError(
+                        f"histogram '{name}': cannot merge across "
+                        f"differing bounds")
+                with h._lock:
+                    for i, dn in rec["b"]:
+                        h._buckets[i] += dn
+                    h._count += rec["c"]
+                    h._sum += rec["s"]
+                    if rec["mn"] is not None and (
+                            h._min is None or rec["mn"] < h._min):
+                        h._min = rec["mn"]
+                    if rec["mx"] is not None and (
+                            h._max is None or rec["mx"] > h._max):
+                        h._max = rec["mx"]
+
     # -- dumpers --------------------------------------------------------------
 
     def dump_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, default=str)
 
     def dump_prometheus(self) -> str:
-        """Prometheus text exposition format (0.0.4)."""
-        lines: List[str] = []
-        snap = self.snapshot()
+        """Prometheus text exposition format (0.0.4).
+
+        Families come out in deterministic sorted order: one HELP/TYPE
+        pair per family, then one sample per child (unlabeled parent
+        first, labeled children in label order). Counters emit both the
+        bare-name sample (compat with pre-label scrapers) and the
+        spec's ``_total``-suffixed sample. HELP text is escaped per the
+        format (``\\`` then newline)."""
+        # One critical section covers the instrument list AND its
+        # metadata: snapshotting first and re-locking for metas would
+        # let a registration land between the two acquisitions and
+        # yield a sample with no TYPE line.
         with self._lock:
-            metas = {n: m for n, m in self._metrics.items()}
-        for name, s in snap.items():
-            m = metas.get(name)
+            items = list(self._metrics.items())
+        fams: Dict[str, List[Any]] = {}
+        for _key, m in items:
+            fams.setdefault(m.name, []).append(m)
+        lines: List[str] = []
+        for name in sorted(fams, key=_prom_name):
+            children = sorted(fams[name], key=lambda m: m.labels)
             pname = "paddle_" + _prom_name(name)
-            if m is not None and m.help:
-                lines.append(f"# HELP {pname} {m.help}")
-            if s["type"] == "counter":
-                lines.append(f"# TYPE {pname} counter")
-                lines.append(f"{pname} {s['value']}")
-            elif s["type"] == "gauge":
-                lines.append(f"# TYPE {pname} gauge")
-                if s["value"] is not None:
-                    lines.append(f"{pname} {_prom_num(s['value'])}")
-            else:  # histogram: cumulative le buckets + _sum/_count
-                lines.append(f"# TYPE {pname} histogram")
-                cum = 0
-                for le, n in s["buckets"]:
-                    cum += n
-                    le_s = "+Inf" if le == float("inf") else _prom_num(le)
-                    lines.append(f'{pname}_bucket{{le="{le_s}"}} {cum}')
-                # the snapshot elides zero buckets, so a zero-count inf
-                # bucket needs an explicit +Inf close
-                if not any(le == float("inf") for le, _ in s["buckets"]):
-                    lines.append(f'{pname}_bucket{{le="+Inf"}} {s["count"]}')
-                lines.append(f"{pname}_sum {_prom_num(s['sum'])}")
-                lines.append(f"{pname}_count {s['count']}")
+            kind = children[0].kind
+            help_ = next((c.help for c in children if c.help), "")
+            if help_:
+                esc = help_.replace("\\", r"\\").replace("\n", r"\n")
+                lines.append(f"# HELP {pname} {esc}")
+            lines.append(f"# TYPE {pname} {kind}")
+            for c in children:
+                s = c.snapshot()
+                lab = _label_suffix(c.labels)
+                if kind == "counter":
+                    lines.append(f"{pname}{lab} {s['value']}")
+                    lines.append(f"{pname}_total{lab} {s['value']}")
+                elif kind == "gauge":
+                    if s["value"] is not None:
+                        lines.append(f"{pname}{lab} {_prom_num(s['value'])}")
+                else:  # histogram: cumulative le buckets + _sum/_count
+                    cum = 0
+                    seen_inf = False
+                    for le, n in s["buckets"]:
+                        cum += n
+                        inf = le == float("inf")
+                        seen_inf = seen_inf or inf
+                        le_s = "+Inf" if inf else _prom_num(le)
+                        blab = _label_suffix(c.labels, ("le", le_s))
+                        lines.append(f"{pname}_bucket{blab} {cum}")
+                    # the snapshot elides zero buckets, so a zero-count
+                    # inf bucket needs an explicit +Inf close
+                    if not seen_inf:
+                        blab = _label_suffix(c.labels, ("le", "+Inf"))
+                        lines.append(f"{pname}_bucket{blab} {s['count']}")
+                    lines.append(f"{pname}_sum{lab} {_prom_num(s['sum'])}")
+                    lines.append(f"{pname}_count{lab} {s['count']}")
         return "\n".join(lines) + "\n"
 
 

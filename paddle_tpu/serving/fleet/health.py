@@ -30,7 +30,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Optional, Tuple
 
-__all__ = ["ReplicaState", "ReplicaHealth"]
+__all__ = ["ReplicaState", "ReplicaHealth", "STATE_CODES"]
 
 
 class ReplicaState:
@@ -38,6 +38,17 @@ class ReplicaState:
     READY = "ready"
     DRAINING = "draining"
     DEAD = "dead"
+
+
+# numeric encoding for the per-replica ``fleet.replica_state`` gauge
+# (Prometheus samples are numbers; dashboards map the code back).
+# Ordered by "distance from serving": 1 is the only routable state.
+STATE_CODES = {
+    ReplicaState.READY: 1,
+    ReplicaState.STARTING: 0,
+    ReplicaState.DRAINING: 2,
+    ReplicaState.DEAD: 3,
+}
 
 
 class ReplicaHealth:

@@ -40,6 +40,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ...models.serving import QueueFull
+from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
 from ...observability import tracing as _tracing
 from ..resilience.engine import ResilientServingEngine
@@ -92,11 +93,13 @@ class ReplicaHandle:
 
     def submit(self, gid: int, prompt, max_new_tokens: int, *,
                out_tokens: Optional[List[int]] = None,
-               handoff: bool = False) -> None:
+               handoff: bool = False,
+               tenant: Optional[str] = None) -> None:
         """Admit under the router's global id. Raises ``QueueFull``
         (bounded admission, non-handoff only) or ``ReplicaUnavailable``
         (transport gone). Returning normally means the request is
-        DURABLY journaled on the replica — the router's ack point."""
+        DURABLY journaled on the replica — the router's ack point.
+        ``tenant`` labels the engine's admission counters."""
         raise NotImplementedError
 
     def pop_finished(self) -> List["FinishedInfo"]:
@@ -197,7 +200,8 @@ class ThreadReplicaHandle(ReplicaHandle):
     # -- verbs ---------------------------------------------------------------
     def submit(self, gid: int, prompt, max_new_tokens: int, *,
                out_tokens: Optional[List[int]] = None,
-               handoff: bool = False) -> None:
+               handoff: bool = False,
+               tenant: Optional[str] = None) -> None:
         if self._killed or self.eng is None or self._stop.is_set():
             raise ReplicaUnavailable(
                 f"replica {self.name} is not accepting work")
@@ -215,7 +219,8 @@ class ThreadReplicaHandle(ReplicaHandle):
                     retry_after_hint=(qw.quantile(0.5)
                                       if qw is not None else None))
             self.eng.add_request(prompt, max_new_tokens=max_new_tokens,
-                                 rid=gid, out_tokens=out_tokens)
+                                 rid=gid, out_tokens=out_tokens,
+                                 tenant=tenant)
         self._wake.set()
 
     def pop_finished(self) -> List[FinishedInfo]:
@@ -368,6 +373,20 @@ class SubprocessReplicaHandle(ReplicaHandle):
                 self._beat = (time.monotonic(),
                               ev.get("phase", "ready"),
                               int(ev.get("qd", 0)))
+                if "m" in ev:
+                    # fold the replica's engine-series delta into OUR
+                    # registry under its name: one scrape of the router
+                    # process shows the whole fleet, and these merged
+                    # values are exactly what survives a SIGKILL
+                    try:
+                        _metrics.registry().merge_delta(
+                            ev["m"], labels={"replica": self.name})
+                    except Exception as e:
+                        # a malformed delta must not kill the reader —
+                        # that would look like replica death to health
+                        _flight.record_event(
+                            "fleet.hb_merge_error",
+                            (self.name, type(e).__name__, str(e)))
             elif kind == "ack" or kind == "full":
                 with self._cv:
                     self._acks[int(ev["gid"])] = ev
@@ -384,7 +403,8 @@ class SubprocessReplicaHandle(ReplicaHandle):
     # -- verbs ---------------------------------------------------------------
     def submit(self, gid: int, prompt, max_new_tokens: int, *,
                out_tokens: Optional[List[int]] = None,
-               handoff: bool = False) -> None:
+               handoff: bool = False,
+               tenant: Optional[str] = None) -> None:
         if not self.status()["alive"]:
             raise ReplicaUnavailable(
                 f"replica {self.name} process is not running")
@@ -393,6 +413,8 @@ class SubprocessReplicaHandle(ReplicaHandle):
               "n": int(max_new_tokens), "handoff": bool(handoff)}
         if out_tokens:
             op["toks"] = [int(t) for t in out_tokens]
+        if tenant is not None:
+            op["tn"] = str(tenant)
         tc = _tracing.inject()
         if tc is not None:
             # carry the router's ambient trace context across the

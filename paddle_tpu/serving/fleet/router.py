@@ -55,7 +55,7 @@ from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
 from ...observability import tracing as _tracing
 from ..resilience.journal import RequestJournal
-from .health import ReplicaHealth, ReplicaState
+from .health import STATE_CODES, ReplicaHealth, ReplicaState
 from .replica import FinishedInfo, QueueFull, ReplicaHandle, \
     ReplicaUnavailable
 
@@ -181,6 +181,16 @@ class ReplicaRouter:
         # private stream: jittered backoff must not perturb anyone
         # else's (or the engines') randomness
         self._rng = random.Random(seed)
+        # per-replica labeled health-state gauges (fleet.replica_state):
+        # registered up front so a scrape shows every replica from the
+        # first poll, including ones that never get to READY
+        self._state_gauges = {
+            name: _M.gauge(
+                "fleet.replica_state",
+                help="replica health state (0 starting, 1 ready, "
+                     "2 draining, 3 dead)",
+                labels={"replica": name})
+            for name in self._replicas}
         self._next_gid = 0
         self._outstanding: Dict[int, _Outstanding] = {}
         # (info, watermark tokens) with no READY survivor yet
@@ -218,6 +228,11 @@ class ReplicaRouter:
     def start(self) -> None:
         for r in self._replicas.values():
             r.start()
+        # ops plane: register the fleet's scrape-time SLIs and (when
+        # FLAGS_telemetry_port says so) start the /metrics·/healthz·
+        # /statusz·/trace endpoint in this process
+        from ...observability import exporter as _exporter
+        _exporter.attach_fleet(self)
 
     def close(self) -> None:
         for r in self._replicas.values():
@@ -250,12 +265,15 @@ class ReplicaRouter:
 
     # -- submit --------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, *,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> int:
         """Admit one request somewhere READY; returns its gid once the
         admission is DURABLY journaled on that replica. Raises
         :class:`FleetShed` instead of queueing past the deadline
         (``deadline_s`` overrides the router default per call — latency-
-        tier traffic can shed earlier than batch traffic)."""
+        tier traffic can shed earlier than batch traffic). ``tenant``
+        rides the submit span and labels the serving engine's admission
+        counters on whichever replica takes the request."""
         t0 = time.monotonic()
         deadline = t0 + (self._submit_deadline_s if deadline_s is None
                          else float(deadline_s))
@@ -267,6 +285,8 @@ class ReplicaRouter:
         # frame) parents onto THIS trace — the one id that follows the
         # request through every process it touches
         with _tracing.span("fleet.submit") as _sp:
+            if tenant is not None:
+                _sp.set(tenant=tenant)
             while True:
                 ready = self._ready_names()
                 if ready:
@@ -279,7 +299,8 @@ class ReplicaRouter:
                         gid = self._next_gid
                         try:
                             self._replicas[name].submit(
-                                gid, prompt, max_new_tokens)
+                                gid, prompt, max_new_tokens,
+                                tenant=tenant)
                         except QueueFull as e:
                             _sp.event("fleet.queue_full", replica=name)
                             if e.retry_after_hint:
@@ -393,6 +414,8 @@ class ReplicaRouter:
         _M_READY.set(float(states.count(ReplicaState.READY)))
         _M_DEAD.set(float(states.count(ReplicaState.DEAD)))
         _M_FLEET_QUEUE.set(float(qdepth))
+        for name, h in self._health.items():
+            self._state_gauges[name].set(float(STATE_CODES[h.state]))
         return done
 
     def pop_output(self, gid: int,

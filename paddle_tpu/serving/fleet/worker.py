@@ -9,15 +9,22 @@ is the config::
      "hb_interval_s": 0.2, "step_sleep_s": 0.0}
 
 then ops, one per line: ``{"op":"submit","gid":G,"prompt":[...],
-"n":N,"handoff":bool,"toks":[...]?,"tc":[hex,hex]?}`` |
+"n":N,"handoff":bool,"toks":[...]?,"tc":[hex,hex]?,"tn":str?}`` |
 ``{"op":"drain"}`` | ``{"op":"stop"}``. ``tc`` is the router's trace
 context (observability.tracing.inject): the worker re-activates it
-around the admission so one trace_id spans both processes. Events go
+around the admission so one trace_id spans both processes. ``tn`` is
+the submitting tenant — it labels the engine's admission counters. Events go
 to stdout, one JSON per line:
 
 * ``{"ev":"ready","phase":...}`` — warmup (or recovery's first step)
   done; the parent's health machine flips STARTING→READY on it
-* ``{"ev":"hb","phase":...,"qd":N}`` — periodic heartbeat
+* ``{"ev":"hb","phase":...,"qd":N,"m":{...}?}`` — periodic heartbeat;
+  ``m`` (present only when something moved) is the registry delta
+  since the previous beat (``metrics.MetricsRegistry.delta_update``
+  over the ``serving.*``/``jit.*`` families) — the parent merges it
+  into its own registry labeled by replica name, so a router scrape
+  shows every replica's engine series, and a SIGKILLed replica's
+  counters survive as their last-merged values
 * ``{"ev":"ack","gid":G}`` — admission DURABLY journaled (the router's
   exactly-once ack point); ``{"ev":"full","gid":G,"hint":h}`` —
   bounded admission refused, hint = median observed queue wait
@@ -119,6 +126,19 @@ def main() -> int:
             emit({"ev": "finish", "gid": rid, "toks": toks,
                   "ttft": ttft, "tpot": tpot})
 
+    # metric piggyback state: one dict per process lifetime, mutated by
+    # delta_update so each beat ships only what moved since the last
+    hb_state: dict = {}
+    hb_prefixes = ("serving.", "jit.")
+
+    def hb_event() -> dict:
+        ev = {"ev": "hb", "phase": eng.phase,
+              "qd": len(eng.engine.pending)}
+        delta = _metrics.registry().delta_update(hb_state, hb_prefixes)
+        if delta:
+            ev["m"] = delta
+        return ev
+
     eng.warmup()
     emit({"ev": "ready", "phase": eng.phase})
     # recovery may have loaded finished outputs straight from the
@@ -153,7 +173,8 @@ def main() -> int:
                     eng.add_request(op["prompt"],
                                     max_new_tokens=int(op["n"]),
                                     rid=gid,
-                                    out_tokens=op.get("toks") or None)
+                                    out_tokens=op.get("toks") or None,
+                                    tenant=op.get("tn"))
                 except QueueFull as e:
                     emit({"ev": "full", "gid": gid,
                           "hint": e.retry_after_hint})
@@ -166,12 +187,14 @@ def main() -> int:
             elif kind == "stop":
                 stop_req = True
         if stop_req:
+            emit(hb_event())   # final delta: land the tail counters
             eng.close()
             _dump_trace_file(cfg["root"])
             return 0
         if drain_req:
             eng.drain()
             flush_finished()
+            emit(hb_event())
             emit({"ev": "drained"})
             eng.close()
             _dump_trace_file(cfg["root"])
@@ -186,8 +209,7 @@ def main() -> int:
         now = time.monotonic()
         if now - last_hb >= hb_interval:
             last_hb = now
-            emit({"ev": "hb", "phase": eng.phase,
-                  "qd": len(eng.engine.pending)})
+            emit(hb_event())
 
 
 if __name__ == "__main__":

@@ -230,7 +230,8 @@ class ResilientServingEngine:
     # -- intake --------------------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32, *,
                     rid: Optional[int] = None,
-                    out_tokens: Optional[List[int]] = None) -> int:
+                    out_tokens: Optional[List[int]] = None,
+                    tenant: Optional[str] = None) -> int:
         """Admit + journal durably: the flushed admission record is the
         ack point — a request this method returned an rid for survives
         any crash. Raises ``QueueFull`` when bounded admission rejects
@@ -252,9 +253,12 @@ class ResilientServingEngine:
         # fsync span nests under it — together they place the durable
         # ack point on the request's timeline
         with _tracing.span("serving.admit") as _sp:
+            if tenant is not None:
+                _sp.set(tenant=tenant)
             rid = self.engine.add_request(prompt,
                                           max_new_tokens=max_new_tokens,
-                                          rid=rid, out_tokens=out_tokens)
+                                          rid=rid, out_tokens=out_tokens,
+                                          tenant=tenant)
             self.journal.append({
                 "t": "admit", "rid": rid,
                 "prompt": [int(x)

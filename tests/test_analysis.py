@@ -483,6 +483,37 @@ class TestTaxonomyRule:
                           'METRIC_NAMES = frozenset({"dispatch.count"})\n')])
         assert len(fs) == 1 and "METRIC_NAMES" in fs[0].message
 
+    def test_dead_metric_name_fires_on_its_definition_line(self):
+        """A METRIC_NAMES entry nothing registers is a dead scrape
+        series: flagged at the entry's own line, once the run carries
+        registration sites in >=2 files besides the definer. Literal
+        registrations and the `"prefix." + var` loop idiom both count
+        as live, whatever the receiver is spelled as."""
+        defs = ('METRIC_NAMES = frozenset({\n'
+                '    "a.live",\n'
+                '    "a.pfx.one",\n'
+                '    "b.dead",\n'
+                '})\n')
+        regs = [("reg1.py", 'import m\nm.registry().counter("a.live")\n'),
+                ("reg2.py", 'for _k in ("one",):\n'
+                            '    reg.gauge("a.pfx." + _k)\n')]
+        fs = check_src(defs, ["taxonomy"], rel="metrics.py",
+                       extra_files=regs)
+        assert len(fs) == 1
+        assert "'b.dead'" in fs[0].message
+        assert "dead taxonomy entry" in fs[0].message
+        assert fs[0].line == 4
+
+    def test_dead_check_stays_disarmed_on_scoped_runs(self):
+        # one registering file besides the definer: a file-scoped run,
+        # not evidence the rest of the tree stopped registering
+        defs = 'METRIC_NAMES = frozenset({"b.dead"})\n'
+        fs = check_src(defs, ["taxonomy"], rel="metrics.py",
+                       extra_files=[("reg1.py",
+                                     'import m\n'
+                                     'm.registry().counter("b.other")\n')])
+        assert fs == []
+
     def test_frozen_sets_actually_exist_in_package(self):
         # the rule is vacuous without the runtime sets: pin them
         from paddle_tpu.jit.step_capture import FALLBACK_REASONS

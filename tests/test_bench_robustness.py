@@ -220,7 +220,8 @@ class TestServingFleetMicro:
         r = bench.bench_serving_fleet(False, quick=True)
         d = r["detail"]
         if (r["value"] < 1.0 or d["overload_sheds"] == 0
-                or d["tracing_overhead_pct"] >= 3.0):     # timing gates
+                or d["tracing_overhead_pct"] >= 3.0
+                or d["scrape_overhead_pct"] >= 3.0):      # timing gates
             r = bench.bench_serving_fleet(False, quick=True)
             d = r["detail"]
         assert r["metric"] == "serving_fleet_goodput"
@@ -242,6 +243,15 @@ class TestServingFleetMicro:
         assert d["tracing_on_tok_s"] > 0.0
         assert d["tracing_off_tok_s"] > 0.0
         assert d["tracing_overhead_pct"] < d["tracing_gate_pct"], d
+        # ISSUE 14 gate: a 1 Hz ops scraper during a load round must
+        # cost <3% of the round's CPU, and the scrapes themselves
+        # must have been served (latency tail recorded)
+        assert d["scrape_count"] >= 1
+        assert d["scrape_latency_p99_ms"] > 0.0
+        assert d["scrape_overhead_pct"] < d["scrape_gate_pct"], d
+        # the endpoint the micro started must be gone afterwards
+        from paddle_tpu.observability import exporter as telemetry
+        assert telemetry.port() is None
         # the flag the micro toggles must be restored afterwards
         import paddle_tpu as paddle
         assert paddle.get_flags(["FLAGS_tracing"])["FLAGS_tracing"] is True

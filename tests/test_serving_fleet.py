@@ -27,6 +27,8 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
+import urllib.request
 
 import numpy as np
 import pytest
@@ -34,6 +36,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.models.serving import ContinuousBatchingEngine, QueueFull
+from paddle_tpu.observability import exporter as telemetry
 from paddle_tpu.observability.metrics import (METRIC_NAMES, Histogram,
                                               registry)
 from paddle_tpu.serving.fleet import (FleetShed, ReplicaRouter,
@@ -108,6 +111,16 @@ def _assert_byte_identical(router, model):
     ref = _reference(model, router.requests)
     got = {g: list(router.outputs[g]) for g in router.requests}
     assert got == ref
+
+
+def _http_get(port, path, timeout=10.0):
+    """(status, body) off the router's ops endpoint; 4xx/5xx returned."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
 
 
 # ------------------------------------------------- routing primitives (fast)
@@ -658,6 +671,50 @@ class TestFleetRouter:
             assert registry().get(name) is not None, name
 
 
+# ------------------------------------------------- telemetry plane (fast)
+
+class TestFleetTelemetry:
+    """ISSUE 14 acceptance, thread-transport half: ``router.start()``
+    auto-serves the ops endpoint from ``FLAGS_telemetry_port`` and ONE
+    scrape shows the whole fleet — a per-replica health-state series
+    for every replica, the router-native failover/shed counters, and
+    the scrape-time SLIs — while /healthz reports fleet readiness."""
+
+    def test_one_scrape_shows_the_fleet(self, model, tmp_path):
+        saved = paddle.get_flags(["FLAGS_telemetry_port"])
+        paddle.set_flags({"FLAGS_telemetry_port": 0})  # 0 = free port
+        try:
+            router, _ = _mk_fleet(model, tmp_path)
+            try:
+                port = telemetry.port()
+                assert port                # started by router.start()
+                for p in _prompts(4):
+                    router.submit(p, max_new_tokens=4)
+                router.drain_all(timeout_s=120.0)
+                code, body = _http_get(port, "/metrics")
+                assert code == 200
+                lines = body.splitlines()
+                for rep in ("rep0", "rep1"):
+                    assert (f'paddle_fleet_replica_state'
+                            f'{{replica="{rep}"}} 1') in lines
+                for fam in ("paddle_fleet_submitted_total ",
+                            "paddle_fleet_sheds_total ",
+                            "paddle_fleet_rerouted_requests_total ",
+                            "paddle_fleet_sli_availability "):
+                    assert any(l.startswith(fam) for l in lines), fam
+                code, hz = _http_get(port, "/healthz")
+                assert code == 200
+                assert json.loads(hz)["replicas"] == \
+                    {"rep0": "ready", "rep1": "ready"}
+                code, st = _http_get(port, "/statusz")
+                assert code == 200 and "rep0" in st and "rep1" in st
+            finally:
+                router.close()
+        finally:
+            telemetry.shutdown()
+            paddle.set_flags(saved)
+
+
 # ------------------------------------------------------- chaos (slow)
 
 @pytest.mark.slow
@@ -735,6 +792,78 @@ class TestSubprocessFleetChaos:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+
+@pytest.mark.slow
+@pytest.mark.heavy
+class TestSubprocessFleetTelemetry:
+    """ISSUE 14 acceptance, subprocess half: real worker processes
+    piggyback registry deltas on their heartbeats; the router merges
+    them under ``replica="<name>"`` so one scrape shows every live
+    replica's ENGINE series — and a SIGKILLed replica's counters
+    survive as their last-merged values while its /healthz
+    contribution flips to dead."""
+
+    def test_killed_replica_series_survive(self, model, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(
+                       [_TESTS_DIR, os.path.dirname(_TESTS_DIR)]))
+        config = {"factory": "serving_chaos_worker:build_model",
+                  "engine": {**ENG, "journal_flush_every": 1},
+                  "max_queue": 8, "hb_interval_s": 0.1,
+                  "step_sleep_s": 0.02}
+        reps = [SubprocessReplicaHandle(
+                    f"tsub{i}", str(tmp_path / f"tsub{i}"), dict(config),
+                    spawn_env=env)
+                for i in range(2)]
+        names = [r.name for r in reps]
+        router = ReplicaRouter(reps, block_size=ENG["block_size"],
+                               heartbeat_timeout_s=5.0,
+                               submit_deadline_s=30.0)
+        saved = paddle.get_flags(["FLAGS_telemetry_port"])
+        paddle.set_flags({"FLAGS_telemetry_port": 0})
+        try:
+            router.start()
+            router.wait_ready(timeout_s=300.0)
+            port = telemetry.port()
+            assert port
+            gids = [router.submit(p, max_new_tokens=8)
+                    for p in _prompts(6, rng_seed=13)]
+            # heartbeats are merging on the reader threads: wait until
+            # every LIVE replica has contributed an engine series
+            deadline = time.time() + 120.0
+            while time.time() < deadline:
+                if all(registry().get("serving.steps", {"replica": n})
+                       is not None for n in names):
+                    break
+                time.sleep(0.05)
+            merged = {n: registry().get("serving.steps", {"replica": n})
+                      for n in names}
+            assert all(m is not None for m in merged.values())
+            victim = router._outstanding[gids[-1]].replica
+            next(r for r in reps if r.name == victim).kill()  # SIGKILL
+            router.drain_all(timeout_s=300.0)
+            assert router.dropped_requests == 0
+            # the victim's last-merged series survive its death, in the
+            # same scrape as the survivors' still-advancing ones
+            assert merged[victim].value > 0
+            _, body = _http_get(port, "/metrics")
+            step_lines = [l for l in body.splitlines()
+                          if l.startswith("paddle_serving_steps_total{")]
+            for n in names:
+                assert any(f'replica="{n}"' in l for l in step_lines), n
+            # ... while its /healthz contribution flips to dead
+            code, hz = _http_get(port, "/healthz")
+            payload = json.loads(hz)
+            assert code == 200            # a survivor is still READY
+            assert payload["replicas"][victim] == "dead"
+            survivor = next(n for n in names if n != victim)
+            assert payload["replicas"][survivor] == "ready"
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+            telemetry.shutdown()
+            paddle.set_flags(saved)
 
 
 class TestGradModeThreadIsolation:
