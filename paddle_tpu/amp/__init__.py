@@ -159,7 +159,12 @@ class GradScaler:
     inside the captured executable with no host sync at all. The eager
     path keeps unscale+check on device and defers its single
     ``bool(found)`` host sync until after the scale transition is
-    enqueued; a disabled scaler pays no device work and no sync."""
+    enqueued; a disabled scaler pays no device work and no sync.
+
+    Because the state is ordinary traced donated state, it also rides
+    the ``lax.scan`` carry of a K-step block (jit/multi_step.py)
+    unchanged: each in-loop step sees the scale the previous step left
+    behind, exactly as K sequential captured replays would."""
 
     def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
                  incr_ratio: float = 2.0, decr_ratio: float = 0.5,

@@ -28,6 +28,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import contextlib
+import functools
 import time
 from typing import Dict, Optional, Tuple
 
@@ -80,14 +81,24 @@ def projected_throughput(compiled, global_batch: int, seq: int,
     }
 
 
+@functools.lru_cache(maxsize=None)
+def _topology_desc(topology: str, platform: str):
+    """Memoized PjRt topology description for a named slice.
+
+    Instantiating the deviceless topology client costs seconds per call
+    and the result is pure in ``(topology, platform)``, so every plan and
+    every test in one process shares a single client."""
+    from jax.experimental import topologies
+    return topologies.get_topology_desc(topology, platform=platform)
+
+
 def topology_mesh(topology: str, axis_shape: Dict[str, int],
                   platform: str = "tpu") -> Mesh:
     """Mesh over a named TPU topology, e.g. ``("v5p:4x4x4", {"dp":8,"mp":8})``.
 
     The axis order puts the LAST axis innermost (ICI-nearest) — tensor
     parallelism belongs there, data parallelism outermost."""
-    from jax.experimental import topologies
-    topo = topologies.get_topology_desc(topology, platform=platform)
+    topo = _topology_desc(topology, platform)
     devs = np.array(topo.devices)
     want = int(np.prod(list(axis_shape.values())))
     if devs.size != want:

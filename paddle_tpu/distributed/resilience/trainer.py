@@ -212,10 +212,27 @@ class ResilientTrainer:
         w = self._skip_window
         return w is not None and w[0] <= step <= w[1]
 
+    def should_skip_block(self, start: int, k: int) -> bool:
+        """K-step-block variant of :meth:`should_skip`: True when ANY of
+        the block's steps ``[start, start + k)`` overlaps the poison
+        window. A K-step block is one fused executable — it cannot drop
+        a single interior step, so the caller drops the WHOLE block
+        (advancing its ring cursor by one block). The window is measured
+        in steps but consumed in K-blocks; the boundary over-skip is at
+        most K-1 known-adjacent batches."""
+        w = self._skip_window
+        return w is not None and start <= w[1] and w[0] <= start + k - 1
+
     # -- per-step poll -------------------------------------------------------
-    def poll(self, step: int) -> str:
+    def poll(self, step: int, block_steps: int = 1) -> str:
         """Call once per training step, AFTER the step ran (state holds
-        replay outputs, safe to snapshot). Returns a TrainerAction."""
+        replay outputs, safe to snapshot). Returns a TrainerAction.
+
+        Under multi-step capture the caller polls once per K-step block
+        with ``block_steps=K``; periodic snapshots then fire on the
+        first block boundary at or past each ``snapshot_every`` multiple
+        (a crossing condition — ``step % snapshot_every == 0`` alone
+        never fires when ``snapshot_every`` is not a multiple of K)."""
         preempted = self._poll_preempted()
         death = False
         if not preempted:
@@ -251,8 +268,14 @@ class ResilientTrainer:
                 _M_RANK_DEATHS.inc()
                 _record("resilience.rank_death", (step,))
             return TrainerAction.RESTART
+        # "did the last block_steps steps cross a snapshot_every
+        # multiple?" — reduces to `step % snapshot_every == 0` when
+        # block_steps is 1, and stays correct when K-misaligned epoch
+        # tails shift the block phase off multiples of K
+        bk = max(1, int(block_steps))
         if self.snapshot_every and step > 0 \
-                and step % self.snapshot_every == 0:
+                and (step // self.snapshot_every) \
+                > max(0, (step - bk) // self.snapshot_every):
             if self.anomaly is not None \
                     and self.anomaly.first_bad_step is not None:
                 # mid-bad-streak: loss spikes do NOT skip the update
@@ -447,5 +470,80 @@ class ResilientTrainer:
         if final_snapshot:
             self.checkpointer.save(self.state_fn(), max_steps - 1,
                                    block=True)
+        self.checkpointer.wait()
+        return TrainerAction.COMPLETED
+
+    def run_blocks(self, train_block_fn: Callable[[int, Any], Any],
+                   max_steps: int, k: int,
+                   final_snapshot: bool = True) -> str:
+        """Multi-step variant of :meth:`run_data`: the trainer drives
+        the loader's K-step ring (``fill_ring(k)``) and
+        ``train_block_fn(start_step, block)`` trains ``block.size``
+        steps at once, returning the block's per-step losses. The
+        loader's committed cursor only ever advances to block
+        boundaries, so snapshots, restores and rewinds all land exactly
+        on one; poison windows are consumed whole-block (the ring draws
+        the batches — advancing the committed cursor — without
+        training). Losses are observed per step in order, so anomaly
+        escalation fires at the same loss index it would single-step."""
+        if self.data_loader is None:
+            raise ValueError("run_blocks requires the data_loader the "
+                             "trainer was constructed with")
+        gen = [None]
+
+        def next_block():
+            empties = 0
+            while True:
+                if gen[0] is None:
+                    gen[0] = self.data_loader.fill_ring(k)
+                try:
+                    return next(gen[0])
+                except StopIteration:
+                    empties += 1
+                    if empties >= 2:
+                        raise RuntimeError(
+                            "run_blocks: data_loader yielded no batches")
+                    gen[0] = None   # epoch boundary: roll into the next
+
+        step = self.restore()
+        recovered_at = -1
+        while step < max_steps:
+            block = next_block()
+            if self.should_skip_block(step, block.size):
+                self.data_loader._commit_stream_state(block.stream_state)
+                step += block.size
+                continue
+            try:
+                out = train_block_fn(step, block)
+            except RuntimeError as e:
+                if ("donated inputs were consumed" in str(e)
+                        and recovered_at != step
+                        and latest_checkpoint(self.checkpointer.root)
+                        is not None):
+                    recovered_at = step
+                    step = self.restore()
+                    gen[0] = None
+                    continue
+                raise
+            self.data_loader._commit_stream_state(block.stream_state)
+            if self.anomaly is not None:
+                outs = list(out) if isinstance(out, (list, tuple)) else [out]
+                rewound = None
+                for i, lv in enumerate(outs):
+                    if self.observe(step + i, lv) == TrainerAction.REWIND:
+                        rewound = self.rewind(step + i)
+                        break
+                if rewound is not None:
+                    step = rewound
+                    gen[0] = None
+                    continue
+            last = step + block.size - 1
+            action = self.poll(last, block_steps=block.size)
+            if action != TrainerAction.CONTINUE:
+                self.checkpointer.wait()
+                return action
+            step += block.size
+        if final_snapshot:
+            self.checkpointer.save(self.state_fn(), step - 1, block=True)
         self.checkpointer.wait()
         return TrainerAction.COMPLETED

@@ -166,6 +166,18 @@ define_flag("step_capture_screen", True,
             "tensor hooks, create_graph=True) fall back to eager with a "
             "source-located diagnosis BEFORE paying the probe + trace + "
             "abort cycle; False defers entirely to the dynamic path")
+define_flag("multi_step", 0,
+            "multi-step capture (jit/multi_step.py): K > 1 makes "
+            "hapi.Model.fit drive training in K-step blocks — ONE "
+            "lax.scan executable runs K whole captured steps (forward, "
+            "fused backward, grad clip, optimizer update with lr/step "
+            "scalars advanced inside the loop carry) over a [K, ...] "
+            "input ring the DataLoader prefetch thread fills "
+            "(DataLoader.fill_ring). The host touches the job once per "
+            "block; epoch tails and unsupported edges (per-step host "
+            "callbacks, arg-ful schedulers) run through single-step "
+            "capture with the reason in the flight recorder. 0 (default) "
+            "= off; explicit jit_step(fn, k_steps=K) ignores this flag")
 define_flag("anomaly_sentinel", False,
             "numerical-fault sentinel (optimizer/optimizer.py): every "
             "optimizer update computes a fused device-side finiteness + "

@@ -172,41 +172,86 @@ class ResilientCheckpoint(Callback):
     not replayed — continuity is parameter-level, same contract as the
     chaos harness asserts)."""
 
-    def __init__(self, dir, snapshot_steps=100, keep=3):
+    def __init__(self, dir, snapshot_steps=100, keep=3, block_steps=1):
         super().__init__()
         self.dir = dir
         self.snapshot_steps = max(1, int(snapshot_steps))
         self.keep = keep
+        # K-step block training (FLAGS_multi_step): params only exist at
+        # block boundaries, so snapshots are taken at block-final steps
+        # only — fit sets this to K when it drives blocks
+        self.block_steps = max(1, int(block_steps))
         self.checkpointer = None
         self.resume_step = 0
         self._gstep = 0
+        self._last_snap = 0
+        # True while the fit loop replays a block's INTERIOR per-step
+        # hooks post-hoc: params already hold end-of-block values there,
+        # so a snapshot would tag future state with a past step
+        self._mid_block = False
+        self._loader = None   # resumable DataLoader to journal (multi path)
+
+    def attach_data_stream(self, loader) -> None:
+        """Journal ``loader.state_dict()`` into every snapshot and
+        restore it on train begin, so a resumed run replays the exact
+        remaining batches. In ring mode the loader pins its public
+        state to the last COMMITTED K-block, so the journaled cursor
+        always matches the snapshotted params."""
+        self._loader = loader
 
     def _state(self):
         # reference-based tree: no jnp.copy of every moment buffer — the
         # checkpointer's foreground snapshot host-copies before the next
         # (possibly donated) step can touch the sources
         from ..distributed.resilience import training_state
-        return training_state(self.model.network, self.model._optimizer)
+        state = training_state(self.model.network, self.model._optimizer)
+        if self._loader is not None:
+            state["data_stream"] = self._loader.state_dict()
+            # restore-side discriminator: stays 0 after rebuilding from a
+            # checkpoint written WITHOUT a journaled stream
+            state["has_stream"] = 1
+        return state
 
     def on_train_begin(self, logs=None):
         from ..distributed.resilience import AsyncCheckpointer
         if self.checkpointer is None:
             self.checkpointer = AsyncCheckpointer(self.dir, keep=self.keep)
-        rebuilt, step = self.checkpointer.restore_latest(self._state())
+        tmpl = self._state()
+        if "has_stream" in tmpl:
+            tmpl["has_stream"] = 0
+        rebuilt, step = self.checkpointer.restore_latest(tmpl)
         if step is not None:
             # model Tensors restored in place; the optimizer subtree is
             # copies, so it must be pushed back
             if self.model._optimizer is not None and "opt" in rebuilt:
                 self.model._optimizer.set_state_dict(rebuilt["opt"])
+            if self._loader is not None and rebuilt.get("has_stream"):
+                self._loader.load_state_dict(rebuilt["data_stream"])
             self.resume_step = step + 1
             # seeded with the COMMITTED step: the first resumed batch's
             # on_train_batch_end pre-increments to step+1, keeping
             # generation tags aligned with batches actually run
             self._gstep = step
+            self._last_snap = step
 
     def on_train_batch_end(self, step, logs=None):
         self._gstep += 1
-        if self._gstep % self.snapshot_steps == 0:
+        bk = self.block_steps
+        if bk > 1:
+            # block mode: params and the committed stream cursor are
+            # only consistent where the fit loop cleared _mid_block
+            # (block-final steps and single-step epoch tails), and the
+            # hooks run post-hoc AFTER the whole block trained — so
+            # snapshot on the first consistent step past each
+            # snapshot_steps multiple (snapshot_steps need not divide
+            # K, and epoch tails shift the block phase, so a plain
+            # `% == 0` could fire mid-block or never)
+            if not self._mid_block and \
+                    (self._gstep // self.snapshot_steps) > \
+                    (self._last_snap // self.snapshot_steps):
+                self._last_snap = self._gstep
+                self.checkpointer.save(self._state(), self._gstep)
+        elif self._gstep % self.snapshot_steps == 0:
             self.checkpointer.save(self._state(), self._gstep)
 
     def on_train_end(self, logs=None):

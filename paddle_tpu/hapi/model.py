@@ -50,6 +50,7 @@ class Model:
         self._optimizer = None
         self._train_step = None   # compiled TrainStep when jit=True
         self._captured_step = None  # FLAGS_step_capture auto-capture
+        self._multi_step = None   # FLAGS_multi_step K-block auto-capture
         self._jit = False
         self.stop_training = False
 
@@ -69,6 +70,7 @@ class Model:
                 amp_configs=None, jit=False):
         self._optimizer = optimizer
         self._captured_step = None   # new opt/loss: stale capture closure
+        self._multi_step = None
         if loss is not None and not (isinstance(loss, Layer)
                                      or callable(loss)):
             raise TypeError("loss must be a Layer or a callable")
@@ -147,16 +149,7 @@ class Model:
         if _flags.get_flag("step_capture"):
             if self._captured_step is None:
                 from ..jit.step_capture import jit_step
-
-                def _eager_step(ins, lbs):
-                    outputs = self._forward_amp(list(ins))
-                    loss = self._loss_value(outputs, list(lbs))
-                    loss.backward()
-                    self._optimizer.step()
-                    self._optimizer.clear_grad()
-                    return loss, outputs
-
-                self._captured_step = jit_step(_eager_step)
+                self._captured_step = jit_step(self._eager_step_fn())
             loss, outputs = self._captured_step(tuple(inputs), tuple(labels))
             return self._with_metric_results(outputs, labels,
                                              [float(np.asarray(loss._data))])
@@ -168,6 +161,22 @@ class Model:
         self._optimizer.clear_grad()
         return self._with_metric_results(outputs, labels,
                                          [float(np.asarray(loss._data))])
+
+    def _eager_step_fn(self):
+        """The whole-step closure both capture regimes compile: one
+        eager step (fwd, tape backward, opt.step/clear_grad) returning
+        (loss, outputs). jit_step captures it as-is; jit_step(k_steps=K)
+        scans the same body K times."""
+
+        def _eager_step(ins, lbs):
+            outputs = self._forward_amp(list(ins))
+            loss = self._loss_value(outputs, list(lbs))
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            return loss, outputs
+
+        return _eager_step
 
     def _forward_amp(self, inputs):
         if self._amp_level:
@@ -254,6 +263,15 @@ class Model:
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
             save_dir=save_dir, metrics=metric_names)
         self.stop_training = False
+        k_steps = self._multi_k(loader, cbks)
+        if k_steps:
+            for c in cbks:
+                if isinstance(c, cbks_mod.ResilientCheckpoint):
+                    # snapshots land on K-block boundaries only, and the
+                    # loader's committed ring cursor rides host_state —
+                    # a mid-K-block preemption resumes byte-identically
+                    c.block_steps = k_steps
+                    c.attach_data_stream(loader)
         cbks.on_train_begin()
         n_labels = len(self._labels)
         for epoch in range(epochs):
@@ -261,14 +279,18 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                ins, lbs = self._split_batch(batch, n_labels)
-                res = self.train_batch(ins, lbs)
-                logs = self._update_logs(res)
-                cbks.on_train_batch_end(step, logs)
-                if self.stop_training:
-                    break
+            if k_steps:
+                logs = self._fit_epoch_multi(loader, cbks, n_labels,
+                                             k_steps, logs)
+            else:
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    ins, lbs = self._split_batch(batch, n_labels)
+                    res = self.train_batch(ins, lbs)
+                    logs = self._update_logs(res)
+                    cbks.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self._run_eval(eval_loader, cbks, n_labels)
@@ -294,6 +316,135 @@ class Model:
         else:
             logs["loss"] = res
         return logs
+
+    # ------------------------------------------------- multi-step (K-blocks)
+    def _multi_k(self, loader, cbks) -> int:
+        """K when FLAGS_multi_step can drive this fit in K-step blocks,
+        else 0. Edges that need per-step host dispatch fall back to the
+        single-step loop with a frozen reason in the flight recorder."""
+        from .. import flags as _flags
+        k = int(_flags.get_flag("multi_step"))
+        if k <= 1 or self._jit or not _flags.get_flag("step_capture"):
+            return 0
+        from ..io import DataLoader, IterableDataset
+        from ..jit.multi_step import record_block_fallback
+        if not isinstance(loader, DataLoader) \
+                or isinstance(loader.dataset, IterableDataset):
+            record_block_fallback(
+                "ring block shorter than k_steps (epoch tail)",
+                "train_data is not a map-style DataLoader — no "
+                "resumable ring to fill; whole run is a tail")
+            return 0
+        unsafe = self._multi_unsafe_reason(cbks)
+        if unsafe:
+            record_block_fallback(
+                "per-step host callbacks need single-step dispatch",
+                unsafe)
+            return 0
+        return k
+
+    def _multi_unsafe_reason(self, cbks) -> Optional[str]:
+        """Blocks run K steps before ANY host hook fires; the per-step
+        callbacks are then replayed post-hoc in order. That is safe for
+        read-only observers, but a hook that MUTATES training state
+        between steps (a by_step schedule, a custom hook) would see —
+        and steer — a different run than single-step dispatch."""
+        for c in cbks:
+            if isinstance(c, cbks_mod.LRScheduler):
+                if c.by_step:
+                    return (f"{type(c).__name__}(by_step=True) steps the "
+                            f"schedule between captured steps")
+                continue
+            if isinstance(c, (cbks_mod.ProgBarLogger,
+                              cbks_mod.ResilientCheckpoint)):
+                continue   # read-only / block-aligned: post-hoc safe
+            if type(c).on_train_batch_begin is not \
+                    cbks_mod.Callback.on_train_batch_begin \
+                    or type(c).on_train_batch_end is not \
+                    cbks_mod.Callback.on_train_batch_end:
+                return f"{type(c).__name__} overrides per-step batch hooks"
+        return None
+
+    def _fit_epoch_multi(self, loader, cbks, n_labels, k, logs):
+        """One epoch in K-step blocks: the DataLoader prefetch thread
+        hands over [K, ...]-stacked RingBlocks, ONE scanned executable
+        trains each block, the loader's committed stream state advances
+        to the block boundary, and only then do the per-step callbacks
+        replay — paired, in order, with the block's [K]-stacked losses
+        read back once. The K-misaligned epoch tail runs through the
+        existing single-step capture."""
+        from ..jit.multi_step import multi_counters
+        rcs = [c for c in cbks if isinstance(c, cbks_mod.ResilientCheckpoint)]
+
+        def blocks():
+            n = 0
+            for b in loader.fill_ring(k):
+                n += 1
+                yield b
+            if n == 0:
+                # a restored cursor can sit EXACTLY on an epoch
+                # boundary — one empty resumed pass is legal, roll
+                # straight into the next epoch (run_data's rule)
+                for b in loader.fill_ring(k):
+                    yield b
+
+        step = 0
+        for block in blocks():
+            if block.stacked is not None:
+                losses, outputs, lbs = self._train_block(block.stacked,
+                                                         n_labels, k)
+                loader._commit_stream_state(block.stream_state)
+                for i in range(block.size):
+                    for c in rcs:   # snapshots only at block-final steps
+                        c._mid_block = i < block.size - 1
+                    cbks.on_train_batch_begin(step)
+                    if self._metrics and outputs:
+                        res = self._with_metric_results(
+                            [Tensor(o._data[i]) for o in outputs],
+                            [Tensor(y._data[i]) for y in lbs],
+                            [losses[i]])
+                    else:
+                        res = losses[i]
+                    logs = self._update_logs(res)
+                    cbks.on_train_batch_end(step, logs)
+                    step += 1
+                    if self.stop_training:
+                        break
+            else:
+                for c in rcs:   # tail steps are ordinary single steps
+                    c._mid_block = False
+                for batch in block.batches:
+                    cbks.on_train_batch_begin(step)
+                    ins, lbs = self._split_batch(batch, n_labels)
+                    res = self.train_batch(ins, lbs)
+                    loader._commit_stream_state(block.stream_state)
+                    logs = self._update_logs(res)
+                    multi_counters["tail_steps"] += 1
+                    cbks.on_train_batch_end(step, logs)
+                    step += 1
+                    if self.stop_training:
+                        break
+            if self.stop_training:
+                break
+        return logs
+
+    def _train_block(self, stacked, n_labels, k):
+        """Train one [K, ...]-stacked block through the K-step scanned
+        executable. Returns (per-step float losses, [K]-stacked output
+        Tensors, [K]-stacked label Tensors) — the latter two feed the
+        post-hoc per-step metric updates by slicing, no extra forward."""
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) before fit"
+        self.network.train()
+        ins, lbs = self._split_batch(stacked, n_labels)
+        ins = [_to_tensor(x) for x in ins]
+        lbs = [_to_tensor(x) for x in lbs]
+        if self._multi_step is None or self._multi_step.k_steps != k:
+            from ..jit.step_capture import jit_step
+            self._multi_step = jit_step(self._eager_step_fn(), k_steps=k)
+        loss, outputs = self._multi_step(tuple(ins), tuple(lbs))
+        losses = [float(v) for v in np.asarray(loss._data)]
+        return losses, _to_list(outputs), lbs
 
     def _run_eval(self, eval_loader, cbks, n_labels):
         cbks.on_eval_begin()

@@ -395,6 +395,10 @@ class DataLoader:
         self._cursor = 0
         self._resume = False
         self._seed = int(np.random.randint(0, 2 ** 31))
+        # ring mode (fill_ring): the prefetch thread's live cursor runs
+        # AHEAD of training by whole blocks, so the public stream state
+        # is pinned to the last COMMITTED block boundary instead
+        self._ring_state: Optional[dict] = None
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
             self.num_workers = 0  # stream datasets stay on the thread path
@@ -425,6 +429,15 @@ class DataLoader:
                 "IterableDataset streams are not resumable: the loader "
                 "cannot re-derive an arbitrary position in user iterator "
                 "state — checkpoint the stream inside the dataset instead")
+        if self._ring_state is not None:
+            # ring mode: the live cursor belongs to the prefetch thread
+            # and may be several K-blocks ahead of the params — resuming
+            # there would SKIP the un-trained prefetched batches. The
+            # committed block boundary is the truth.
+            return dict(self._ring_state)
+        return self._live_state()
+
+    def _live_state(self) -> dict:
         return {"epoch": self._epoch, "batch": self._cursor,
                 "seed": self._seed, "dataset_len": len(self.dataset),
                 "owns_sampler": self._owns_sampler}
@@ -455,6 +468,7 @@ class DataLoader:
         self._cursor = int(sd["batch"])
         self._seed = int(sd["seed"])
         self._resume = True
+        self._ring_state = None    # the live cursor is authoritative again
 
     def _index_batches(self, epoch: int):
         """Deterministic index-batch stream for ``epoch``."""
@@ -521,15 +535,11 @@ class DataLoader:
                 break
             yield item
 
-    def __iter__(self):
-        if isinstance(self.dataset, IterableDataset):
-            src = self._produce_iterable()
-            if self.use_buffer_reader:
-                src = self._buffered(src)
-            for b in src:
-                yield _to_tensors(b)
-            return
-        # map-style: position the (resumable) cursor for this pass
+    def _epoch_batches(self):
+        """One resumable map-style pass of raw collated batches. Cursor
+        accounting is the CALLER's: ``__iter__`` counts on the consumer
+        side (between yields), the ring fill counts on the producer
+        side (its prefetch thread needs per-draw stream states)."""
         if self._resume:
             self._resume = False
             start = self._cursor
@@ -541,17 +551,107 @@ class DataLoader:
         if start:
             idx_iter = itertools.islice(idx_iter, start, None)
         if self.num_workers > 0:
-            src = self._iter_multiprocess(idx_iter)
+            yield from self._iter_multiprocess(idx_iter)
         else:
-            src = (self.collate_fn([self.dataset[i] for i in idxs])
-                   for idxs in idx_iter)
+            for idxs in idx_iter:
+                yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def __iter__(self):
+        if isinstance(self.dataset, IterableDataset):
+            src = self._produce_iterable()
             if self.use_buffer_reader:
                 src = self._buffered(src)
+            for b in src:
+                yield _to_tensors(b)
+            return
+        src = self._epoch_batches()
+        if self.num_workers == 0 and self.use_buffer_reader:
+            src = self._buffered(src)
         for b in src:
             # count the batch as consumed BEFORE handing it out: a
             # state_dict taken between yields resumes AFTER this batch
             self._cursor += 1
             yield _to_tensors(b)
+
+    # -- device-side input ring (multi-step capture) --------------------------
+    def fill_ring(self, k: int):
+        """Hand the epoch to the prefetch thread in ``[K, ...]``-stacked
+        blocks for multi-step capture (``jit_step(fn, k_steps=K)``).
+
+        Yields :class:`RingBlock`\\ s: full blocks carry ``stacked`` (the
+        batch tree with a leading K step axis, stacked before the H2D
+        transfer so the device ring fills asynchronously) and the
+        epoch's K-misaligned tail comes back as size-1 blocks whose
+        ``batches`` route through single-step capture. Every block
+        carries the loader ``stream_state`` measured at its LAST draw;
+        the training driver calls :meth:`_commit_stream_state` with it
+        after the block trains, which pins :meth:`state_dict` to the
+        last committed K-block boundary — a mid-block checkpoint resumes
+        byte-identically even while the prefetch cursor races ahead.
+        """
+        if isinstance(self.dataset, IterableDataset):
+            raise TypeError(
+                "fill_ring needs a resumable map-style stream: "
+                "IterableDataset cannot re-derive a block boundary "
+                "(the same reason it is not state_dict-resumable)")
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"fill_ring: k must be >= 1, got {k}")
+        if self._ring_state is None:
+            # until the first block commits, the committed position is
+            # wherever the stream stood when ring mode began
+            self._ring_state = self._live_state()
+        gen = self._ring_blocks(k)
+        if self.use_buffer_reader:
+            gen = self._buffered(gen)   # block fill + stack runs on the
+        return gen                      # existing prefetch thread
+
+    def _ring_blocks(self, k: int):
+        buf: List[tuple] = []
+        for b in self._epoch_batches():
+            self._cursor += 1           # producer-side: drawn into the ring
+            buf.append((b, self._live_state()))
+            if len(buf) == k:
+                yield RingBlock(_to_tensors(_stack_batches(
+                    [x for x, _ in buf])), None, buf[-1][1], k)
+                buf = []
+        for b, st in buf:               # K-misaligned epoch tail
+            yield RingBlock(None, [_to_tensors(b)], st, 1)
+
+    def _commit_stream_state(self, sd: dict) -> None:
+        """Mark a ring block's batches as TRAINED: ``state_dict`` now
+        resumes after them. Called by the block driver (hapi.Model.fit)
+        once the block's executable has been dispatched."""
+        self._ring_state = dict(sd)
+
+
+class RingBlock:
+    """One K-step slab of the input ring: either a ``stacked`` batch
+    tree (leading axis = step index) for the multi-step executable, or
+    — for the epoch tail — unstacked ``batches`` for single-step
+    capture. ``stream_state`` is the loader position after this block's
+    last draw; committing it makes a checkpoint resume exactly here."""
+
+    __slots__ = ("stacked", "batches", "stream_state", "size")
+
+    def __init__(self, stacked, batches, stream_state, size):
+        self.stacked = stacked
+        self.batches = batches
+        self.stream_state = stream_state
+        self.size = size
+
+
+def _stack_batches(batches: List):
+    """Stack K collated batch trees along a new leading step axis."""
+    b0 = batches[0]
+    if isinstance(b0, np.ndarray):
+        return np.stack(batches)
+    if isinstance(b0, (tuple, list)):
+        return [_stack_batches([b[i] for b in batches])
+                for i in range(len(b0))]
+    if isinstance(b0, dict):
+        return {key: _stack_batches([b[key] for b in batches]) for key in b0}
+    return np.stack([np.asarray(b) for b in batches])
 
 
 def _to_tensors(batch):

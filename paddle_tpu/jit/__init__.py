@@ -18,6 +18,7 @@ from .api import (to_static, TrainStep, not_to_static,  # noqa: F401
                   TranslatedLayer)
 from .api import save, load  # noqa: F401
 from .step_capture import jit_step, CapturedStep  # noqa: F401
+from .multi_step import MultiStepCapture  # noqa: F401
 
 from . import sot  # noqa: E402,F401
 from .sot import symbolic_translate  # noqa: E402,F401

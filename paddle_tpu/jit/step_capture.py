@@ -422,6 +422,80 @@ def _flatten_args(args, kwargs):
             (treedef, leaves, tuple(dyn_pos), tuple(dyn_kind)))
 
 
+def _make_step_body(fn, disc: "_Discovery", rebuild, lr_hosts,
+                    tracebox: Dict[str, Any], outbox: Dict[str, Any]):
+    """Build the pure traced step body the capture jit-compiles.
+
+    Returns ``step_fn(state_arrs, grads_in, packs, key, lrs, dyn) ->
+    (out_arrs, new_state, new_grads, new_packs, key)`` — one full user
+    step (forward through trace-through dispatch, tape backward, grad
+    clip, optimizer update) expressed over explicit array I/O. The body
+    is a valid ``lax.scan`` body as well: its carry-shaped quadruple
+    (state, grads, packs, key) round-trips with matching avals, which is
+    what jit/multi_step.py scans K times inside ONE executable."""
+    state = disc.state
+    state_ids = disc.state_ids
+    opts = disc.opts
+    treedef, leaves, dyn_pos, dyn_kind = rebuild
+    static_leaves = list(leaves)
+    for pos in dyn_pos:
+        static_leaves[pos] = None   # don't pin this call's batch
+
+    def step_fn(state_arrs, grads_in, packs, key, lrs, dyn):
+        tracebox["ran"] = True
+        key, rng = jax.random.split(key)
+        opt_in = {id(o): {"step": pack[2], "lr": lr_t,
+                          "lr_host": lr_v, "calls": 0}
+                  for o, pack, lr_t, lr_v in zip(opts, packs, lrs,
+                                                 lr_hosts)}
+        ctx = _TraceCtx(state_ids, opt_in)
+        saved_opt = [(list(o._states), list(o._masters)) for o in opts]
+        saved_grads = [t._grad for t in state]
+        try:
+            with _swap_state(list(state), list(state_arrs)):
+                for o, pack in zip(opts, packs):
+                    o._states = list(pack[0])
+                    o._masters = list(pack[1])
+                for t, g in zip(state, grads_in):
+                    t._grad = Tensor(g) if g is not None else None
+                _set_trace(ctx)
+                try:
+                    lv = list(static_leaves)
+                    for pos, arr, kind in zip(dyn_pos, dyn, dyn_kind):
+                        lv[pos] = Tensor(arr) if kind == "T" else arr
+                    cargs, ckwargs = jax.tree.unflatten(treedef, lv)
+                    with _traced_rng(rng):
+                        out = fn(*cargs, **ckwargs)
+                finally:
+                    _set_trace(None)
+                # collect while state still holds the traced values
+                out_flat, out_tree = jax.tree.flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                outbox["tree"] = out_tree
+                outbox["is_tensor"] = tuple(
+                    isinstance(x, Tensor) for x in out_flat)
+                out_arrs = tuple(x._data if isinstance(x, Tensor) else x
+                                 for x in out_flat)
+                new_state = tuple(t._data for t in state)
+                new_grads = tuple(
+                    t._grad._data if t._grad is not None else None
+                    for t in state)
+                new_packs = tuple(
+                    (tuple(o._states), tuple(o._masters),
+                     opt_in[id(o)]["step"]
+                     + opt_in[id(o)].get("adv",
+                                         opt_in[id(o)]["calls"]))
+                    for o in opts)
+        finally:
+            for o, (s, m) in zip(opts, saved_opt):
+                o._states, o._masters = s, m
+            for t, g0 in zip(state, saved_grads):
+                t._grad = g0
+        return out_arrs, new_state, new_grads, new_packs, key
+
+    return step_fn
+
+
 class _Captured:
     """A compiled whole-step executable plus its replay binding plan.
 
@@ -551,81 +625,23 @@ class CapturedStep:
     # -- capture -------------------------------------------------------------
     def _attempt_capture(self, key, dyn_arrays, rebuild):
         d = self._disc
-        state = d.state
-        state_ids = d.state_ids
-        treedef, leaves, dyn_pos, dyn_kind = rebuild
-        static_leaves = list(leaves)
-        for pos in dyn_pos:
-            static_leaves[pos] = None   # don't pin this call's batch
-        opts = d.opts
-        fn = self._fn
 
         if self._dev_key is None:
             self._dev_key = generator.next_key()
-        lr_hosts = [float(o.get_lr()) for o in opts]
-        lrs = tuple(jnp.asarray(v, jnp.float32) for v in lr_hosts)
-        packs = tuple(self._opt_pack(o) for o in opts)
-        state_arrs = tuple(t._data for t in state)
+        lr_hosts = [float(o.get_lr()) for o in d.opts]
+        lrs = self._lr_args(d)
+        packs = tuple(self._opt_pack(o) for o in d.opts)
+        state_arrs = tuple(t._data for t in d.state)
         grads_in = tuple(t._grad._data if t._grad is not None else None
-                         for t in state)
+                         for t in d.state)
 
         tracebox: Dict[str, Any] = {}
         outbox: Dict[str, Any] = {}
-
-        def step_fn(state_arrs, grads_in, packs, key, lrs, dyn):
-            tracebox["ran"] = True
-            key, rng = jax.random.split(key)
-            opt_in = {id(o): {"step": pack[2], "lr": lr_t,
-                              "lr_host": lr_v, "calls": 0}
-                      for o, pack, lr_t, lr_v in zip(opts, packs, lrs,
-                                                     lr_hosts)}
-            ctx = _TraceCtx(state_ids, opt_in)
-            saved_opt = [(list(o._states), list(o._masters)) for o in opts]
-            saved_grads = [t._grad for t in state]
-            try:
-                with _swap_state(list(state), list(state_arrs)):
-                    for o, pack in zip(opts, packs):
-                        o._states = list(pack[0])
-                        o._masters = list(pack[1])
-                    for t, g in zip(state, grads_in):
-                        t._grad = Tensor(g) if g is not None else None
-                    _set_trace(ctx)
-                    try:
-                        lv = list(static_leaves)
-                        for pos, arr, kind in zip(dyn_pos, dyn, dyn_kind):
-                            lv[pos] = Tensor(arr) if kind == "T" else arr
-                        cargs, ckwargs = jax.tree.unflatten(treedef, lv)
-                        with _traced_rng(rng):
-                            out = fn(*cargs, **ckwargs)
-                    finally:
-                        _set_trace(None)
-                    # collect while state still holds the traced values
-                    out_flat, out_tree = jax.tree.flatten(
-                        out, is_leaf=lambda x: isinstance(x, Tensor))
-                    outbox["tree"] = out_tree
-                    outbox["is_tensor"] = tuple(
-                        isinstance(x, Tensor) for x in out_flat)
-                    out_arrs = tuple(x._data if isinstance(x, Tensor) else x
-                                     for x in out_flat)
-                    new_state = tuple(t._data for t in state)
-                    new_grads = tuple(
-                        t._grad._data if t._grad is not None else None
-                        for t in state)
-                    new_packs = tuple(
-                        (tuple(o._states), tuple(o._masters),
-                         opt_in[id(o)]["step"]
-                         + opt_in[id(o)].get("adv",
-                                             opt_in[id(o)]["calls"]))
-                        for o in opts)
-            finally:
-                for o, (s, m) in zip(opts, saved_opt):
-                    o._states, o._masters = s, m
-                for t, g0 in zip(state, saved_grads):
-                    t._grad = g0
-            return out_arrs, new_state, new_grads, new_packs, key
+        step_fn = _make_step_body(self._fn, d, rebuild, lr_hosts,
+                                  tracebox, outbox)
 
         snap = _HostSnapshot(d)
-        jfn = jax.jit(step_fn, donate_argnums=(0, 1, 2, 3))
+        jfn = jax.jit(self._wrap_body(step_fn), donate_argnums=(0, 1, 2, 3))
         hook = _span_hook()
         try:
             if hook is not None:
@@ -660,6 +676,33 @@ class CapturedStep:
             self._opt_sync[id(o)] = sync
         return (tuple(o._states), tuple(o._masters), sync[1])
 
+    def _wrap_body(self, step_fn):
+        """Hook for subclasses to reshape the traced body before jit —
+        multi_step wraps it in a K-iteration ``lax.scan``."""
+        return step_fn
+
+    def _lr_args(self, d) -> tuple:
+        """Per-optimizer traced lr arguments for one executable launch
+        (scalars here; a [K] schedule stack in multi_step). Cached so a
+        steady lr pays zero transfers — one H2D per lr CHANGE."""
+        lrs = []
+        for o in d.opts:
+            v = float(o.get_lr())
+            c = self._lr_cache.get(id(o))
+            if c is None or c[0] != v:
+                c = (v, jnp.asarray(v, jnp.float32))
+                self._lr_cache[id(o)] = c
+            lrs.append(c[1])
+        return tuple(lrs)
+
+    def _host_reps(self, host_effects: bool) -> int:
+        """How many per-step host-effect applications (optimizer step
+        counts, scheduler advances) one executable launch owes. The
+        trace itself runs the step's host side once, so a launch that
+        traced pays one fewer than a pure replay — 0 vs 1 here, K-1 vs
+        K for a K-step block."""
+        return 1 if host_effects else 0
+
     # -- replay --------------------------------------------------------------
     def _replay(self, entry: _Captured, dyn_arrays):
         d = entry.disc     # bind state/host effects as captured, not as
@@ -668,14 +711,7 @@ class CapturedStep:
             self._disc = None
             self._entries.clear()
             return None     # caller re-dispatches (re-probes)
-        lrs = []
-        for o in d.opts:
-            v = float(o.get_lr())
-            c = self._lr_cache.get(id(o))
-            if c is None or c[0] != v:   # one transfer per lr CHANGE
-                c = (v, jnp.asarray(v, jnp.float32))
-                self._lr_cache[id(o)] = c
-            lrs.append(c[1])
+        lrs = self._lr_args(d)
         packs = tuple(self._opt_pack(o) for o in d.opts)
         state_arrs = tuple(t._data for t in d.state)
         grads_in = tuple(t._grad._data if t._grad is not None else None
@@ -736,6 +772,7 @@ class CapturedStep:
 
     def _apply_outputs(self, entry: _Captured, outs, host_effects: bool):
         d = entry.disc
+        reps = self._host_reps(host_effects)
         out_arrs, new_state, new_grads, new_packs, new_key = outs
         for t, arr in zip(d.state, new_state):
             t._rebind_donated(arr)
@@ -744,19 +781,19 @@ class CapturedStep:
         for o, pack in zip(d.opts, new_packs):
             o._states = list(pack[0])
             o._masters = list(pack[1])
-            if host_effects:
+            if reps:
                 # sentinel note: whether a guarded update (and its step
                 # advance) applied is on DEVICE only — the optimizer's
                 # cumulative-skip ledger in _anomaly_t lets its next
                 # consume_anomaly() reconcile this host count exactly,
                 # however many replays happened in between
-                o._step_count += d.opt_steps.get(id(o), 0)
+                o._step_count += reps * d.opt_steps.get(id(o), 0)
             self._opt_sync[id(o)] = [o._step_count, pack[2]]
-        if host_effects:
+        if reps:
             for sref, delta in d.sched_deltas:
                 s = sref()
                 if s is not None:
-                    for _ in range(delta):
+                    for _ in range(reps * delta):
                         s.step()
         self._dev_key = new_key
         out_tree, is_tensor = entry.out_is_tensor
@@ -841,7 +878,7 @@ class CapturedStep:
         return out
 
 
-def jit_step(function: Optional[Callable] = None):
+def jit_step(function: Optional[Callable] = None, *, k_steps: int = 1):
     """Wrap a training-step function for whole-step capture.
 
     ``step = paddle_tpu.jit_step(train_step)`` — ``train_step`` runs the
@@ -851,7 +888,17 @@ def jit_step(function: Optional[Callable] = None):
     as a decorator. Gated by ``FLAGS_step_capture``; anything the
     capture cannot express falls back to the eager path with the reason
     in the flight recorder.
+
+    ``k_steps=K`` (K > 1) returns a :class:`~paddle_tpu.jit.multi_step.
+    MultiStepCapture` instead: every call takes a ``[K, ...]``-stacked
+    batch block (leading axis = step index; ``io.DataLoader.fill_ring``
+    builds them) and runs K whole steps inside ONE ``lax.scan``
+    executable, returning ``[K]``-stacked outputs — the host touches
+    the job once per block.
     """
     if function is None:
-        return jit_step
+        return functools.partial(jit_step, k_steps=k_steps)
+    if int(k_steps) > 1:
+        from .multi_step import MultiStepCapture
+        return MultiStepCapture(function, int(k_steps))
     return CapturedStep(function)

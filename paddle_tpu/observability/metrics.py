@@ -71,6 +71,9 @@ METRIC_NAMES = frozenset({
     "step_capture.replays", "step_capture.fallbacks",
     "step_capture.bypass", "step_capture.invalidations",
     "step_capture.static_screened",
+    # jit/multi_step.py (K-step block capture)
+    "multi_step.blocks", "multi_step.replays", "multi_step.fallbacks",
+    "multi_step.tail_steps",
     # distributed/resilience/checkpointer.py
     "checkpoint.snapshot_seconds", "checkpoint.write_seconds",
     "checkpoint.committed", "checkpoint.aborted",

@@ -107,6 +107,7 @@ SPAN_NAMES = frozenset({
     # jit/step_capture.py — the training step
     "step_capture.capture",    # span: trace+lower+compile of a whole step
     "step_capture.replay",     # span: one captured-executable replay
+    "step_capture.multi",      # span: one K-step block (capture or replay)
     # optimizer/optimizer.py
     "optimizer.update",        # span: one eager/traced optimizer.step()
     # distributed/resilience/

@@ -330,7 +330,11 @@ class Optimizer:
         semantics using the device-side cumulative-skip ledger — exact
         however many skipped replays happened since the last read (the
         eager path reconciles inline at its deferred sync and advances
-        the ledger mirror, so it never double-counts here)."""
+        the ledger mirror, so it never double-counts here). The same
+        cumulative ledger gives K-step blocks (jit/multi_step.py)
+        per-lane skip semantics for free: the sentinel rides the scan
+        carry, each in-loop iteration adds its own skip, and one
+        consume per K-block reconciles them all."""
         t = self._anomaly_t
         if t is None or isinstance(t._data, jax.core.Tracer):
             return None

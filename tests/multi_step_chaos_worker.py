@@ -1,0 +1,107 @@
+"""Chaos-harness worker for K-step block training (driven by
+tests/test_multi_step.py).
+
+One single-rank deterministic run wired through
+``ResilientTrainer.run_blocks``: a resumable ``DataLoader`` feeds
+K-step ring blocks into a ``jit_step(..., k_steps=K)`` scanned
+executable, snapshots land on K-block boundaries only, and the
+journaled ring cursor makes a relaunch replay the exact remaining
+batch sequence. The parent injects SIGKILL mid-K-block; the relaunch
+must restore the last COMMITTED block boundary and retrace the exact
+loss curve an uninterrupted run from that generation produces.
+
+Sample i of the dataset is a pure function of i and every incarnation
+iterates unshuffled, so (step → batch) is a fixed map: loss continuity
+across the kill proves both the parameter restore AND the ring-cursor
+restore are byte-identical.
+
+argv: out_dir ckpt_dir total_steps
+env:  CHAOS_ATTEMPT [CHAOS_STEP_SLEEP] [CHAOS_K]
+
+exit: 0 completed
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+EXIT_CODES = {"completed": 0, "checkpoint_exit": 64, "restart": 75}
+
+
+def main() -> int:
+    out_dir, ckpt_dir, total_steps = (sys.argv[1], sys.argv[2],
+                                      int(sys.argv[3]))
+    attempt = int(os.environ["CHAOS_ATTEMPT"])
+    step_sleep = float(os.environ.get("CHAOS_STEP_SLEEP", "0.05"))
+    k = int(os.environ.get("CHAOS_K", "4"))
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.resilience import (AsyncCheckpointer,
+                                                   ResilientTrainer)
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Synth(Dataset):
+        def __init__(self, n):
+            self.n = n
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            r = np.random.RandomState(1000 + i)
+            x = r.rand(8).astype(np.float32)
+            return x, x.sum(keepdims=True).astype(np.float32)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+
+    def step(x, y):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    fn = paddle.jit_step(step, k_steps=k)
+
+    # 32 samples / batch 4 = 8 batches per epoch = 2 K-blocks when K=4,
+    # so committed boundaries land both mid-epoch and at epoch edges;
+    # epochs chain inside run_blocks until total_steps
+    loader = DataLoader(Synth(32), batch_size=4, shuffle=False)
+
+    losses = open(os.path.join(out_dir, f"losses_a{attempt}.jsonl"), "a")
+
+    def train_block(start, block):
+        xs, ys = block.stacked
+        out = fn(xs, ys)
+        vals = [float(v) for v in np.asarray(out._data)]
+        for i, lv in enumerate(vals):
+            losses.write(json.dumps({"step": start + i, "loss": lv}) + "\n")
+        losses.flush()
+        time.sleep(step_sleep)   # keep kills landing mid-run, not post-run
+        return vals
+
+    def state_fn():
+        return {"model": net.state_dict(), "opt": opt.state_dict()}
+
+    def apply_fn(rebuilt, resume):
+        opt.set_state_dict(rebuilt["opt"])
+
+    ck = AsyncCheckpointer(ckpt_dir, keep=4)
+    tr = ResilientTrainer(ck, state_fn, apply_fn, snapshot_every=4,
+                          install_signal=False, data_loader=loader)
+    action = tr.run_blocks(train_block, total_steps, k)
+    with open(os.path.join(out_dir, f"result_a{attempt}.json"), "w") as f:
+        json.dump({"action": action, "resume": tr.resume_step,
+                   "stream": loader.state_dict()}, f)
+    return EXIT_CODES[action]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -277,6 +277,38 @@ class TestStepCaptureMicro:
         assert got["FLAGS_step_capture"] is True
 
 
+class TestMultiStepMicro:
+    def test_micro_runs_and_meets_gate(self):
+        """bench.py multi_step smoke (ISSUE 15 acceptance): a K=16
+        lax.scan block must beat single-step capture by >=1.3x per step
+        on the dispatch-bound MLP micro, with ONE executable serving
+        every timed K-block. The speedup is a wall-clock gate: one
+        retry absorbs a busy host."""
+        r = bench.bench_multi_step(False)
+        if r["value"] < 1.3:        # timing gate: wall clock on a
+            r = bench.bench_multi_step(False)   # shared CI host
+        assert r["metric"] == "multi_step_speedup_k16"
+        assert r["unit"] == "x_vs_single_step_capture"
+        d = r["detail"]
+        assert d["gate_model"] == "mlp"         # CPU run
+        for k in ("k1", "k4", "k16"):
+            assert d["mlp_us_per_step"][k] > 0.0
+            assert d["bert_tiny_us_per_step"][k] > 0.0
+        # ONE executable per K-block: at most one capture per
+        # (model, K) pair — 2 models x K in {1,4,16} — while the timed
+        # loops replayed blocks far more often than that
+        assert 0 < d["executables_built"] <= 6
+        assert d["block_replays"] > d["executables_built"]
+        assert d["counters"]["fallbacks"] == 0
+        # the acceptance gate itself (>=1.3x at K=16)
+        assert r["value"] >= 1.3, r
+        assert r["vs_baseline"] >= 1.0
+        # the flag the micro toggles must be restored afterwards
+        import paddle_tpu as paddle
+        got = paddle.get_flags(["FLAGS_step_capture"])
+        assert got["FLAGS_step_capture"] is True
+
+
 class TestCheckpointOverlapMicro:
     def test_micro_runs_and_meets_gate(self):
         """bench.py checkpoint_overlap smoke (ISSUE 7 acceptance): async

@@ -1,0 +1,603 @@
+"""Multi-step capture (jit/multi_step.py): one K-step ``lax.scan``
+block must be BITWISE equivalent to K sequential single-step captured
+replays — params, optimizer state, step counts, host-replayed schedule
+and anomaly skips — across the optimizer zoo x {scheduler, clip, bf16
+masters}; the DataLoader ring must hand out [K]-stacked blocks whose
+committed stream cursor resumes byte-identically; the hapi fit
+auto-path must drive blocks (falling back to single-step dispatch on
+the frozen edges); and the K-block resilience plumbing must snapshot,
+restore and rewind on block boundaries only."""
+
+import json
+import os
+import signal
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import multi_step as ms
+from paddle_tpu.jit import step_capture as sc
+from paddle_tpu.jit.multi_step import MultiStepCapture, multi_counters
+from paddle_tpu.observability import flight_recorder as fr
+
+_WORKER = os.path.join(os.path.dirname(__file__),
+                       "multi_step_chaos_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _flags():
+    paddle.set_flags({"FLAGS_step_capture": True, "FLAGS_multi_step": 0})
+    yield
+    paddle.set_flags({"FLAGS_step_capture": True, "FLAGS_multi_step": 0})
+
+
+def f32(seed, *shape):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+OPTS = ("sgd", "adam", "adamw")
+
+
+def _build(opt_name, variant):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3))
+    if variant == "bf16":
+        net.to(dtype="bfloat16")
+    lr = (paddle.optimizer.lr.StepDecay(0.05, step_size=2, gamma=0.5)
+          if variant == "sched" else 0.05)
+    clip = nn.ClipGradByGlobalNorm(1.0) if variant == "clip" else None
+    mk = {
+        "sgd": lambda: paddle.optimizer.SGD(
+            learning_rate=lr, parameters=net.parameters(), grad_clip=clip),
+        "adam": lambda: paddle.optimizer.Adam(
+            learning_rate=lr, parameters=net.parameters(), grad_clip=clip),
+        "adamw": lambda: paddle.optimizer.AdamW(
+            learning_rate=lr, weight_decay=0.01,
+            parameters=net.parameters(), grad_clip=clip),
+    }[opt_name]
+    opt = mk()
+    ce = nn.CrossEntropyLoss()
+
+    def step(x, y):
+        out = net(x)
+        if variant == "bf16":
+            out = out.astype("float32")
+        loss = ce(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if variant == "sched":
+            lr.step()
+        return loss
+
+    return net, opt, step
+
+
+_Y = np.array([0, 1, 2, 0], np.int64)
+
+
+def _x(i, variant):
+    t = paddle.to_tensor(f32(i, 4, 6))
+    return t.astype("bfloat16") if variant == "bf16" else t
+
+
+def _run_single(opt_name, variant, n):
+    net, opt, step = _build(opt_name, variant)
+    fn = paddle.jit_step(step)
+    losses = [float(fn(_x(i, variant), paddle.to_tensor(_Y)))
+              for i in range(n)]
+    return losses, net, opt
+
+
+def _run_multi(opt_name, variant, k, blocks):
+    net, opt, step = _build(opt_name, variant)
+    fn = paddle.jit_step(step, k_steps=k)
+    assert isinstance(fn, MultiStepCapture)
+    losses = []
+    for b in range(blocks):
+        xs = paddle.to_tensor(np.stack([f32(b * k + i, 4, 6)
+                                        for i in range(k)]))
+        if variant == "bf16":
+            xs = xs.astype("bfloat16")
+        out = fn(xs, paddle.to_tensor(np.stack([_Y] * k)))
+        losses.extend(float(v) for v in np.asarray(out._data))
+    return losses, net, opt
+
+
+class TestBlockMatchesSequentialReplays:
+    """K-step block == K sequential single-step captured replays."""
+
+    @pytest.mark.parametrize("opt_name", OPTS)
+    @pytest.mark.parametrize("variant", ("plain", "sched", "clip"))
+    def test_bitwise_fp32(self, opt_name, variant):
+        k, blocks = 4, 3
+        ls, net_s, opt_s = _run_single(opt_name, variant, k * blocks)
+        before = dict(multi_counters)
+        lm, net_m, opt_m = _run_multi(opt_name, variant, k, blocks)
+        after = dict(multi_counters)
+        assert after["blocks"] > before["blocks"], \
+            "block capture never engaged — test is vacuous"
+        assert after["replays"] > before["replays"]
+        # fp32 is BITWISE: same ops in the same order, scanned or not
+        assert ls == lm
+        for a, b in zip(net_s.parameters(), net_m.parameters()):
+            assert a._data.dtype == b._data.dtype
+            assert np.array_equal(np.asarray(a._data), np.asarray(b._data))
+        for se, sm in zip(opt_s._states, opt_m._states):
+            if se is None:
+                assert sm is None
+                continue
+            for key in se:
+                assert np.array_equal(np.asarray(se[key]),
+                                      np.asarray(sm[key]))
+        assert opt_s._step_count == opt_m._step_count
+        assert opt_s.get_lr() == opt_m.get_lr()   # [K] lr stack replayed
+
+    @pytest.mark.parametrize("opt_name", OPTS)
+    def test_bf16_matches_to_epsilon(self, opt_name):
+        # XLA lowers bf16 differently inside a scan body than in a
+        # standalone executable (fusion boundaries move the rounding
+        # points), so agreement is bounded by bf16 epsilon — dtypes,
+        # master existence and step accounting must still be EXACT
+        k, blocks = 4, 3
+        ls, net_s, opt_s = _run_single(opt_name, "bf16", k * blocks)
+        lm, net_m, opt_m = _run_multi(opt_name, "bf16", k, blocks)
+        np.testing.assert_allclose(ls, lm, rtol=1e-2, atol=2e-3)
+        for a, b in zip(net_s.parameters(), net_m.parameters()):
+            assert a._data.dtype == b._data.dtype
+            np.testing.assert_allclose(
+                np.asarray(a._data, np.float32),
+                np.asarray(b._data, np.float32), rtol=1e-2, atol=2e-3)
+        for me, mm in zip(opt_s._masters, opt_m._masters):
+            assert (me is None) == (mm is None)
+            if me is not None:
+                assert me.dtype == mm.dtype
+                np.testing.assert_allclose(np.asarray(me), np.asarray(mm),
+                                           rtol=1e-2, atol=2e-3)
+        assert opt_s._step_count == opt_m._step_count
+
+    def test_anomaly_sentinel_parity(self):
+        """A poisoned batch inside a block must be skipped by the
+        in-scan sentinel exactly as the single-step path skips it:
+        same params, same reconciled step count, same consume()."""
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True})
+        try:
+            k, blocks, poison = 4, 3, 5
+
+            def batch(i):
+                x = f32(i, 4, 6)
+                if i == poison:
+                    x[0, 0] = np.nan
+                return x
+
+            net_s, opt_s, step_s = _build("adam", "plain")
+            fn_s = paddle.jit_step(step_s)
+            for i in range(k * blocks):
+                fn_s(paddle.to_tensor(batch(i)), paddle.to_tensor(_Y))
+            net_m, opt_m, step_m = _build("adam", "plain")
+            fn_m = paddle.jit_step(step_m, k_steps=k)
+            for b in range(blocks):
+                xs = np.stack([batch(b * k + i) for i in range(k)])
+                fn_m(paddle.to_tensor(xs),
+                     paddle.to_tensor(np.stack([_Y] * k)))
+            sent_s = opt_s.consume_anomaly()
+            sent_m = opt_m.consume_anomaly()   # once per K-block is enough
+            assert sent_s == sent_m
+            assert opt_s._step_count == opt_m._step_count \
+                == k * blocks - 1   # the poisoned update was dropped
+            for a, b in zip(net_s.parameters(), net_m.parameters()):
+                assert np.array_equal(np.asarray(a._data),
+                                      np.asarray(b._data))
+        finally:
+            paddle.set_flags({"FLAGS_anomaly_sentinel": False})
+
+    def test_malformed_leading_axis_raises(self):
+        _, _, step = _build("sgd", "plain")
+        fn = paddle.jit_step(step, k_steps=4)
+        with pytest.raises(ValueError, match="step axis"):
+            fn(paddle.to_tensor(f32(0, 3, 6)),   # [3,...] into a K=4 block
+               paddle.to_tensor(np.stack([_Y] * 4)))
+
+    def test_k1_returns_plain_capture(self):
+        _, _, step = _build("sgd", "plain")
+        fn = paddle.jit_step(step)
+        assert not isinstance(fn, MultiStepCapture)
+        assert isinstance(paddle.jit_step(step, k_steps=3),
+                          MultiStepCapture)
+        with pytest.raises(ValueError):
+            MultiStepCapture(step, k_steps=1)
+
+
+# --------------------------------------------------------------- data ring
+
+class _Seq:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full((3,), i, np.float32),
+                np.array([i], np.int64))
+
+
+def _make_loader(n=40, bs=4):
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Seq(Dataset):
+        __len__ = _Seq.__len__
+        __getitem__ = _Seq.__getitem__
+
+        def __init__(self, n):
+            self.n = n
+
+    return DataLoader(Seq(n), batch_size=bs, shuffle=False)
+
+
+class TestDataRing:
+    def test_blocks_and_tail(self):
+        loader = _make_loader(n=40, bs=4)   # 10 batches
+        sizes = []
+        for block in loader.fill_ring(4):
+            if block.stacked is not None:
+                xs, ys = block.stacked
+                assert xs._data.shape == (4, 4, 3)   # [K, batch, feat]
+                assert ys._data.shape == (4, 4, 1)
+                sizes.append(block.size)
+            else:
+                assert len(block.batches) == 1 and block.size == 1
+                sizes.append(0)   # tail marker
+        assert sizes == [4, 4, 0, 0]   # 2 full blocks + 2 tail batches
+
+    def test_commit_resume_byte_identical(self):
+        loader = _make_loader()
+        gen = loader.fill_ring(4)
+        first = next(gen)
+        second = next(gen)
+        loader._commit_stream_state(first.stream_state)
+        committed = loader.state_dict()   # pinned to the COMMITTED block
+        del gen, second
+
+        fresh = _make_loader()
+        fresh.load_state_dict(committed)
+        resumed = next(fresh.fill_ring(4))
+        # batches 4..7: the exact block that followed the committed one
+        # (sample value == sample index, so the cursor is directly
+        # readable from the data)
+        xs, _ = resumed.stacked
+        got = np.asarray(xs._data)
+        assert got.shape == (4, 4, 3)
+        assert np.array_equal(got[:, 0, 0],
+                              np.array([16, 20, 24, 28], np.float32))
+
+    def test_public_state_lags_live_cursor(self):
+        loader = _make_loader()
+        gen = loader.fill_ring(4)
+        b0 = next(gen)
+        loader._commit_stream_state(b0.stream_state)
+        next(gen)   # ring runs ahead of the committed cursor
+        assert loader.state_dict()["batch"] == b0.stream_state["batch"]
+        # plain resume from the live cursor returns once load_state_dict
+        # reinstalls an authoritative position
+        loader.load_state_dict(b0.stream_state)
+        assert loader._ring_state is None
+
+    def test_iterable_dataset_raises(self):
+        from paddle_tpu.io import DataLoader, IterableDataset
+
+        class It(IterableDataset):
+            def __iter__(self):
+                yield np.zeros((3,), np.float32)
+
+        loader = DataLoader(It(), batch_size=2)
+        with pytest.raises(TypeError):
+            next(loader.fill_ring(4))
+
+    def test_bad_k_raises(self):
+        with pytest.raises(ValueError):
+            next(_make_loader().fill_ring(0))
+
+    def test_plain_iteration_unchanged(self):
+        loader = _make_loader(n=12, bs=4)
+        a = [np.asarray(x._data).copy() for x, _ in loader]
+        b = [np.asarray(x._data).copy() for x, _ in loader]
+        assert all(np.array_equal(p, q) for p, q in zip(a, b))
+
+
+# ------------------------------------------------------- hapi fit auto-path
+
+class TestFitAutoPath:
+    def _model(self):
+        from paddle_tpu.hapi import Model
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(3, 8), nn.Tanh(), nn.Linear(8, 3))
+        m = Model(net)
+        m.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+        return m, net
+
+    def _data(self, n=22):
+        from paddle_tpu.io import Dataset
+
+        class D(Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return (f32(i, 3), np.array([i % 3], np.int64))
+
+        return D()
+
+    def test_blocks_tail_and_equivalence(self):
+        # 22 samples / bs 4 = 6 batches: 1 K-block + 2 tail per epoch;
+        # 3 epochs: probe, capture, replay
+        paddle.set_flags({"FLAGS_multi_step": 4})
+        before = dict(multi_counters)
+        m1, net1 = self._model()
+        m1.fit(self._data(), batch_size=4, epochs=3, shuffle=False,
+               verbose=0)
+        d = {key: multi_counters[key] - before[key]
+             for key in multi_counters}
+        assert d["blocks"] == 2 and d["replays"] == 1, d
+        assert d["tail_steps"] == 6, d
+        assert m1._optimizer._step_count == 18
+
+        paddle.set_flags({"FLAGS_multi_step": 0})
+        m2, net2 = self._model()
+        m2.fit(self._data(), batch_size=4, epochs=3, shuffle=False,
+               verbose=0)
+        for a, b in zip(net1.parameters(), net2.parameters()):
+            assert np.array_equal(np.asarray(a._data), np.asarray(b._data))
+
+    def test_unsafe_callback_falls_back(self):
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Spy(Callback):
+            steps = 0
+
+            def on_train_batch_end(self, step, logs=None):
+                Spy.steps += 1
+
+        paddle.set_flags({"FLAGS_multi_step": 4})
+        before = dict(multi_counters)
+        m, _ = self._model()
+        m.fit(self._data(), batch_size=4, epochs=1, shuffle=False,
+              verbose=0, callbacks=[Spy()])
+        d = {key: multi_counters[key] - before[key]
+             for key in multi_counters}
+        assert d["blocks"] == 0 and d["fallbacks"] >= 1, d
+        assert Spy.steps == 6   # every step still dispatched singly
+
+    def test_snapshots_on_block_boundaries_only(self, tmp_path):
+        paddle.set_flags({"FLAGS_multi_step": 4})
+        m, _ = self._model()
+        m.fit(self._data(), batch_size=4, epochs=2, shuffle=False,
+              verbose=0, resilience_dir=str(tmp_path), snapshot_steps=4)
+        gens = sorted(int(n.split("-")[1]) for n in os.listdir(tmp_path)
+                      if n.startswith("step-"))
+        # epoch = 1 block (steps 1-4) + 2 tails (5,6). Boundary-aligned
+        # crossings: 4 (block end), 10 (first boundary past 8), final 12.
+        # A naive `% == 0` would have snapshotted step 8 — an INTERIOR
+        # step of epoch 2's block, tagging future params with a past step
+        assert gens == [4, 10, 12], gens
+
+    def test_resume_restores_ring_cursor(self, tmp_path):
+        paddle.set_flags({"FLAGS_multi_step": 4})
+        m, _ = self._model()
+        m.fit(self._data(), batch_size=4, epochs=2, shuffle=False,
+              verbose=0, resilience_dir=str(tmp_path), snapshot_steps=4)
+        steps_before = m._optimizer._step_count
+        m2, _ = self._model()
+        m2.fit(self._data(), batch_size=4, epochs=1, shuffle=False,
+               verbose=0, resilience_dir=str(tmp_path), snapshot_steps=4)
+        # restored params + opt state, then one more epoch of 6 steps
+        assert m2._optimizer._step_count == steps_before + 6
+
+
+# --------------------------------------------- K-block resilience plumbing
+
+class TestBlockResilience:
+    def _trainer(self, tmp_path, **kw):
+        from paddle_tpu.distributed.resilience import (AsyncCheckpointer,
+                                                       ResilientTrainer)
+        state = {"w": np.zeros((2,), np.float32)}
+        ck = AsyncCheckpointer(str(tmp_path))
+        return ResilientTrainer(ck, lambda: dict(state), None,
+                                install_signal=False, **kw)
+
+    def test_poll_block_crossing(self, tmp_path):
+        tr = self._trainer(tmp_path, snapshot_every=5)
+        saved = []
+        tr.checkpointer.save = lambda st, step, block=False: \
+            saved.append(step)
+        for last in (3, 7, 11, 15, 19):   # K=4 block-final steps
+            tr.poll(last, block_steps=4)
+        # crossings of 5/10/15 land on the first boundary past each
+        assert saved == [7, 11, 15], saved
+
+    def test_poll_single_step_unchanged(self, tmp_path):
+        tr = self._trainer(tmp_path, snapshot_every=5)
+        saved = []
+        tr.checkpointer.save = lambda st, step, block=False: \
+            saved.append(step)
+        for step in range(12):
+            tr.poll(step)
+        assert saved == [5, 10], saved
+
+    def test_should_skip_block(self, tmp_path):
+        tr = self._trainer(tmp_path, snapshot_every=0)
+        tr._skip_window = (9, 10)
+        assert not tr.should_skip_block(4, 4)    # [4,7] misses
+        assert tr.should_skip_block(8, 4)        # [8,11] overlaps
+        assert tr.should_skip_block(10, 4)       # [10,13] overlaps
+        assert not tr.should_skip_block(12, 4)   # [12,15] misses
+        tr._skip_window = None
+        assert not tr.should_skip_block(8, 4)
+
+    def test_run_blocks_rewind_skips_whole_blocks(self, tmp_path):
+        """Host-injected NaN losses at steps 8-9 escalate to REWIND;
+        the replay must restore the committed block boundary and drop
+        the ENTIRE poison block [8,11] from the stream — the window is
+        measured in steps but consumed in K-blocks."""
+        from paddle_tpu.distributed.resilience import AnomalyDetector
+        loader = _make_loader(n=64, bs=4)   # 16 batches, no tails
+        tr = self._trainer(tmp_path, snapshot_every=4,
+                           anomaly=AnomalyDetector(nonfinite_streak=2),
+                           data_loader=loader)
+        trained = []
+        poisoned = []
+
+        def train_block(start, block):
+            trained.append(start)
+            out = []
+            for i in range(block.size):
+                s = start + i
+                if s in (8, 9) and s not in poisoned:
+                    poisoned.append(s)
+                    out.append(float("nan"))
+                else:
+                    out.append(1.0)
+            return out
+
+        from paddle_tpu.distributed.resilience import TrainerAction
+        assert tr.run_blocks(train_block, 16, 4) == \
+            TrainerAction.COMPLETED
+        # snapshot committed at step 7; rewind at 9 → window [8,9];
+        # block [8,11] skipped whole, training resumes at 12
+        assert trained == [0, 4, 8, 12], trained
+        assert tr._skip_window == (8, 9)
+        # the skipped block still advanced the committed ring cursor
+        assert loader.state_dict()["batch"] in (0, 16)
+
+    def test_run_blocks_snapshots_and_completes(self, tmp_path):
+        loader = _make_loader(n=32, bs=4)   # 8 batches = 2 blocks/epoch
+        tr = self._trainer(tmp_path, snapshot_every=4, data_loader=loader)
+        starts = []
+        from paddle_tpu.distributed.resilience import TrainerAction
+        assert tr.run_blocks(
+            lambda s, b: (starts.append(s) or [0.0] * b.size),
+            16, 4) == TrainerAction.COMPLETED
+        assert starts == [0, 4, 8, 12]
+        gens = sorted(int(n.split("-")[1]) for n in os.listdir(tmp_path)
+                      if n.startswith("step-"))
+        assert gens and all((g + 1) % 4 == 0 for g in gens), gens
+
+
+# ----------------------------------------------------- taxonomy and counters
+
+class TestTaxonomy:
+    def test_counters_registered(self):
+        from paddle_tpu.observability.metrics import METRIC_NAMES
+        for key in ("blocks", "replays", "fallbacks", "tail_steps"):
+            assert f"multi_step.{key}" in METRIC_NAMES
+
+    def test_span_registered(self):
+        from paddle_tpu.observability.tracing import SPAN_NAMES
+        assert "step_capture.multi" in SPAN_NAMES
+
+    def test_fallback_reasons_frozen(self):
+        assert isinstance(ms.MULTI_STEP_FALLBACK_REASONS, frozenset)
+        with pytest.raises(ValueError, match="unregistered"):
+            ms.record_block_fallback("made-up reason")
+
+    def test_record_block_fallback(self):
+        before = multi_counters["fallbacks"]
+        entry = paddle.get_flags(
+            ["FLAGS_flight_recorder"])["FLAGS_flight_recorder"]
+        paddle.set_flags({"FLAGS_flight_recorder": True})
+        try:
+            ms.record_block_fallback(
+                "per-step host callbacks need single-step dispatch",
+                "TestCallback overrides per-step batch hooks")
+            events = [e for e in fr.recorder().entries()
+                      if e[3] == "multi_step.fallback"]
+            assert events and events[-1][5] == \
+                "per-step host callbacks need single-step dispatch"
+        finally:
+            paddle.set_flags({"FLAGS_flight_recorder": entry})
+        assert multi_counters["fallbacks"] == before + 1
+
+
+# ----------------------------------------------------- chaos harness (slow)
+
+def _read_losses(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            out[rec["step"]] = rec["loss"]
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.heavy
+class TestMultiStepChaos:
+    TOTAL = 24
+    K = 4
+
+    def _spawn(self, tmp_path, attempt, ckpt="ckpt", sleep="0.15"):
+        env = dict(os.environ,
+                   CHAOS_ATTEMPT=str(attempt),
+                   CHAOS_STEP_SLEEP=sleep,
+                   CHAOS_K=str(self.K),
+                   PYTHONPATH=os.path.dirname(os.path.dirname(_WORKER)))
+        return subprocess.Popen(
+            [sys.executable, _WORKER, str(tmp_path / "out"),
+             str(tmp_path / ckpt), str(self.TOTAL)], env=env)
+
+    def _wait_for_steps(self, tmp_path, attempt, n, timeout=180):
+        path = tmp_path / "out" / f"losses_a{attempt}.jsonl"
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if path.exists() and len(path.read_text().splitlines()) >= n:
+                return
+            time.sleep(0.2)
+        raise AssertionError(f"attempt {attempt} never reached step {n}")
+
+    def test_sigkill_mid_block_resumes_on_boundary(self, tmp_path):
+        (tmp_path / "out").mkdir()
+        p = self._spawn(tmp_path, attempt=0)
+        try:
+            # let at least two K-blocks commit, then kill mid-run
+            self._wait_for_steps(tmp_path, 0, 10)
+            os.kill(p.pid, signal.SIGKILL)
+            assert p.wait(timeout=60) == -signal.SIGKILL
+        finally:
+            if p.poll() is None:
+                p.kill()
+
+        # uninterrupted reference from the SAME committed generation
+        shutil.copytree(tmp_path / "ckpt", tmp_path / "refckpt")
+        ref = self._spawn(tmp_path, attempt=99, ckpt="refckpt", sleep="0.0")
+        assert ref.wait(timeout=300) == 0
+        ref_res = json.load(open(tmp_path / "out" / "result_a99.json"))
+
+        # relaunch on the original checkpoint root
+        p1 = self._spawn(tmp_path, attempt=1, sleep="0.0")
+        assert p1.wait(timeout=300) == 0
+        res = json.load(open(tmp_path / "out" / "result_a1.json"))
+        assert res["action"] == "completed"
+        resume = res["resume"]
+        assert resume == ref_res["resume"]
+        # the committed generation is a K-block boundary: resume ≡ 0 (K)
+        assert resume % self.K == 0 and resume >= self.K
+        # ring cursor continuity: both incarnations end at the same
+        # committed stream position
+        assert res["stream"] == ref_res["stream"]
+
+        # loss-curve continuity: every step from the boundary to the end
+        # retraces the uninterrupted reference bitwise-closely
+        got = _read_losses(tmp_path / "out" / "losses_a1.jsonl")
+        reference = _read_losses(tmp_path / "out" / "losses_a99.jsonl")
+        assert sorted(got) == list(range(resume, self.TOTAL))
+        for s in range(resume, self.TOTAL):
+            np.testing.assert_allclose(got[s], reference[s], rtol=1e-6,
+                                       err_msg=f"loss diverged at {s}")

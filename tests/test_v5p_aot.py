@@ -71,14 +71,18 @@ class TestParamSpecs:
         assert specs["llama.norm.weight"] == P()
 
 
+@pytest.fixture(scope="module")
+def plan():
+    # depth-reduced 8B geometry (hidden 4096 / ffn 14336 / GQA 32:8)
+    # on a real v5p-64 topology — same sharded program structure as
+    # the full model, ~2 min compile; module scope so every assertion
+    # class shares the ONE compile (and aot._topology_desc memoizes the
+    # topology client underneath it)
+    return aot.plan_llama3_8b_v5p64(tp=8, dp=8, layers=2, seq=2048)
+
+
 @pytest.mark.heavy
 class TestV5pAotCompile:
-    @pytest.fixture(scope="class")
-    def plan(self):
-        # depth-reduced 8B geometry (hidden 4096 / ffn 14336 / GQA 32:8)
-        # on a real v5p-64 topology — same sharded program structure as
-        # the full model, ~2 min compile
-        return aot.plan_llama3_8b_v5p64(tp=8, dp=8, layers=2, seq=2048)
 
     def test_compile_succeeds(self, plan):
         assert plan["compile_seconds"] > 0
@@ -126,7 +130,11 @@ class TestV5pAotCompile:
         assert c["all-reduce"] >= 2 * 2 * 2
         assert c["collective-permute"] == 0   # nothing rides DCN-shaped paths
 
+    @pytest.mark.slow
     def test_zero1_shrinks_per_chip_state(self, plan):
+        # a SECOND full XLA:TPU compile (~2 min) — the only test here
+        # that can't share the module-scoped plan, so it rides the slow
+        # tier; tier-1 keeps the six assertions on the shared compile
         z = aot.plan_llama3_8b_v5p64(tp=8, dp=8, layers=2, seq=2048,
                                      zero1=True)
         assert (z["per_chip_bytes"]["arguments"]
