@@ -2576,6 +2576,243 @@ def bench_checkpoint_overlap(on_tpu: bool):
     }
 
 
+def bench_fused_optimizer(on_tpu: bool):
+    """Fused optimizer megakernel micro (ISSUE 16 acceptance): the
+    dtype-bucketed single-kernel update route vs the optimizer update it
+    replaces, across {sgd, adam, adamw} x {fp32, bf16 masters} x
+    {small_many, large_few} parameter sets.
+
+    Three variants per cell, labeled honestly:
+      - per_param_chain: ONE jit launch per parameter (the reference's
+        standard non-multi-tensor optimizer loop — what the paddle
+        phi/kernels/fusion multi-tensor kernels replace). Gate baseline.
+      - pytree: this repo's own per-param path (FLAGS_fused_optimizer
+        off) — ALREADY one whole-pytree XLA program per step, so it
+        amortizes launches; the megakernel's eager marginal win over it
+        on a CPU host is small (~1.0-1.2x, host-dispatch bound) and the
+        bucketing payoff concentrates on the Pallas/TPU route and the
+        captured training tail (fewer programs to compile and launch).
+      - fused: FLAGS_fused_optimizer on (bucketed megakernel route).
+
+    Gate: fused >= 2x per_param_chain on the dispatch-bound cell
+    (adam / fp32 / small_many) — launch-chain amortization is the
+    megakernel's reason to exist and holds on CPU and TPU alike.
+
+    Also re-measures the BERT-tiny vs native-twin gap UNDER MULTI-STEP
+    (K=8 scan blocks) with the fused route off vs on, so the bench
+    artifact records before/after-fused numbers for the training tail.
+    """
+    import gc
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.optimizer import optimizer as opt_mod
+
+    entry = paddle.get_flags(["FLAGS_fused_optimizer",
+                              "FLAGS_step_capture"])
+    SIZES = {"small_many": [(64,)] * 48, "large_few": [(256, 256)] * 4}
+    OPTS = ("sgd", "adam", "adamw")
+    steps = {"small_many": 20, "large_few": 10}
+
+    def build(name, shapes, bf16):
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        params = [Tensor(jnp.asarray((rng.randn(*s) * 0.1)
+                                     .astype(np.float32)),
+                         stop_gradient=False) for s in shapes]
+        if bf16:
+            params = [Tensor(p._data.astype(jnp.bfloat16),
+                             stop_gradient=False) for p in params]
+        O = paddle.optimizer
+        opt = {"sgd": lambda: O.SGD(learning_rate=1e-3, parameters=params),
+               "adam": lambda: O.Adam(learning_rate=1e-3, weight_decay=0.01,
+                                      parameters=params),
+               "adamw": lambda: O.AdamW(learning_rate=1e-3,
+                                        weight_decay=0.01,
+                                        parameters=params),
+               }[name]()
+        grads = [jnp.asarray(np.random.RandomState(7 + i)
+                             .randn(*s).astype(np.float32))
+                 for i, s in enumerate(shapes)]
+        if bf16:
+            grads = [g.astype(jnp.bfloat16) for g in grads]
+        return params, opt, grads
+
+    def opt_step(params, opt, grads):
+        for p, g in zip(params, grads):
+            p.grad = Tensor(g)
+        opt.step()
+        opt.clear_grad()
+
+    def chain_step(params, opt, grads, cache):
+        """Reference-style optimizer loop: one jitted _update launch per
+        parameter (+ one write-back cast launch per master param)."""
+        opt._step_count += 1
+        lr = jnp.float32(opt.get_lr())
+        st = jnp.float32(opt._step_count)
+        for i, (p, g) in enumerate(zip(params, grads)):
+            m = opt._masters[i]
+            arr = m if m is not None else p._data
+            key = (arr.shape, str(arr.dtype), str(g.dtype))
+            fn = cache.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    lambda a, gg, s, lr_, st_, wd_: opt._update(
+                        a, gg.astype(a.dtype), s, lr_, st_, wd_),
+                    donate_argnums=(0, 2))
+                cache[key] = fn
+            wd = jnp.float32(opt._param_weight_decay(i))
+            new_arr, opt._states[i] = fn(arr, g, opt._states[i], lr, st, wd)
+            if m is not None:
+                opt._masters[i] = new_arr
+                p._data = new_arr.astype(p._data.dtype)
+            else:
+                p._data = new_arr
+
+    def timed(fn, final, n):
+        fn()
+        fn()                      # compile + prime
+        jax.block_until_ready(final())
+        best = float("inf")
+        for _ in range(2):
+            gc.collect()
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            jax.block_until_ready(final())
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best * 1e6
+
+    grid = {}
+    try:
+        for name in OPTS:
+            for prec in ("f32", "bf16"):
+                for size, shapes in SIZES.items():
+                    cell = {}
+                    n = steps[size]
+                    # per-param launch chain (rule math identical)
+                    paddle.set_flags({"FLAGS_fused_optimizer": False})
+                    params, opt, grads = build(name, shapes, prec == "bf16")
+                    opt_step(params, opt, grads)      # init states/masters
+                    cache = {}
+                    cell["per_param_chain_us"] = timed(
+                        lambda: chain_step(params, opt, grads, cache),
+                        lambda: params[0]._data, n)
+                    for label, fused in (("pytree", False), ("fused", True)):
+                        paddle.set_flags({"FLAGS_fused_optimizer": fused})
+                        params, opt, grads = build(name, shapes,
+                                                   prec == "bf16")
+                        cell[label + "_us"] = timed(
+                            lambda: opt_step(params, opt, grads),
+                            lambda: params[0]._data, n)
+                    cell["fused_vs_chain"] = round(
+                        cell["per_param_chain_us"] / max(cell["fused_us"],
+                                                         1e-9), 2)
+                    cell["fused_vs_pytree"] = round(
+                        cell["pytree_us"] / max(cell["fused_us"], 1e-9), 2)
+                    for k in ("per_param_chain_us", "pytree_us", "fused_us"):
+                        cell[k] = round(cell[k], 1)
+                    grid[f"{name}_{prec}_{size}"] = cell
+
+        # BERT-tiny vs native twin, K=8 multi-step blocks, fused off/on
+        from paddle_tpu.models import BertConfig, BertForQuestionAnswering
+        import paddle_tpu.nn.functional as F
+        from benchmarks.native_jax import make_bert_step
+
+        cfg = BertConfig.tiny()
+        batch, seq, k = (8, 128, 8) if on_tpu else (2, 32, 8)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        st_np = rng.randint(0, seq, batch).astype(np.int32)
+        en_np = rng.randint(0, seq, batch).astype(np.int32)
+
+        def bert_multi_us(fused):
+            paddle.set_flags({"FLAGS_step_capture": True,
+                              "FLAGS_fused_optimizer": fused})
+            paddle.seed(0)
+            model = paddle.Model(BertForQuestionAnswering(
+                BertConfig(**{**cfg.__dict__})))
+            opt = paddle.optimizer.AdamW(
+                learning_rate=3e-5, parameters=model.parameters())
+
+            def qa_loss(s_logits, e_logits, starts, ends):
+                return (F.cross_entropy(s_logits, starts).mean()
+                        + F.cross_entropy(e_logits, ends).mean())
+
+            model.prepare(opt, qa_loss)
+            model.network.train()
+            fn = paddle.jit_step(model._eager_step_fn(), k_steps=k)
+            tile = lambda a: np.stack([a] * k)
+            ins = (paddle.to_tensor(tile(ids)),)
+            lbs = (paddle.to_tensor(tile(st_np)), paddle.to_tensor(tile(en_np)))
+            reps = 8 if on_tpu else 5
+            return timed(lambda: fn(ins, lbs),
+                         lambda: model.network.classifier.weight._data,
+                         reps) / k
+
+        bert_unfused = bert_multi_us(False)
+        bert_fused = bert_multi_us(True)
+
+        nstep, nstate = make_bert_step(
+            batch, seq, vocab=cfg.vocab_size, hidden=cfg.hidden_size,
+            layers=cfg.num_hidden_layers, heads=cfg.num_attention_heads,
+            ffn=cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+            amp_o2=on_tpu)
+        idsj = jnp.asarray(ids)
+        sj, ej = jnp.asarray(st_np), jnp.asarray(en_np)
+        state = [nstate]
+
+        def native():
+            state[0], loss = nstep(state[0], idsj, sj, ej)
+            return loss
+
+        native_us = _time_steps(native, 8 if on_tpu else 4,
+                                final=lambda: state[0][0]["qa_w"]) * 1e6
+    finally:
+        paddle.set_flags({"FLAGS_fused_optimizer": entry
+                          ["FLAGS_fused_optimizer"],
+                          "FLAGS_step_capture": entry["FLAGS_step_capture"]})
+
+    gate_cell = grid["adam_f32_small_many"]
+    gate = gate_cell["fused_vs_chain"]
+    return {
+        "metric": "fused_optimizer_speedup",
+        "value": round(gate, 4),
+        "unit": "x_vs_per_param_launch_chain",
+        # gate: >= 2x over the per-param launch chain on the
+        # dispatch-bound cell
+        "vs_baseline": round(gate / 2.0, 4),
+        "detail": {
+            "gate_config": "adam_f32_small_many",
+            "grid": grid,
+            "counters": dict(opt_mod.fused_counters),
+            "bert_tiny_multi_step_k8": {
+                "unfused_us_per_step": round(bert_unfused, 1),
+                "fused_us_per_step": round(bert_fused, 1),
+                "native_twin_us_per_step": round(native_us, 1),
+                "twin_gap_before": round(native_us / max(bert_unfused,
+                                                         1e-9), 4),
+                "twin_gap_after": round(native_us / max(bert_fused,
+                                                        1e-9), 4),
+            },
+            "note": "per_param_chain = one jit launch per parameter "
+                    "(reference's non-multi-tensor loop; the gate "
+                    "baseline). pytree = this repo's per-param path, "
+                    "already ONE whole-pytree program per step, so "
+                    "fused_vs_pytree ~1x eager on a CPU host by design "
+                    "— the bucketed route's remaining wins there are "
+                    "fewer compiles and the in-kernel unscale/clip/"
+                    "write-back fold on the captured/Pallas tail. "
+                    "twin_gap = native_twin_us / ours_us (higher = "
+                    "ours faster), measured per step inside K=8 scan "
+                    "blocks vs the twin's single fp32 step; on a CPU "
+                    "host the compute-bound tiny step puts fused and "
+                    "unfused within run-to-run noise (~5%)",
+        },
+    }
+
+
 def _rescue_headline(headline, merged_cfgs):
     """Never report 0.0 while a companion MFU geometry succeeded
     (VERDICT r4 Weak#1): promote the best successful llama companion."""
@@ -2701,7 +2938,7 @@ def main():
         "cbatch,serving_ragged,serving_recovery,serving_fleet,aot,"
         "tp_attention,micro,"
         "dispatch,observability,step_capture,multi_step,"
-        "checkpoint_overlap,anomaly_overhead")
+        "checkpoint_overlap,anomaly_overhead,fused_optimizer")
     which = [w.strip() for w in which.split(",") if w.strip()]
     if (on_tpu and len(which) > 1
             and os.environ.get("PTPU_BENCH_ISOLATED", "1") != "0"):
@@ -2817,6 +3054,9 @@ def main():
     anom = guard("anomaly_overhead", bench_anomaly_overhead, on_tpu)
     if anom:
         configs.append(anom)
+    fopt = guard("fused_optimizer", bench_fused_optimizer, on_tpu)
+    if fopt:
+        configs.append(fopt)
 
     mfu = llama["mfu"] if llama else 0.0
     print(json.dumps({

@@ -36,6 +36,19 @@ def _fused_unscale(grads, scale):
     return out, found, gnorm
 
 
+@jax.jit
+def _probe_unscale(grads, scale):
+    """found/gnorm of the unscaled grads WITHOUT materializing them —
+    the fused-optimizer deferral's half of _fused_unscale: identical
+    math over the same `g * inv` expressions (bitwise-equal flag and
+    norm), but XLA drops the grad rewrite since nothing consumes it;
+    the fused kernel applies the reciprocal in-register instead."""
+    inv = 1.0 / scale.astype(jnp.float32)
+    out = tuple(g * inv.astype(g.dtype) for g in grads)
+    found, gnorm = optimizer_mod._sentinel_reduce(out)
+    return found, gnorm
+
+
 @functools.partial(jax.jit, static_argnames=("incr_every", "decr_every",
                                              "incr_ratio", "decr_ratio"))
 def _scaler_update(found, scale, good, bad, incr_every, decr_every,
@@ -236,9 +249,20 @@ class GradScaler:
             self._found_last = False
             return
         grads = tuple(p.grad._data for p in with_grads)
-        new_grads, found, gnorm = _fused_unscale(grads, self._scale_t._data)
-        for p, g in zip(with_grads, new_grads):
-            p.grad._set_data(g)
+        if getattr(optimizer, "_fused_defer_scale", None) is not None \
+                and optimizer._fused_defer_scale():
+            # fused-optimizer route: leave the grads SCALED and hand the
+            # scale to the optimizer — its megakernel applies the
+            # reciprocal in-register (one less full rewrite of every
+            # grad); the finite check / norm reduce still runs here,
+            # over the same unscaled expressions
+            found, gnorm = _probe_unscale(grads, self._scale_t._data)
+            optimizer._pending_scale = self._scale_t._data
+        else:
+            new_grads, found, gnorm = _fused_unscale(grads,
+                                                     self._scale_t._data)
+            for p, g in zip(with_grads, new_grads):
+                p.grad._set_data(g)
         self._found_dev = found
         self._gnorm_dev = gnorm
 
@@ -292,6 +316,10 @@ class GradScaler:
                     optimizer._reconciled_skips += 1
         self._found_dev = None
         self._gnorm_dev = None
+        # a skipped step never consumed a deferred scale; drop it so a
+        # later bare optimizer.step() cannot unscale fresh grads
+        if getattr(optimizer, "_pending_scale", None) is not None:
+            optimizer._pending_scale = None
         self._unscaled.discard(id(optimizer))
 
     def minimize(self, optimizer, scaled_loss):

@@ -192,6 +192,14 @@ define_flag("anomaly_sentinel", False,
             "AnomalyDetector. Eager steps pay one deferred host sync; "
             "captured steps pay none")
 define_flag("use_pallas_kernels", True, "route hot ops to Pallas hand kernels")
+define_flag("fused_optimizer", True,
+            "dtype-bucketed fused optimizer update: ONE kernel per "
+            "(dtype, weight-decay) bucket fusing grad unscale, global-"
+            "norm clip, the anomaly-sentinel select, the update rule "
+            "and the bf16 master write-back (Pallas on TPU, one flat "
+            "XLA chain per bucket elsewhere); the per-param chain runs "
+            "when off or ineligible (ops/kernels/pallas/"
+            "fused_optimizer.py)")
 define_flag("benchmark", False, "block on every op for accurate timing")
 define_flag("comm_timeout_s", 600.0,
             "eager collective / train-step watchdog timeout (seconds); the "

@@ -66,6 +66,9 @@ METRIC_NAMES = frozenset({
     "distributed.collective_calls",
     # ops/kernels/pallas/tp_attention.py (+ aot.py readers)
     "tp_attention.sharded", "tp_attention.fallback",
+    # optimizer/optimizer.py (fused megakernel route)
+    "optimizer.fused.buckets", "optimizer.fused.updates",
+    "optimizer.fused.fallbacks",
     # jit/step_capture.py
     "step_capture.probes", "step_capture.captures",
     "step_capture.replays", "step_capture.fallbacks",

@@ -110,6 +110,8 @@ SPAN_NAMES = frozenset({
     "step_capture.multi",      # span: one K-step block (capture or replay)
     # optimizer/optimizer.py
     "optimizer.update",        # span: one eager/traced optimizer.step()
+    "optimizer.fused_update",  # span: the fused megakernel route's
+    #                            bucketed apply inside optimizer.step()
     # distributed/resilience/
     "anomaly.verdict",         # event: non-OK AnomalyDetector verdict
     "checkpoint.snapshot",     # span: foreground device->host snapshot

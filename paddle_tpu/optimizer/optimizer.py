@@ -20,8 +20,10 @@ import numpy as np
 
 from .. import flags as _flags
 from ..core.tensor import Tensor
+from ..observability import flight_recorder as _flight
+from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
-from ..nn.clip import ClipGradBase
+from ..nn.clip import ClipGradBase, ClipGradByGlobalNorm
 from .lr import LRScheduler
 
 # Step-capture integration (jit/step_capture.py). _PROBE is non-None
@@ -38,6 +40,71 @@ _PROBE = None
 # FLAGS_anomaly_sentinel: guard every update with a fused device-side
 # finiteness check so a poison batch can never corrupt (donated) params
 _F_SENTINEL = _flags._REGISTRY["anomaly_sentinel"]
+
+# FLAGS_fused_optimizer: dtype-bucketed megakernel update route
+# (ops/kernels/pallas/fused_optimizer.py) — ONE kernel per bucket
+# instead of a per-parameter chain
+_F_FUSED = _flags._REGISTRY["fused_optimizer"]
+_F_PALLAS = _flags._REGISTRY["use_pallas_kernels"]
+
+_FOK = None
+
+
+def _fok():
+    """Lazy kernel-module import (keeps `import paddle_tpu` light;
+    pallas loads only when the fused route is first taken)."""
+    global _FOK
+    if _FOK is None:
+        from ..ops.kernels.pallas import fused_optimizer as m
+        _FOK = m
+    return _FOK
+
+
+# Frozen fallback-reason taxonomy for the fused route (the
+# step_capture.FALLBACK_REASONS discipline): every reason that can
+# reach _fused_fallback() lives here, so the flight recorder and the
+# fallbacks counter can never fork on a typo'd or ad-hoc string.
+# _fused_fallback() enforces membership at runtime.
+FUSED_OPT_FALLBACK_REASONS = frozenset({
+    "FLAGS_fused_optimizer disabled",
+    "optimizer rule has no fused kernel",
+    "ZeRO/GSPMD sharding active on params or optimizer state",
+    "tensor hook attached to a parameter",
+    "unsupported param/grad dtype layout",
+})
+
+# authoritative dict (tests snapshot it), published as callback gauges —
+# zero extra hot-path writes. `buckets` is the bucket count of the most
+# recent fused plan; `updates`/`fallbacks` count fused/per-param
+# routings of step() (counted at trace time under capture: replays of a
+# captured step re-run the same route without touching Python).
+fused_counters = {"buckets": 0, "updates": 0, "fallbacks": 0}
+for _k in ("buckets", "updates", "fallbacks"):
+    _metrics.registry().gauge(
+        "optimizer.fused." + _k,
+        fn=lambda _k=_k: float(fused_counters[_k]),
+        help=f"fused-optimizer '{_k}' (optimizer.py megakernel route)")
+del _k
+
+
+def _fused_kind_cfg(opt):
+    """(kind, static hyperparam cfg) for the optimizers with a fused
+    rule — EXACT type match, so a user subclass with an overridden
+    `_update` can never be routed onto the stock kernel."""
+    t = type(opt)
+    if t is SGD:
+        return "sgd", {}
+    if t is Momentum:
+        return "momentum", {"momentum": float(opt._momentum),
+                            "nesterov": bool(opt._nesterov)}
+    if t is Adam or t is AdamW:
+        return "adam", {"b1": float(opt._beta1), "b2": float(opt._beta2),
+                        "eps": float(opt._eps),
+                        "decoupled": bool(opt._decoupled())}
+    if t is Lamb:
+        return "lamb", {"b1": float(opt._beta1), "b2": float(opt._beta2),
+                        "eps": float(opt._eps)}
+    return None, None
 
 
 def _sentinel_reduce(grads):
@@ -126,6 +193,14 @@ class Optimizer:
         self._guard_found = None
         self._anomaly_t: Optional[Tensor] = None
         self._reconciled_skips = 0
+        # fused megakernel route (FLAGS_fused_optimizer): bucket plans
+        # cached per parameter structure; _pending_scale carries the
+        # GradScaler's DEFERRED unscale scale into the kernel (the grads
+        # stay scaled in memory, the kernel applies the reciprocal)
+        self._fused_plans: Dict = {}
+        self._fused_route_fast = None   # (key, plan, reason) memo
+        self._fused_last_reason: Optional[str] = None
+        self._pending_scale = None
 
     def _state_sharding_of(self, param) -> Optional[object]:
         return self._state_shardings.get(id(param))
@@ -172,6 +247,130 @@ class Optimizer:
         weight-decay coeff as a traced scalar. Implemented by subclasses."""
         raise NotImplementedError
 
+    # -- fused megakernel route ----------------------------------------------
+    def _fused_fallback(self, reason: str) -> None:
+        if reason not in FUSED_OPT_FALLBACK_REASONS:
+            raise ValueError(
+                f"unregistered fused-optimizer fallback reason {reason!r} — "
+                f"add it to FUSED_OPT_FALLBACK_REASONS (frozen so the "
+                f"flight recorder and counters cannot fork)")
+        fused_counters["fallbacks"] += 1
+        if reason != self._fused_last_reason:
+            # one ring entry per distinct reason, not per step
+            self._fused_last_reason = reason
+            if _flight.enabled():
+                _flight.recorder().record(
+                    "optimizer.fused_fallback", (reason,), reason)
+
+    def _fused_specs(self, idxs):
+        """Per-param (shape, compute dtype, grad dtype, write-back
+        dtype, wd) layout key — None when a dtype disqualifies the
+        route. Pure host metadata (shapes/dtypes only), so it is stable
+        across eager, probe and trace runs of the same step."""
+        specs = []
+        for i in idxs:
+            p = self._parameter_list[i]
+            pd = p._data.dtype
+            gd = p.grad._data.dtype
+            master = self._multi_precision and pd in (jnp.bfloat16,
+                                                      jnp.float16)
+            cdt = jnp.float32 if master else pd
+            if cdt not in (jnp.float32, jnp.bfloat16, jnp.float16) or \
+                    not jnp.issubdtype(gd, jnp.floating):
+                return None
+            specs.append((tuple(p._data.shape), jnp.dtype(cdt).name,
+                          jnp.dtype(gd).name,
+                          jnp.dtype(pd).name if master else None,
+                          self._param_weight_decay(i)))
+        return tuple(specs)
+
+    def _params_sharded(self, idxs) -> bool:
+        for i in idxs:
+            d = self._parameter_list[i]._data
+            if isinstance(d, jax.core.Tracer):
+                continue  # in-trace: the probe already ran this check
+            sh = getattr(d, "sharding", None)
+            try:
+                if sh is not None and len(sh.device_set) > 1:
+                    return True
+            except Exception:
+                return True
+        return False
+
+    def _fused_route(self, idxs, record: bool = True):
+        """The fused bucket plan for this step, or None with the frozen
+        reason counted (when `record`). The plan is planned ONCE per
+        parameter structure; the compiled program it feeds is keyed into
+        the flags+mesh fingerprint by _apply_fused_update.
+
+        The full eligibility walk (specs + sharding probe) costs O(N)
+        dtype conversions, so repeat steps revalidate only what can
+        actually change between them — flag fingerprint, param/grad
+        dtype identity and hook presence — and reuse the cached verdict;
+        anything heavier (sharding, a new param structure) changes the
+        fingerprint or the dtype key and forces a re-walk."""
+        fast_key = (tuple(idxs), _flags.version, _F_FUSED.value,
+                    self._sharding_version,
+                    tuple(self._parameter_list[i]._data.dtype for i in idxs),
+                    tuple(self._parameter_list[i].grad._data.dtype
+                          for i in idxs),
+                    any(getattr(self._parameter_list[i], "_leaf_hooks", None)
+                        for i in idxs))
+        cached = self._fused_route_fast
+        if cached is None or cached[0] != fast_key:
+            cached = (fast_key,) + self._fused_route_slow(idxs)
+            self._fused_route_fast = cached
+        _, plan, reason = cached
+        if reason is not None and record:
+            self._fused_fallback(reason)
+        return plan
+
+    def _fused_route_slow(self, idxs):
+        kind, cfg = _fused_kind_cfg(self)
+        reason = specs = None
+        if not _F_FUSED.value:
+            reason = "FLAGS_fused_optimizer disabled"
+        elif kind is None:
+            reason = "optimizer rule has no fused kernel"
+        elif self._state_shardings or self._params_sharded(idxs):
+            reason = "ZeRO/GSPMD sharding active on params or optimizer state"
+        elif any(getattr(self._parameter_list[i], "_leaf_hooks", None)
+                 for i in idxs):
+            reason = "tensor hook attached to a parameter"
+        else:
+            specs = self._fused_specs(idxs)
+            if specs is None:
+                reason = "unsupported param/grad dtype layout"
+        if reason is not None:
+            return None, reason
+        key = (kind, tuple(sorted(cfg.items())), specs)
+        plan = self._fused_plans.get(key)
+        if plan is None:
+            plan = _fok().plan_buckets(kind, cfg, specs)
+            self._fused_plans[key] = plan
+        return plan, None
+
+    def _fused_defer_scale(self) -> bool:
+        """GradScaler.unscale_ asks: will step() take the fused route
+        (so the unscale multiply can ride the kernel instead of
+        rewriting every grad)? Deferral also requires the clip to be
+        absent or — under capture, where everything lands in one traced
+        program anyway — global-norm. An eager step with ANY clip must
+        see unscaled grads BEFORE the clip program runs (and the eager
+        route clips in a standalone program to stay bitwise with the
+        per-param path, see step()). Never counts a fallback — step()
+        recounts the authoritative decision."""
+        if not _F_FUSED.value:
+            return False
+        if self._grad_clip is not None:
+            if not isinstance(self._grad_clip, ClipGradByGlobalNorm):
+                return False
+            if _CAPTURE is None:
+                return False
+        idxs = [i for i, p in enumerate(self._parameter_list)
+                if p.grad is not None and not p.stop_gradient]
+        return bool(idxs) and self._fused_route(idxs, record=False) is not None
+
     # -- step ----------------------------------------------------------------
     def step(self):
         if _PROBE is not None:
@@ -188,7 +387,28 @@ class Optimizer:
         if not params:
             return
         _t0_ns = _tracing.now_ns()
-        if self._grad_clip is not None:
+        scale = self._pending_scale
+        self._pending_scale = None
+        plan = self._fused_route(idxs)
+        if plan is None and scale is not None:
+            # route was eligible when GradScaler deferred the unscale
+            # but is not now (e.g. a flag flipped mid-step): restore the
+            # per-param path's contract by unscaling the grads here
+            inv = 1.0 / scale.astype(jnp.float32)
+            grads = [Tensor(g._data * inv.astype(g._data.dtype))
+                     for g in grads]
+            scale = None
+        # under capture a global-norm clip FOLDS into the fused kernels
+        # (one norm reduce across all buckets, coefficient applied
+        # in-register — the per-param inline path traces its clip into
+        # the same program too). EAGER steps clip in the standalone
+        # _global_norm_clip program exactly like the per-param path:
+        # folding the norm reduce into the update executable changes
+        # LLVM's fusion/vectorization choices enough to flip low bits
+        # in unrelated lanes, breaking fused==per-param bitwise parity.
+        fold_clip = plan is not None and _CAPTURE is not None and \
+            isinstance(self._grad_clip, ClipGradByGlobalNorm)
+        if self._grad_clip is not None and not fold_clip:
             pg = self._grad_clip(list(zip(params, grads)))
             grads = [g for _, g in pg]
 
@@ -213,8 +433,12 @@ class Optimizer:
             p_arrays.append(m if m is not None else self._parameter_list[i]._data)
         g_arrays = tuple(g._data for g in grads)
         s_pytree = tuple(self._states[i] for i in idxs)
-        wd_arrays = tuple(jnp.asarray(self._param_weight_decay(i), jnp.float32)
-                          for i in idxs)
+        # per-param wd scalars feed only the per-param rule paths; the
+        # fused route bakes wd into the bucket layout, so building N
+        # device scalars per step would be pure dispatch overhead there
+        wd_arrays = None if plan is not None else tuple(
+            jnp.asarray(self._param_weight_decay(i), jnp.float32)
+            for i in idxs)
 
         # pre-step placements (any sharding type) so stage-1 updates can
         # restore params to exactly where they were
@@ -223,7 +447,30 @@ class Optimizer:
             for i in idxs)
 
         sentinel = _F_SENTINEL.value or self._guard_found is not None
-        if _CAPTURE is not None:
+        lows = None
+        if plan is not None:
+            use_pallas = _F_PALLAS.value and _fok().default_use_pallas()
+            if _CAPTURE is not None:
+                new_p, new_s, lows = _fused_inline(
+                    self, plan, tuple(p_arrays), g_arrays, s_pytree,
+                    scale, self._grad_clip.clip_norm if fold_clip else None,
+                    sentinel, use_pallas)
+            else:
+                new_p, new_s, lows, sent = _apply_fused_update(
+                    self, plan, tuple(p_arrays), g_arrays, s_pytree,
+                    jnp.asarray(lr, jnp.float32), self._step_count, scale,
+                    clip_norm=self._grad_clip.clip_norm if fold_clip
+                    else None,
+                    sentinel=sentinel, use_pallas=use_pallas)
+                if sentinel:
+                    self._stash_anomaly(sent[0], sent[1])
+                    # same ONE deferred host sync as the per-param path
+                    if bool(sent[0] > 0):
+                        self._step_count -= 1
+                        self._reconciled_skips += 1
+            fused_counters["updates"] += 1
+            fused_counters["buckets"] = len(plan.buckets)
+        elif _CAPTURE is not None:
             # in-trace application: the ambient whole-step jit is the
             # only executable, and lr/step arrive as traced inputs so a
             # replayed step keeps advancing bias corrections and LR
@@ -271,7 +518,10 @@ class Optimizer:
             p = self._parameter_list[i]
             if self._masters[i] is not None:
                 self._masters[i] = new_p[k]
-                arr = new_p[k].astype(p._data.dtype)
+                # the fused kernels emit the low-precision write-back
+                # themselves (one less dispatch per master param)
+                arr = lows[k] if lows is not None and lows[k] is not None \
+                    else new_p[k].astype(p._data.dtype)
             else:
                 arr = new_p[k]
             if self._state_shardings:
@@ -284,6 +534,11 @@ class Optimizer:
             self._states[i] = new_s[k]
         # retroactive (a with-block would re-indent the whole rule):
         # under step-capture this lands inside the step_capture span
+        if plan is not None:
+            _tracing.record_span(
+                "optimizer.fused_update", _t0_ns, _tracing.now_ns(),
+                trace=_tracing.current(),
+                attrs={"buckets": len(plan.buckets), "params": len(params)})
         _tracing.record_span(
             "optimizer.update", _t0_ns, _tracing.now_ns(),
             trace=_tracing.current(),
@@ -469,6 +724,130 @@ def _apply_pytree_update(opt, static_key, p_tuple, g_tuple, s_tuple, lr, step,
     return fn(p_tuple, g_tuple, s_tuple, lr, step, wd_tuple)
 
 
+def _fused_prescalars(opt, g_tuple, scale, clip_norm, sentinel):
+    """Scalar conditioning for the fused kernels, with the EXACT eager
+    formulas: unscale reciprocal (amp._fused_unscale), global-norm clip
+    coefficient (nn.clip._global_norm_clip) and the sentinel reduce over
+    the same conditioned per-param expressions the per-param path
+    reduces over — so fused and per-param paths agree bitwise. The
+    conditioned grads built here exist only as reduce inputs (XLA drops
+    them when no reduce consumes them); the kernels re-apply the two
+    scalar multiplies in-register."""
+    if scale is not None:
+        inv = 1.0 / scale.astype(jnp.float32)
+        un = tuple(g * inv.astype(g.dtype) for g in g_tuple)
+    else:
+        inv = jnp.float32(1.0)
+        un = g_tuple
+    if clip_norm is not None:
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in un)
+        coeff = jnp.minimum(
+            clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12), 1.0)
+        cl = tuple(g * coeff.astype(g.dtype) for g in un)
+    else:
+        coeff = jnp.float32(1.0)
+        cl = un
+    found = gnorm = None
+    if sentinel:
+        found, gnorm = _sentinel_reduce(cl)
+        if opt._guard_found is not None:
+            found = jnp.logical_or(found, opt._guard_found)
+    return inv, coeff, found, gnorm
+
+
+def _fused_inline(opt, plan, p_tuple, g_tuple, s_tuple, scale, clip_norm,
+                  sentinel, use_pallas):
+    """In-trace fused application: the ambient whole-step jit is the
+    only executable, so the conditioning scalars, the sentinel reduce
+    and the bucketed kernels all become part of the captured program,
+    with the trace's lr/step scalars (a skipped update does not consume
+    a step, exactly like the per-param capture branch)."""
+    lr_t = _CAPTURE.traced_lr(opt)
+    inv, coeff, found, gnorm = _fused_prescalars(
+        opt, g_tuple, scale, clip_norm, sentinel)
+    if sentinel:
+        applied = jnp.where(found, 0, 1)
+        step_t = _CAPTURE.traced_step(opt, applied)
+    else:
+        step_t = _CAPTURE.traced_step(opt)
+    new_p, new_s, lows = _fok().fused_apply(
+        plan, p_tuple, g_tuple, s_tuple, lr_t, step_t, inv, coeff,
+        jnp.float32(0.0) if found is None else found,
+        use_pallas=use_pallas,
+        condition=scale is not None or clip_norm is not None,
+        # trace-time constants, exactly like the per-param capture
+        # branch's wd_arrays (built inside the trace)
+        wd_list=[jnp.float32(b.wd) for b in plan.buckets])
+    if sentinel:
+        opt._stash_anomaly(found, gnorm)
+    return new_p, new_s, lows
+
+
+_FUSED_JIT_CACHE: Dict = {}
+_FUSED_DUMMY_SCALE = None
+
+
+def _fused_dummy_scale():
+    # one device constant, not one device_put per step
+    global _FUSED_DUMMY_SCALE
+    if _FUSED_DUMMY_SCALE is None:
+        _FUSED_DUMMY_SCALE = jnp.float32(1.0)
+    return _FUSED_DUMMY_SCALE
+
+
+def _apply_fused_update(opt, plan, p_tuple, g_tuple, s_tuple, lr, step,
+                        scale, *, clip_norm, sentinel, use_pallas):
+    """ONE XLA program for the whole fused eager step: scalar
+    conditioning + sentinel reduce + one kernel per bucket, params and
+    state donated (the bucket gathers read the donated buffers, the
+    scattered outputs rebind them). Cached per instance/plan and keyed
+    into the flags+mesh fingerprint (`flags.version`), so a flag flip or
+    topology change can never replay a stale route. This is also how the
+    eager (non-captured) path batches its per-leaf updates: the bucket
+    plan IS the batching."""
+    import weakref
+    for k in [k for k, (ref, _) in _FUSED_JIT_CACHE.items()
+              if ref() is None]:
+        del _FUSED_JIT_CACHE[k]
+    has_scale = scale is not None
+    cache_key = (id(opt), id(plan), clip_norm, sentinel, has_scale,
+                 use_pallas, _flags.version)
+    ent = _FUSED_JIT_CACHE.get(cache_key)
+    if ent is None or ent[0]() is not opt:
+        ref = weakref.ref(opt)
+
+        def run(p_tuple, g_tuple, s_tuple, lr, step, scale, wd_tuple):
+            o = ref()
+            inv, coeff, found, gnorm = _fused_prescalars(
+                o, g_tuple, scale if has_scale else None, clip_norm,
+                sentinel)
+            new_p, new_s, lows = _fok().fused_apply(
+                plan, p_tuple, g_tuple, s_tuple, lr, step, inv, coeff,
+                jnp.float32(0.0) if found is None else found,
+                use_pallas=use_pallas,
+                condition=has_scale or clip_norm is not None,
+                wd_list=wd_tuple)
+            if sentinel:
+                return new_p, new_s, lows, jnp.stack(
+                    [found.astype(jnp.float32), gnorm.astype(jnp.float32)])
+            return new_p, new_s, lows, ()
+
+        fn = jax.jit(run, donate_argnums=(0, 2))
+        _FUSED_JIT_CACHE[cache_key] = (ref, fn)
+    else:
+        fn = ent[1]
+    wd_tuple = plan._wd_devs
+    if wd_tuple is None:
+        # per-bucket wd as traced jit ARGUMENTS (device scalars cached
+        # on the plan), so `wd * p` lowers exactly like the per-param
+        # path's traced wd_arrays — a baked constant contracts
+        # differently under LLVM and flips low bits
+        wd_tuple = tuple(jnp.float32(b.wd) for b in plan.buckets)
+        plan._wd_devs = wd_tuple
+    return fn(p_tuple, g_tuple, s_tuple, lr, step,
+              scale if has_scale else _fused_dummy_scale(), wd_tuple)
+
+
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=True, name=None):
@@ -591,6 +970,11 @@ class Lamb(Optimizer):
         v = b2 * state["v"] + (1 - b2) * jnp.square(g)
         inv_bc1, inv_bc2 = _bias_corrections(b1, b2, step)
         tr_div = (m * inv_bc1) / (jnp.sqrt(v * inv_bc2) + eps) + wd * p
+        # barrier: materialize tr_div so the norm is a standalone reduce
+        # — the fused bucketed path (fused_optimizer._lamb_ratios)
+        # reduces over the SAME materialized shape, and XLA's reduction
+        # order then agrees bitwise between the two lowerings
+        tr_div = jax.lax.optimization_barrier(tr_div)
         pn = jnp.sqrt(jnp.sum(jnp.square(p)))
         tn = jnp.sqrt(jnp.sum(jnp.square(tr_div)))
         r = jnp.where((pn > 0) & (tn > 0), pn / jnp.where(tn > 0, tn, 1.0), 1.0)

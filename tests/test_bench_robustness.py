@@ -362,6 +362,50 @@ class TestAnomalyOverheadMicro:
         assert got["FLAGS_anomaly_sentinel"] is False
 
 
+class TestFusedOptimizerMicro:
+    def test_micro_runs_and_meets_gate(self):
+        """bench.py fused_optimizer smoke (ISSUE 16 acceptance): the
+        bucketed megakernel route must beat the per-param launch chain
+        by >=2x on the dispatch-bound adam/fp32/small_many cell, with
+        the full {sgd,adam,adamw} x {f32,bf16} x {small_many,large_few}
+        grid and the BERT-tiny multi-step twin-gap re-measure in the
+        artifact entry. One retry absorbs a busy host."""
+        r = bench.bench_fused_optimizer(False)
+        if r["value"] < 2.0:        # timing gate: wall clock on a
+            r = bench.bench_fused_optimizer(False)  # shared CI host
+        assert r["metric"] == "fused_optimizer_speedup"
+        assert r["unit"] == "x_vs_per_param_launch_chain"
+        d = r["detail"]
+        assert d["gate_config"] == "adam_f32_small_many"
+        for name in ("sgd", "adam", "adamw"):
+            for prec in ("f32", "bf16"):
+                for size in ("small_many", "large_few"):
+                    cell = d["grid"][f"{name}_{prec}_{size}"]
+                    for k in ("per_param_chain_us", "pytree_us",
+                              "fused_us"):
+                        assert cell[k] > 0.0
+                    assert cell["fused_vs_chain"] > 0.0
+        # the fused route really ran (updates counted, bucket planned)
+        assert d["counters"]["updates"] > 0
+        assert d["counters"]["buckets"] >= 1
+        bert = d["bert_tiny_multi_step_k8"]
+        for k in ("unfused_us_per_step", "fused_us_per_step",
+                  "native_twin_us_per_step"):
+            assert bert[k] > 0.0
+        # the captured tail must not regress beyond CPU host noise
+        assert bert["fused_us_per_step"] < 1.25 * bert[
+            "unfused_us_per_step"], bert
+        # the acceptance gate itself (>=2x over the launch chain)
+        assert r["value"] >= 2.0, r
+        assert r["vs_baseline"] >= 1.0
+        # the flags the micro toggles must be restored afterwards
+        import paddle_tpu as paddle
+        got = paddle.get_flags(["FLAGS_fused_optimizer",
+                                "FLAGS_step_capture"])
+        assert got["FLAGS_fused_optimizer"] is True
+        assert got["FLAGS_step_capture"] is True
+
+
 class TestObservabilityMicro:
     def test_micro_runs_and_reports(self):
         """bench.py observability_overhead smoke: the micro must run on

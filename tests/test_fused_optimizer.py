@@ -1,0 +1,599 @@
+"""Fused optimizer megakernel (ops/kernels/pallas/fused_optimizer.py +
+the optimizer.py routing): the dtype-bucketed single-kernel update route
+must be BITWISE fp32-identical to the per-param rule chain across the
+optimizer zoo x {global-norm clip, LR scheduler, GradScaler, anomaly
+poison, all combined, bf16 masters}; the forced-Pallas (interpret) route
+must match the XLA composite to a few ulp; the bucket planner, the
+frozen fallback-reason taxonomy, the metric/span names, the GradScaler
+unscale deferral, and the one-executable-per-block capture/multi-step
+contracts are all pinned here."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as O
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability.metrics import METRIC_NAMES, registry
+from paddle_tpu.observability.tracing import SPAN_NAMES
+from paddle_tpu.ops.kernels.pallas import fused_optimizer as fok
+from paddle_tpu.optimizer import optimizer as opt_mod
+from paddle_tpu.optimizer.optimizer import (FUSED_OPT_FALLBACK_REASONS,
+                                            fused_counters)
+
+OPTS = ("sgd", "momentum", "adam", "adamw", "lamb")
+SHAPES = [(8, 16), (130,), (4, 5), (54,)]
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    paddle.set_flags({"FLAGS_fused_optimizer": True,
+                      "FLAGS_anomaly_sentinel": False,
+                      "FLAGS_step_capture": True})
+    fok._FORCE_PALLAS = None
+
+
+def _make_opt(name, params, sched=False, clip=False):
+    c = nn.ClipGradByGlobalNorm(1.0) if clip else None
+    lr = O.lr.StepDecay(learning_rate=0.01, step_size=2, gamma=0.5) \
+        if sched else 0.01
+    kw = dict(parameters=params, grad_clip=c)
+    return {
+        "sgd": lambda: O.SGD(learning_rate=lr, **kw),
+        "momentum": lambda: O.Momentum(learning_rate=lr, momentum=0.9,
+                                       use_nesterov=True, weight_decay=0.01,
+                                       **kw),
+        "adam": lambda: O.Adam(learning_rate=lr, weight_decay=0.01, **kw),
+        "adamw": lambda: O.AdamW(learning_rate=lr, weight_decay=0.01, **kw),
+        "lamb": lambda: O.Lamb(learning_rate=lr, lamb_weight_decay=0.01,
+                               **kw),
+    }[name]()
+
+
+def _run(name, fused, *, clip=False, sched=False, scaler=False, poison=None,
+         bf16=False, steps=4, pallas=False):
+    """`steps` optimizer steps on a fixed grad stream; returns the
+    per-step param snapshots (bf16 raw-byte views for bitwise compare)."""
+    paddle.set_flags({"FLAGS_fused_optimizer": fused})
+    fok._FORCE_PALLAS = True if pallas else None
+    if poison is not None:
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True})
+    rng = np.random.RandomState(0)
+    params = [Tensor((rng.randn(*s) * 0.1).astype(np.float32),
+                     stop_gradient=False) for s in SHAPES]
+    if bf16:
+        params = [Tensor(p._data.astype(jnp.bfloat16), stop_gradient=False)
+                  for p in params]
+    opt = _make_opt(name, params, sched=sched, clip=clip)
+    sc = paddle.amp.GradScaler(init_loss_scaling=16.0) if scaler else None
+    rng = np.random.RandomState(123)
+    outs = []
+    for t in range(steps):
+        for k, p in enumerate(params):
+            g = rng.randn(*p.shape).astype(np.float32)
+            if poison is not None and t == poison and k == 1:
+                g[3] = np.nan
+            if scaler:
+                g = g * 16.0
+            gd = jnp.asarray(g)
+            if bf16:
+                gd = gd.astype(jnp.bfloat16)
+            p.grad = Tensor(gd)
+        if scaler:
+            sc.step(opt)
+            sc.update()
+        else:
+            opt.step()
+        opt.clear_grad()
+        if sched:
+            opt._learning_rate.step()
+        outs.append([np.asarray(p._data).copy() for p in params])
+    paddle.set_flags({"FLAGS_fused_optimizer": True,
+                      "FLAGS_anomaly_sentinel": False})
+    fok._FORCE_PALLAS = None
+    return outs
+
+
+def _assert_bitwise(a, b):
+    for t, (xa, xb) in enumerate(zip(a, b)):
+        for k, (pa, pb) in enumerate(zip(xa, xb)):
+            assert pa.dtype == pb.dtype
+            va = pa.view(np.uint8) if pa.dtype != np.float32 else pa
+            vb = pb.view(np.uint8) if pb.dtype != np.float32 else pb
+            assert np.array_equal(va, vb), \
+                f"step {t} param {k}: {(va != vb).sum()} bytes/els differ"
+
+
+def _max_ulp(a, b):
+    worst = 0
+    for xa, xb in zip(a, b):
+        for pa, pb in zip(xa, xb):
+            ia = np.asarray(pa, np.float32).view(np.int32).astype(np.int64)
+            ib = np.asarray(pb, np.float32).view(np.int32).astype(np.int64)
+            worst = max(worst, int(np.abs(ia - ib).max()))
+    return worst
+
+
+# --------------------------------------------------------------------------
+# bitwise fp32 parity: fused vs per-param, full matrix
+# --------------------------------------------------------------------------
+
+MODES = {
+    "plain": {},
+    "clip": dict(clip=True),
+    "sched": dict(sched=True),
+    "scaler": dict(scaler=True),
+    "poison": dict(poison=2),
+    "combined": dict(clip=True, scaler=True, poison=2),
+}
+
+
+class TestBitwiseParity:
+    @pytest.mark.parametrize("name", OPTS)
+    @pytest.mark.parametrize("mode", sorted(MODES))
+    def test_fused_matches_per_param(self, name, mode):
+        kw = MODES[mode]
+        _assert_bitwise(_run(name, True, **kw), _run(name, False, **kw))
+
+    @pytest.mark.parametrize("name", OPTS)
+    def test_bf16_masters_bitwise(self, name):
+        """bf16 params + fp32 masters: the kernel's low-dtype write-back
+        must produce byte-identical bf16 params to the per-param path's
+        master cast."""
+        _assert_bitwise(_run(name, True, bf16=True),
+                        _run(name, False, bf16=True))
+
+
+# --------------------------------------------------------------------------
+# forced-Pallas (interpret) route vs the XLA composite
+# --------------------------------------------------------------------------
+
+class TestPallasInterpret:
+    @pytest.mark.parametrize("name", OPTS)
+    def test_pallas_matches_composite(self, name, monkeypatch):
+        """The Pallas kernels (interpret mode off-TPU) run the same
+        shared rule chain over (block_rows, 128) tiles of the flat
+        bucket. Tile-shaped loops and the SMEM scalar extraction give
+        LLVM different contraction choices than the per-segment
+        composite, so parity here is a few ulp, not bitwise — the
+        BITWISE contract is composite vs per-param, above."""
+        calls = []
+        real = fok._bucket_kernel_call
+
+        def spy(body, bucket, inputs, out_dtypes):
+            calls.append(bucket.total)
+            return real(body, bucket, inputs, out_dtypes)
+
+        monkeypatch.setattr(fok, "_bucket_kernel_call", spy)
+        a = _run(name, True, pallas=True)
+        assert calls, "forced-Pallas run never invoked a bucket kernel"
+        b = _run(name, True, pallas=False)
+        assert _max_ulp(a, b) <= 64
+        np.testing.assert_allclose(
+            np.concatenate([x.ravel() for x in a[-1]]),
+            np.concatenate([x.ravel() for x in b[-1]]),
+            rtol=2e-5, atol=1e-8)
+
+    def test_pallas_combined_and_bf16(self):
+        a = _run("adam", True, pallas=True, clip=True, scaler=True, poison=2)
+        b = _run("adam", True, pallas=False, clip=True, scaler=True,
+                 poison=2)
+        assert _max_ulp(a, b) <= 64
+        a = _run("adam", True, pallas=True, bf16=True)
+        b = _run("adam", True, pallas=False, bf16=True)
+        for xa, xb in zip(a, b):
+            for pa, pb in zip(xa, xb):
+                np.testing.assert_allclose(
+                    np.asarray(pa, np.float32), np.asarray(pb, np.float32),
+                    rtol=2e-2, atol=1e-3)
+
+
+# --------------------------------------------------------------------------
+# bucket planner
+# --------------------------------------------------------------------------
+
+class TestBucketPlan:
+    def test_grouping_offsets_padding(self):
+        specs = (
+            ((8, 16), "float32", "float32", None, 0.01),
+            ((130,), "float32", "float32", None, 0.01),
+            ((4, 5), "float32", "float32", None, 0.0),      # wd splits
+            ((7,), "float32", "bfloat16", None, 0.01),      # gdtype splits
+            ((3, 3), "float32", "float32", "bfloat16", 0.01),  # low splits
+        )
+        plan = fok.plan_buckets("adam", {"b1": 0.9, "b2": 0.999,
+                                         "eps": 1e-8, "decoupled": True},
+                                specs)
+        assert plan.n_params == 5
+        assert plan.state_keys == ("m", "v")
+        assert len(plan.buckets) == 4
+        assert sorted(sum((b.ids for b in plan.buckets), ())) == list(
+            range(5))
+        big = next(b for b in plan.buckets if set(b.ids) == {0, 1})
+        assert big.offsets == (0, 128)
+        assert big.sizes == (128, 130)
+        assert big.total == 258
+        # rows padded to the sublane quantum and tiled exactly
+        assert big.rows % big.block_rows == 0
+        assert big.block_rows % fok._SUBLANE_QUANTUM == 0
+        assert big.rows * fok._LANES >= big.total
+        assert big.wd == 0.01 and big.low is None
+
+    def test_block_rows_cap_and_scalar_param(self):
+        specs = (((1 << 20,), "float32", "float32", None, 0.0),
+                 ((), "float32", "float32", None, 0.0))
+        plan = fok.plan_buckets("sgd", {}, specs)
+        (b,) = plan.buckets
+        assert b.block_rows == fok._BLOCK_ROWS
+        assert b.sizes == (1 << 20, 1)     # 0-d param occupies one slot
+
+    def test_kind_state_keys(self):
+        for kind, keys in fok.STATE_KEYS.items():
+            plan = fok.plan_buckets(
+                kind, {"b1": 0.9, "b2": 0.999, "eps": 1e-8,
+                       "momentum": 0.9, "nesterov": False,
+                       "decoupled": False},
+                (((4,), "float32", "float32", None, 0.0),))
+            assert plan.state_keys == keys
+
+
+# --------------------------------------------------------------------------
+# routing: frozen fallback reasons, counters, taxonomy
+# --------------------------------------------------------------------------
+
+def _tiny_opt(name="adam", **kw):
+    rng = np.random.RandomState(0)
+    params = [Tensor(rng.randn(4, 3).astype(np.float32),
+                     stop_gradient=False),
+              Tensor(rng.randn(5).astype(np.float32), stop_gradient=False)]
+    opt = _make_opt(name, params, **kw)
+    for p in params:
+        p.grad = Tensor(np.random.RandomState(1).randn(*p.shape)
+                        .astype(np.float32))
+    return params, opt
+
+
+class TestRouting:
+    def test_reason_set_is_frozen(self):
+        assert FUSED_OPT_FALLBACK_REASONS == frozenset({
+            "FLAGS_fused_optimizer disabled",
+            "optimizer rule has no fused kernel",
+            "ZeRO/GSPMD sharding active on params or optimizer state",
+            "tensor hook attached to a parameter",
+            "unsupported param/grad dtype layout",
+        })
+        assert isinstance(FUSED_OPT_FALLBACK_REASONS, frozenset)
+
+    def test_unregistered_reason_raises(self):
+        _, opt = _tiny_opt()
+        with pytest.raises(ValueError, match="unregistered"):
+            opt._fused_fallback("bogus reason")
+
+    def _reason_of(self, opt):
+        idxs = [i for i, p in enumerate(opt._parameter_list)
+                if p.grad is not None]
+        f0 = fused_counters["fallbacks"]
+        plan = opt._fused_route(idxs)
+        if plan is None:
+            assert fused_counters["fallbacks"] == f0 + 1
+            assert opt._fused_last_reason in FUSED_OPT_FALLBACK_REASONS
+            return opt._fused_last_reason
+        return None
+
+    def test_flag_disabled(self):
+        paddle.set_flags({"FLAGS_fused_optimizer": False})
+        _, opt = _tiny_opt()
+        assert self._reason_of(opt) == "FLAGS_fused_optimizer disabled"
+
+    def test_no_fused_kernel_for_rule(self):
+        paddle.set_flags({"FLAGS_fused_optimizer": True})
+        rng = np.random.RandomState(0)
+        params = [Tensor(rng.randn(4).astype(np.float32),
+                         stop_gradient=False)]
+        params[0].grad = Tensor(rng.randn(4).astype(np.float32))
+        opt = O.RMSProp(learning_rate=0.01, parameters=params)
+        assert self._reason_of(opt) == "optimizer rule has no fused kernel"
+
+    def test_subclass_never_routes_to_stock_kernel(self):
+        class MySGD(O.SGD):
+            def _update(self, p, g, state, lr, step, wd):
+                return p - lr * (g + g), {}
+
+        rng = np.random.RandomState(0)
+        params = [Tensor(rng.randn(4).astype(np.float32),
+                         stop_gradient=False)]
+        params[0].grad = Tensor(rng.randn(4).astype(np.float32))
+        opt = MySGD(learning_rate=0.01, parameters=params)
+        assert self._reason_of(opt) == "optimizer rule has no fused kernel"
+
+    def test_sharding_reason(self):
+        _, opt = _tiny_opt()
+        opt._state_shardings = {0: object()}
+        assert self._reason_of(opt) == \
+            "ZeRO/GSPMD sharding active on params or optimizer state"
+
+    def test_hook_reason(self):
+        params, opt = _tiny_opt()
+        params[0].register_hook(lambda g: g)
+        assert self._reason_of(opt) == "tensor hook attached to a parameter"
+
+    def test_dtype_reason(self):
+        params, opt = _tiny_opt()
+        params[1].grad = Tensor(np.arange(5, dtype=np.int32))
+        assert self._reason_of(opt) == "unsupported param/grad dtype layout"
+
+    def test_route_memo_and_plan_cache(self):
+        """The fast route memo revalidates per step without re-walking
+        specs, and the bucket plan is planned once per structure."""
+        _, opt = _tiny_opt()
+        idxs = [0, 1]
+        p1 = opt._fused_route(idxs)
+        assert p1 is not None
+        memo = opt._fused_route_fast
+        assert opt._fused_route(idxs) is p1
+        assert opt._fused_route_fast is memo      # memo hit, no re-walk
+        paddle.set_flags({"FLAGS_fused_optimizer": False})
+        assert opt._fused_route(idxs) is None     # fingerprint change seen
+        paddle.set_flags({"FLAGS_fused_optimizer": True})
+        assert opt._fused_route(idxs) is p1       # plan cache, same object
+
+    def test_updates_counter_and_metrics_gauges(self):
+        params, opt = _tiny_opt("sgd")
+        u0, b0 = fused_counters["updates"], fused_counters["buckets"]
+        opt.step()
+        assert fused_counters["updates"] == u0 + 1
+        assert fused_counters["buckets"] >= 1
+        snap = {k: g.value for k, g in
+                ((n, registry().get(n)) for n in
+                 ("optimizer.fused.updates", "optimizer.fused.buckets",
+                  "optimizer.fused.fallbacks"))}
+        assert snap["optimizer.fused.updates"] == float(
+            fused_counters["updates"])
+        assert snap["optimizer.fused.buckets"] == float(
+            fused_counters["buckets"])
+        assert snap["optimizer.fused.fallbacks"] == float(
+            fused_counters["fallbacks"])
+
+    def test_taxonomy_registered(self):
+        for n in ("optimizer.fused.buckets", "optimizer.fused.updates",
+                  "optimizer.fused.fallbacks"):
+            assert n in METRIC_NAMES
+        assert "optimizer.fused_update" in SPAN_NAMES
+
+
+# --------------------------------------------------------------------------
+# eager route: donation safety, steady-state compiles, wd scalars
+# --------------------------------------------------------------------------
+
+class TestEagerRoute:
+    def test_donated_program_is_reusable(self):
+        """3 steps through the ONE donated jit program: donation must
+        not alias stale buffers (values keep matching per-param) and the
+        steady state adds ZERO compiles after the first step."""
+        paddle.set_flags({"FLAGS_fused_optimizer": True})
+        gauge = registry().get("jit.compiles")
+        rng = np.random.RandomState(0)
+        params = [Tensor(rng.randn(6, 4).astype(np.float32),
+                         stop_gradient=False)]
+        opt = _make_opt("adam", params)
+        grads = [np.random.RandomState(s).randn(6, 4).astype(np.float32)
+                 for s in range(3)]
+        for t, g in enumerate(grads):
+            params[0].grad = Tensor(g)
+            if t == 1:
+                c0 = gauge.value
+            opt.step()
+            opt.clear_grad()
+        assert gauge.value == c0        # steps 2..3 recompiled nothing
+        # per-param replay of the same stream agrees bitwise
+        paddle.set_flags({"FLAGS_fused_optimizer": False})
+        rng = np.random.RandomState(0)
+        params2 = [Tensor(rng.randn(6, 4).astype(np.float32),
+                          stop_gradient=False)]
+        opt2 = _make_opt("adam", params2)
+        for g in grads:
+            params2[0].grad = Tensor(g)
+            opt2.step()
+            opt2.clear_grad()
+        assert np.array_equal(np.asarray(params[0]._data),
+                              np.asarray(params2[0]._data))
+
+    def test_traced_wd_cached_on_plan(self):
+        """The per-bucket wd device scalars are put ONCE and cached on
+        the plan — steps must not re-upload them."""
+        params, opt = _tiny_opt("adamw")
+        opt.step()
+        plan = opt._fused_route([0, 1], record=False)
+        devs = plan._wd_devs
+        assert devs is not None and len(devs) == len(plan.buckets)
+        for p in params:
+            p.grad = Tensor(np.ones(p.shape, np.float32))
+        opt.step()
+        assert plan._wd_devs is devs
+
+
+# --------------------------------------------------------------------------
+# GradScaler unscale deferral
+# --------------------------------------------------------------------------
+
+class TestScalerDeferral:
+    def test_defers_only_on_fused_route_without_eager_clip(self):
+        _, opt = _tiny_opt("adam")
+        assert opt._fused_defer_scale() is True
+        paddle.set_flags({"FLAGS_fused_optimizer": False})
+        assert opt._fused_defer_scale() is False
+        paddle.set_flags({"FLAGS_fused_optimizer": True})
+        _, opt_c = _tiny_opt("adam", clip=True)
+        # eager: the clip program must see unscaled grads (and the
+        # update program must NOT carry the fold, for bitwise parity)
+        assert opt_c._fused_defer_scale() is False
+
+    def test_route_lost_after_deferral_recovers(self):
+        """unscale_ defers, then the route disappears before step():
+        step() must restore the per-param contract by unscaling the
+        grads itself — same math as never deferring."""
+        outs = {}
+        for flip in (False, True):
+            paddle.set_flags({"FLAGS_fused_optimizer": True})
+            rng = np.random.RandomState(0)
+            params = [Tensor(rng.randn(4, 3).astype(np.float32),
+                             stop_gradient=False)]
+            opt = _make_opt("sgd", params)
+            sc = paddle.amp.GradScaler(init_loss_scaling=8.0)
+            params[0].grad = Tensor(
+                8.0 * np.random.RandomState(1).randn(4, 3)
+                .astype(np.float32))
+            sc.unscale_(opt)
+            if flip:
+                paddle.set_flags({"FLAGS_fused_optimizer": False})
+            sc.step(opt)
+            sc.update()
+            outs[flip] = np.asarray(params[0]._data)
+        np.testing.assert_allclose(outs[False], outs[True],
+                                   rtol=1e-6, atol=1e-7)
+
+
+# --------------------------------------------------------------------------
+# capture + multi-step: one executable, zero fallbacks, bitwise replay
+# --------------------------------------------------------------------------
+
+def _capture_job(opt_name, scaler=None):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 4))
+    params = net.parameters()
+    opt = _make_opt(opt_name, params)
+
+    def step(x):
+        loss = (net(x) ** 2).mean()
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+        else:
+            loss.backward()
+            opt.step()
+        opt.clear_grad()
+        return loss
+
+    return net, opt, step
+
+
+def _capture_batches(n, poison=()):
+    out = []
+    for i in range(n):
+        b = np.random.RandomState(100 + i).randn(2, 4).astype(np.float32)
+        if i in poison:
+            b[:] = np.nan
+        out.append(b)
+    return out
+
+
+class TestCaptureIntegration:
+    @pytest.mark.parametrize("opt_name", ("sgd", "adam", "lamb"))
+    def test_captured_matches_eager_through_poison(self, opt_name):
+        from paddle_tpu.jit.step_capture import capture_counters
+        results = {}
+        for captured in (False, True):
+            paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                              "FLAGS_step_capture": captured,
+                              "FLAGS_fused_optimizer": True})
+            net, opt, step = _capture_job(opt_name)
+            fn = paddle.jit_step(step) if captured else step
+            c0 = dict(capture_counters)
+            f0 = fused_counters["fallbacks"]
+            for b in _capture_batches(5, poison=(2,)):
+                fn(Tensor(jnp.asarray(b)))
+                opt.consume_anomaly()
+            results[captured] = (
+                np.asarray(net[0].weight._data), opt._step_count,
+                capture_counters["fallbacks"] - c0["fallbacks"],
+                fused_counters["fallbacks"] - f0)
+        we, ce, _, fe = results[False]
+        wc, cc, capfb, fc = results[True]
+        assert np.array_equal(we, wc)
+        assert ce == cc == 4            # the poison step was skipped
+        assert capfb == 0 and fe == 0 and fc == 0
+
+    def test_amp_sentinel_capture_zero_fallbacks_one_executable(self):
+        from paddle_tpu.jit.step_capture import capture_counters
+        paddle.set_flags({"FLAGS_anomaly_sentinel": True,
+                          "FLAGS_step_capture": True,
+                          "FLAGS_fused_optimizer": True})
+        sc = paddle.amp.GradScaler(init_loss_scaling=16.0)
+        net, opt, step = _capture_job("adam", scaler=sc)
+        cap = paddle.jit_step(step)
+        gauge = registry().get("jit.compiles")
+        c0 = dict(capture_counters)
+        f0 = fused_counters["fallbacks"]
+        deltas = []
+        for b in _capture_batches(4, poison=(2,)):
+            g0 = gauge.value
+            cap(Tensor(jnp.asarray(b)))
+            opt.consume_anomaly()
+            deltas.append(gauge.value - g0)
+        assert capture_counters["captures"] - c0["captures"] == 1
+        assert capture_counters["fallbacks"] - c0["fallbacks"] == 0
+        assert fused_counters["fallbacks"] - f0 == 0
+        # replays (incl. the poison batch) run the ONE captured
+        # executable: batch 0 probes+captures, batch 1 still compiles
+        # one capture helper, then the steady state adds NOTHING
+        assert deltas[2:] == [0, 0], deltas
+
+
+class TestMultiStepIntegration:
+    def test_k16_bitwise_one_executable_per_block(self):
+        from paddle_tpu.jit.multi_step import MultiStepCapture
+        paddle.set_flags({"FLAGS_step_capture": True,
+                          "FLAGS_fused_optimizer": True})
+
+        def build():
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3))
+            opt = O.AdamW(learning_rate=0.05, weight_decay=0.01,
+                          parameters=net.parameters())
+            ce = nn.CrossEntropyLoss()
+
+            def step(x, y):
+                loss = ce(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            return net, opt, step
+
+        def f32(seed, *shape):
+            return np.random.RandomState(seed).randn(*shape).astype(
+                np.float32)
+
+        y = np.array([0, 1, 2, 0], np.int64)
+        k, blocks = 16, 3
+        net_s, _, step_s = build()
+        fn = paddle.jit_step(step_s)
+        ls = [float(fn(paddle.to_tensor(f32(i, 4, 6)), paddle.to_tensor(y)))
+              for i in range(k * blocks)]
+
+        net_m, _, step_m = build()
+        fnm = paddle.jit_step(step_m, k_steps=k)
+        assert isinstance(fnm, MultiStepCapture)
+        gauge = registry().get("jit.compiles")
+        f0 = fused_counters["fallbacks"]
+        lm, deltas = [], []
+        for b in range(blocks):
+            c0 = gauge.value
+            xs = paddle.to_tensor(
+                np.stack([f32(b * k + i, 4, 6) for i in range(k)]))
+            out = fnm(xs, paddle.to_tensor(np.stack([y] * k)))
+            deltas.append(gauge.value - c0)
+            lm.extend(float(v) for v in np.asarray(out._data))
+        assert ls == lm
+        for a, b_ in zip(net_s.parameters(), net_m.parameters()):
+            assert np.array_equal(np.asarray(a._data), np.asarray(b_._data))
+        # block 1 compiles the scan executable (+ its capture); the
+        # steady state replays it with ZERO new compiles
+        assert deltas[-1] == 0, deltas
+        assert fused_counters["fallbacks"] - f0 == 0

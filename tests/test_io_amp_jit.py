@@ -219,26 +219,55 @@ class TestAMP:
 
     def test_grad_scaler_unscale_is_fused(self):
         """VERDICT weak-7: unscale_ must be ONE jitted pass + one host sync,
-        not a per-parameter device round-trip."""
+        not a per-parameter device round-trip. With the fused-optimizer
+        route active (default), unscale_ goes further and defers the grad
+        rewrite entirely — the megakernel applies the reciprocal
+        in-register; with the flag off, the single fused pass remains."""
         from paddle_tpu import amp as amp_mod
-        ws = [paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
-              for _ in range(5)]
-        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=ws)
-        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
-        loss = sum(((w * 2.0).sum() for w in ws), paddle.to_tensor(0.0))
-        scaler.scale(loss).backward()
-        calls = []
-        orig = amp_mod._fused_unscale
+        from paddle_tpu import flags as F
 
-        def spy(grads, inv):
-            calls.append(len(grads))
-            return orig(grads, inv)
+        def build():
+            ws = [paddle.to_tensor(np.ones(3, np.float32),
+                                   stop_gradient=False) for _ in range(5)]
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=ws)
+            scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+            loss = sum(((w * 2.0).sum() for w in ws), paddle.to_tensor(0.0))
+            scaler.scale(loss).backward()
+            return ws, opt, scaler
 
-        amp_mod._fused_unscale = spy
+        def spied_unscale(scaler, opt):
+            calls = []
+            orig = amp_mod._fused_unscale
+
+            def spy(grads, inv):
+                calls.append(len(grads))
+                return orig(grads, inv)
+
+            amp_mod._fused_unscale = spy
+            try:
+                scaler.unscale_(opt)
+            finally:
+                amp_mod._fused_unscale = orig
+            return calls
+
+        # default route: deferral — no grad rewrite at all, scale handed
+        # to the optimizer, finite-check still ran (one probe pass)
+        ws, opt, scaler = build()
+        calls = spied_unscale(scaler, opt)
+        assert calls == []
+        assert opt._pending_scale is not None
+        assert scaler._found_inf is False
+        for w in ws:                 # grads deliberately still scaled
+            np.testing.assert_allclose(np.asarray(w.grad._data), [8.0] * 3)
+
+        # flag off: the one fused unscale pass over all 5 grads
+        old = F.get_flags(["fused_optimizer"])
+        F.set_flags({"fused_optimizer": False})
         try:
-            scaler.unscale_(opt)
+            ws, opt, scaler = build()
+            calls = spied_unscale(scaler, opt)
         finally:
-            amp_mod._fused_unscale = orig
+            F.set_flags(old)
         assert calls == [5]          # one fused call over all 5 grads
         assert scaler._found_inf is False
         for w in ws:                 # grads actually unscaled (8.0 / 4.0)
