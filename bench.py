@@ -1327,6 +1327,36 @@ def _bench_span_cost_s(tracing, n: int = 2000) -> float:
     return (time.perf_counter() - t0) / n
 
 
+def _bench_ledger_cost_s(ptpu_perf, n: int = 2000):
+    """(per-call, per-sampled-call) CPU seconds of the executable
+    ledger's tick+commit pair, hot-looped on a throwaway ledger (the
+    flag must be on). The sampled path includes the block_until_ready
+    on an already-ready array — the real cost on a synced host."""
+    import jax.numpy as jnp
+
+    import jax
+    led = ptpu_perf.ExecutableLedger()
+    e = led.register(("bench", "ledger_cost"), "op", name="bench")
+    arr = jnp.zeros((8,))
+    jax.block_until_ready(arr)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        led.tick(e)
+        led.commit(e, 1e-6)
+    per_call = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n):
+        led.tick(e)
+        w0 = time.perf_counter()
+        jax.block_until_ready(arr)
+        _ = time.perf_counter() - w0
+        # constant ready time: jitter in a sub-us loop would otherwise
+        # trip the regression sentinel and pollute perf.regression
+        led.commit(e, 1e-6, 1e-6)
+    per_sample = (time.perf_counter() - t0) / n
+    return per_call, per_sample
+
+
 def bench_serving_fleet(on_tpu: bool, quick: bool = False):
     """ISSUE 12 acceptance micro: the multi-replica fleet end to end.
 
@@ -1356,6 +1386,12 @@ def bench_serving_fleet(on_tpu: bool, quick: bool = False):
     the composed estimate spans-per-round x per-span-cost / round-CPU,
     whose components are individually stable where the sub-1% direct
     differential drowns in shared-host noise.
+
+    A fifth phase (scrape-under-load, ISSUE 14) and a sixth
+    (perf-attribution tax + one /perfz dump, ISSUE 17) reuse the same
+    composed-estimate idiom; the perf phase also runs a tiny captured
+    train step so the recorded /perfz rows carry a training-step
+    executable next to the serving ones.
     """
     import shutil
     import tempfile
@@ -1589,6 +1625,70 @@ def bench_serving_fleet(on_tpu: bool, quick: bool = False):
         scrape_overhead_pct = (e_scrapes * scrape_cost_s
                                / e_cpu_s * 100.0)
 
+        # phase F: perf-attribution tax + one /perfz dump (ISSUE 17).
+        # Same composed-estimate idiom as D/E: the ledger's per-call and
+        # per-sample unit costs are microbenched hot, multiplied by the
+        # deterministic call/sample counts of one more identical load
+        # round, divided by that round's process CPU. A tiny captured
+        # train step runs in the same process so the /perfz snapshot
+        # carries a training-step row next to the serving rows.
+        pa_entry = paddle.get_flags(["FLAGS_perf_attribution"])
+        from paddle_tpu.observability import perf as ptpu_perf
+        c_perf_samples = ptpu_metrics.registry().counter("perf.samples")
+        paddle.set_flags({"FLAGS_perf_attribution": True})
+        try:
+            # warmup request: the flag flip re-fingerprints the jit
+            # caches, so the first instrumented round re-jits — keep
+            # that compile out of the timed round's CPU denominator
+            g = router.submit(mk_prompt(499), max_new_tokens=max_new,
+                              deadline_s=30.0)
+            router.drain_all(timeout_s=600.0)
+            calls0 = sum(x.calls for x in ptpu_perf.ledger().entries())
+            samples0 = c_perf_samples.value
+            f_toks = 0
+            f_cpu0 = time.process_time()
+            for i in range(n_d):
+                g = router.submit(mk_prompt(500 + i),
+                                  max_new_tokens=max_new, deadline_s=30.0)
+                router.drain_all(timeout_s=600.0)
+                f_toks += len(router.outputs[g])
+            f_cpu_s = time.process_time() - f_cpu0
+            f_calls = (sum(x.calls for x in ptpu_perf.ledger().entries())
+                       - calls0)
+            f_samples = c_perf_samples.value - samples0
+            # one captured train step family for the same snapshot
+            import paddle_tpu.nn as ptpu_nn
+            from paddle_tpu.hapi.model import Model as PtpuModel
+            sc_entry = paddle.get_flags(["FLAGS_step_capture"])
+            paddle.set_flags({"FLAGS_step_capture": True})
+            try:
+                tnet = ptpu_nn.Linear(16, 8)
+                tm = PtpuModel(tnet)
+                tm.prepare(
+                    optimizer=paddle.optimizer.SGD(
+                        parameters=tnet.parameters(), learning_rate=0.01),
+                    loss=lambda out, y: ((out - y) ** 2).mean())
+                t_rng = np.random.RandomState(42)
+                tx = t_rng.rand(8, 16).astype("float32")
+                ty = t_rng.rand(8, 8).astype("float32")
+                for _ in range(3):
+                    tm.train_batch([tx], [ty])
+            finally:
+                paddle.set_flags(sc_entry)
+            call_cost_s, sample_cost_s = map(min, zip(
+                *(_bench_ledger_cost_s(ptpu_perf) for _ in range(5))))
+            perf_overhead_pct = (
+                (f_calls * call_cost_s + f_samples * sample_cost_s)
+                / f_cpu_s * 100.0)
+            perf_snap = ptpu_perf.perfz_snapshot(top=12)
+            # top rows by device time, plus the captured-train-step rows
+            # even when the tiny train model ranks below the serving ops
+            f_rows = ptpu_perf.ledger().stats()
+            f_rows = f_rows[:4] + [r for r in f_rows[4:]
+                                   if r["kind"] in ("step", "multi")][:2]
+        finally:
+            paddle.set_flags(pa_entry)
+
         # byte-identity: one plain engine, same gids, same seed
         ref = ContinuousBatchingEngine(model, **eng_kw)
         for g in sorted(delivered):
@@ -1654,6 +1754,26 @@ def bench_serving_fleet(on_tpu: bool, quick: bool = False):
                            "endpoint during a load round; overhead_pct "
                            "= scrapes x per-scrape CPU cost / round "
                            "CPU (ISSUE 14 <3% gate)",
+            "perf_calls_per_round": f_calls,
+            "perf_samples_per_round": f_samples,
+            "perf_call_cost_us": round(call_cost_s * 1e6, 3),
+            "perf_sample_cost_us": round(sample_cost_s * 1e6, 3),
+            "perf_overhead_pct": round(perf_overhead_pct, 4),
+            "perf_gate_pct": 3.0,
+            "perf_note": "FLAGS_perf_attribution on for one identical "
+                         "load round; overhead_pct = calls x per-call "
+                         "cost + samples x per-sample cost / round CPU "
+                         "(ISSUE 17 <3% gate)",
+            "perfz_top": [
+                {"key": r["key"], "kind": r["kind"], "calls": r["calls"],
+                 "dev_s": r["device_seconds"], "flops": r["flops"],
+                 "hbm_bytes": sum(v or 0 for v in r["hbm"].values()),
+                 "attainment": (r.get("roofline") or {}).get("attainment"),
+                 "bound": r["bound"]}
+                for r in f_rows],
+            "perf_step_decomposition": {
+                part: s.get("sum")
+                for part, s in perf_snap["step"].items()},
             "baseline": "every delivered stream replayed on one plain "
                         "engine under the same gids must match byte-"
                         "for-byte"
@@ -2929,7 +3049,138 @@ def _run_isolated(names):
     print(out)
 
 
+# --------------------------------------------------------------------------
+# perf-regression sentinel: bench.py --compare BENCH_rNN.json [CANDIDATE]
+# --------------------------------------------------------------------------
+
+_CMP_LOWER_BETTER = ("_us", "_ms", "_seconds", "_gb", "_bytes", "_s")
+_CMP_HIGHER_BETTER = ("_per_sec", "_per_s", "mfu", "speedup", "goodput",
+                      "tok_s", "x_vs", "fraction", "throughput")
+
+
+def _cmp_direction(name: str) -> int:
+    """-1: lower is better, +1: higher is better, 0: not gated."""
+    n = name.lower()
+    for suf in _CMP_LOWER_BETTER:
+        if n.endswith(suf):
+            return -1
+    if any(t in n for t in _CMP_HIGHER_BETTER):
+        return 1
+    return 0
+
+
+def _cmp_metrics(path: str) -> dict:
+    """Flatten one BENCH_rNN.json round record (or a bare parsed bench
+    line) into {metric_name: value} over the headline + detail.configs."""
+    with open(path) as f:
+        rec = json.load(f)
+    parsed = rec.get("parsed", rec) if isinstance(rec, dict) else None
+    if not isinstance(parsed, dict):
+        return {}   # a round whose output line never parsed
+    out = {}
+    if isinstance(parsed.get("value"), (int, float)):
+        out[str(parsed.get("metric"))] = float(parsed["value"])
+    cfgs = (parsed.get("detail") or {}).get("configs")
+    if isinstance(cfgs, list):
+        for c in cfgs:
+            if isinstance(c, dict) \
+                    and isinstance(c.get("value"), (int, float)):
+                out[str(c.get("metric"))] = float(c["value"])
+    return out
+
+
+def _cmp_noise_tol_pct(history: list, floor_pct: float = 10.0,
+                       k: float = 3.0) -> dict:
+    """Per-metric noise tolerance from the recorded rounds: k x the
+    median absolute relative round-to-round difference (in %), floored.
+    A metric with <2 recorded rounds just gets the floor."""
+    series: dict = {}
+    for vals in history:
+        for m, v in vals.items():
+            series.setdefault(m, []).append(v)
+    tol = {}
+    for m, vs in series.items():
+        diffs = [abs(b - a) / abs(a) for a, b in zip(vs, vs[1:]) if a]
+        if diffs:
+            diffs.sort()
+            med = diffs[len(diffs) // 2]
+            tol[m] = max(floor_pct, k * med * 100.0)
+        else:
+            tol[m] = floor_pct
+    return tol
+
+
+def bench_compare(baseline_path: str,
+                  candidate_path: "str | None" = None) -> int:
+    """Noise-aware perf-regression gate over two recorded bench rounds.
+
+    Candidate defaults to the NEWEST ``BENCH_r*.json`` next to the
+    baseline (so ``--compare BENCH_r06.json`` on an unmodified tree
+    compares the latest round against itself and passes). Every metric
+    with a known better-direction is compared; a metric regresses when
+    it worsens by more than its tolerance — ``max(10%, 3 x median
+    |round-to-round relative diff|)`` over the recorded history, so
+    historically jittery micros get a wider band. Prints a per-micro
+    table; returns 1 (nonzero exit) iff anything regressed."""
+    import glob as _glob
+    bench_dir = os.path.dirname(os.path.abspath(baseline_path)) or "."
+    rounds = sorted(_glob.glob(os.path.join(bench_dir, "BENCH_r*.json")))
+    if candidate_path is None:
+        if not rounds:
+            print(f"--compare: no BENCH_r*.json next to {baseline_path}")
+            return 2
+        candidate_path = rounds[-1]
+    base = _cmp_metrics(baseline_path)
+    cand = _cmp_metrics(candidate_path)
+    # noise bands come from history UP TO the baseline only — folding in
+    # later rounds would let a regression widen its own tolerance
+    abs_base = os.path.abspath(baseline_path)
+    hist = [p for p in rounds if os.path.abspath(p) <= abs_base] or rounds
+    tol = _cmp_noise_tol_pct([_cmp_metrics(p) for p in hist])
+    shared = [m for m in base if m in cand and base[m]]
+    rows, regressed = [], []
+    for m in sorted(shared):
+        d = _cmp_direction(m)
+        delta_pct = (cand[m] - base[m]) / abs(base[m]) * 100.0
+        if d == 0:
+            verdict = "info"
+        else:
+            worsening = -d * delta_pct   # >0 means moved the wrong way
+            t = tol.get(m, 10.0)
+            verdict = "REGRESSED" if worsening > t else "ok"
+            if verdict == "REGRESSED":
+                regressed.append(m)
+        rows.append((m, base[m], cand[m], delta_pct,
+                     tol.get(m, 10.0), verdict))
+    name_w = max([len(r[0]) for r in rows] + [6])
+    print(f"compare {os.path.basename(baseline_path)} -> "
+          f"{os.path.basename(candidate_path)} "
+          f"({len(hist)} rounds of history for noise bands)")
+    print(f"{'metric':<{name_w}} {'base':>12} {'cand':>12} "
+          f"{'delta%':>8} {'tol%':>6}  verdict")
+    for m, b, c, dp, t, v in rows:
+        print(f"{m:<{name_w}} {b:>12.4g} {c:>12.4g} "
+              f"{dp:>+8.2f} {t:>6.1f}  {v}")
+    skipped = len(base) - len(shared)
+    if skipped:
+        print(f"({skipped} baseline metrics absent from candidate or "
+              f"zero-valued: not gated)")
+    if regressed:
+        print(f"REGRESSION: {len(regressed)} metric(s) beyond their "
+              f"noise band: {', '.join(regressed)}")
+        return 1
+    print("no regression beyond noise bands")
+    return 0
+
+
 def main():
+    if "--compare" in sys.argv:
+        i = sys.argv.index("--compare")
+        if i + 1 >= len(sys.argv):
+            print("usage: bench.py --compare BASELINE.json [CANDIDATE.json]")
+            sys.exit(2)
+        cand = sys.argv[i + 2] if i + 2 < len(sys.argv) else None
+        sys.exit(bench_compare(sys.argv[i + 1], cand))
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     which = os.environ.get(

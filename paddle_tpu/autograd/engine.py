@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..observability import metrics as _obs_metrics
+from ..observability import perf as _perf_mod
 
 # -- grad mode ----------------------------------------------------------------
 #
@@ -515,7 +516,15 @@ def _make_runner(plan: _FusedPlan):
 
 
 def _build_fused_runner(plan: _FusedPlan):
-    return jax.jit(_make_runner(plan))
+    runner = jax.jit(_make_runner(plan))
+    if _perf_mod.enabled():
+        # one ledger row per stable tape structure, under the same
+        # signature that keys the fused cache (wrap() is a passthrough
+        # when the plane is off at compile time)
+        runner = _perf_mod.ledger().wrap(
+            ("fused_bwd", plan.signature), "backward", runner,
+            name="fused_bwd")
+    return runner
 
 
 # Step-capture integration (jit/step_capture.py): non-None while a

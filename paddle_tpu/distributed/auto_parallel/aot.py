@@ -357,7 +357,15 @@ def plan_llama3_8b_v5p64(tp: int = 8, dp: int = 8,
     # evidence the flash kernel actually lowered as Mosaic custom calls
     # (0 would mean the shard_map'd Pallas path silently fell back)
     out["pallas_custom_calls"] = hlo.count("tpu_custom_call")
-    # roofline projection alongside the live-HBM fit evidence
+    # roofline projection alongside the live-HBM fit evidence; also
+    # registered with the perf plane so /perfz can put the live achieved
+    # numbers next to what this plan said the hardware allows
     out["projected"] = projected_throughput(
         compiled, global_batch=batch_per_dp * dp, seq=seq)
+    try:
+        from ...observability import perf as _perf_mod
+        _perf_mod.note_projection(
+            f"llama3_8b_v5p64:tp{tp}xdp{dp}", out["projected"])
+    except Exception:
+        pass   # /perfz join is advisory; the plan's own output stands
     return out

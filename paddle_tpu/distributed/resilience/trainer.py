@@ -33,6 +33,7 @@ import numpy as np
 
 from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
+from ...observability import perf as _perf_mod
 from ..checkpoint.save_load import latest_checkpoint
 from .anomaly import AnomalyAction, AnomalyDetector
 from .checkpointer import AsyncCheckpointer, restore_state
@@ -377,6 +378,8 @@ class ResilientTrainer:
                     skip_fn(step)
                 step += 1
                 continue
+            seq0 = _perf_mod.step_seq()
+            t0 = time.perf_counter()
             try:
                 out = step_fn(step)
             except RuntimeError as e:
@@ -388,6 +391,10 @@ class ResilientTrainer:
                     step = self.restore()
                     continue
                 raise
+            if _perf_mod.step_seq() == seq0:
+                # step_fn did not self-report (raw closure, not hapi):
+                # record the wall total so decomposition still counts it
+                _perf_mod.record_step(time.perf_counter() - t0)
             if self.anomaly is not None \
                     and self.observe(step, out) == TrainerAction.REWIND:
                 resumed = self.rewind(step)
@@ -439,10 +446,14 @@ class ResilientTrainer:
         step = self.restore()
         recovered_at = -1
         while step < max_steps:
+            t_w = time.perf_counter()
             batch = next_batch()
+            _perf_mod.note_data_wait(time.perf_counter() - t_w)
             if self.should_skip(step):
                 step += 1
                 continue
+            seq0 = _perf_mod.step_seq()
+            t0 = time.perf_counter()
             try:
                 out = train_fn(step, batch)
             except RuntimeError as e:
@@ -455,6 +466,8 @@ class ResilientTrainer:
                     it[0] = None
                     continue
                 raise
+            if _perf_mod.step_seq() == seq0:
+                _perf_mod.record_step(time.perf_counter() - t0)
             if self.anomaly is not None \
                     and self.observe(step, out) == TrainerAction.REWIND:
                 resumed = self.rewind(step)
@@ -508,11 +521,15 @@ class ResilientTrainer:
         step = self.restore()
         recovered_at = -1
         while step < max_steps:
+            t_w = time.perf_counter()
             block = next_block()
+            _perf_mod.note_data_wait(time.perf_counter() - t_w)
             if self.should_skip_block(step, block.size):
                 self.data_loader._commit_stream_state(block.stream_state)
                 step += block.size
                 continue
+            seq0 = _perf_mod.step_seq()
+            t0 = time.perf_counter()
             try:
                 out = train_block_fn(step, block)
             except RuntimeError as e:
@@ -525,6 +542,9 @@ class ResilientTrainer:
                     gen[0] = None
                     continue
                 raise
+            if _perf_mod.step_seq() == seq0:
+                _perf_mod.record_step(time.perf_counter() - t0,
+                                      steps=block.size)
             self.data_loader._commit_stream_state(block.stream_state)
             if self.anomaly is not None:
                 outs = list(out) if isinstance(out, (list, tuple)) else [out]

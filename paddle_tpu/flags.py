@@ -242,6 +242,23 @@ define_flag("telemetry_port", -1,
             "-1 (default) = off, 0 = pick a free port, >0 = bind that "
             "port. The server starts on the first fleet/engine attach "
             "(or explicit observability.serve_telemetry())")
+define_flag("perf_attribution", False,
+            "performance attribution plane (observability/perf.py): the "
+            "ExecutableLedger registers every compiled program at its "
+            "creation site (per-op exec cache, fused backward, step "
+            "capture, fused optimizer, static executor, serving step), "
+            "captures cost/memory analysis at compile time and samples "
+            "device time via timed block_until_ready every "
+            "FLAGS_perf_sample_every-th call — yielding live achieved "
+            "FLOP/s, bytes/s, MFU and a compute/bandwidth/host-bound "
+            "classification per executable on /perfz. Off (default) the "
+            "hot path pays ~zero (trace-time caches rebuild without the "
+            "instrumentation; coarse sites pay one flag read)")
+define_flag("perf_sample_every", 16,
+            "device-time sampling period for the executable ledger: every "
+            "Nth call of a registered executable is timed through "
+            "block_until_ready when FLAGS_perf_attribution is on; 1 = "
+            "time every call (bench mode), larger = lower sampling tax")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")
 define_flag("rng_impl", "rbg",

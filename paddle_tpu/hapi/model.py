@@ -11,12 +11,14 @@ world.
 from __future__ import annotations
 
 import os
+import time
 import warnings
 from typing import List, Optional
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import perf as _perf_mod
 from ..metric import Metric
 from ..nn.layer_base import Layer
 from . import callbacks as cbks_mod
@@ -115,8 +117,14 @@ class Model:
                 self._train_step = TrainStep(self.network, _scalar_loss,
                                              self._optimizer,
                                              amp_level=self._amp_level)
+            t0 = time.perf_counter()
             loss = self._train_step(tuple(inputs), tuple(labels))
+            t1 = time.perf_counter()
             lv = float(loss._data if isinstance(loss, Tensor) else loss)
+            t2 = time.perf_counter()
+            # dispatch returns before the device finishes; the float() sync
+            # above bounds device time from the host's point of view
+            _perf_mod.record_step(t2 - t0, host_s=t1 - t0, device_s=t2 - t1)
             if not self._metrics:
                 return self._with_metric_results(None, labels, [lv])
             # metrics need network outputs, which the compiled step does not
@@ -150,17 +158,25 @@ class Model:
             if self._captured_step is None:
                 from ..jit.step_capture import jit_step
                 self._captured_step = jit_step(self._eager_step_fn())
+            t0 = time.perf_counter()
             loss, outputs = self._captured_step(tuple(inputs), tuple(labels))
-            return self._with_metric_results(outputs, labels,
-                                             [float(np.asarray(loss._data))])
+            t1 = time.perf_counter()
+            lv = float(np.asarray(loss._data))
+            t2 = time.perf_counter()
+            _perf_mod.record_step(t2 - t0, host_s=t1 - t0, device_s=t2 - t1)
+            return self._with_metric_results(outputs, labels, [lv])
 
+        t0 = time.perf_counter()
         outputs = self._forward_amp(inputs)
         loss = self._loss_value(outputs, labels)
         loss.backward()
         self._optimizer.step()
         self._optimizer.clear_grad()
-        return self._with_metric_results(outputs, labels,
-                                         [float(np.asarray(loss._data))])
+        t1 = time.perf_counter()
+        lv = float(np.asarray(loss._data))
+        t2 = time.perf_counter()
+        _perf_mod.record_step(t2 - t0, host_s=t1 - t0, device_s=t2 - t1)
+        return self._with_metric_results(outputs, labels, [lv])
 
     def _eager_step_fn(self):
         """The whole-step closure both capture regimes compile: one
@@ -283,7 +299,7 @@ class Model:
                 logs = self._fit_epoch_multi(loader, cbks, n_labels,
                                              k_steps, logs)
             else:
-                for step, batch in enumerate(loader):
+                for step, batch in enumerate(_perf_mod.timed_iter(loader)):
                     cbks.on_train_batch_begin(step)
                     ins, lbs = self._split_batch(batch, n_labels)
                     res = self.train_batch(ins, lbs)
@@ -389,7 +405,7 @@ class Model:
                     yield b
 
         step = 0
-        for block in blocks():
+        for block in _perf_mod.timed_iter(blocks()):
             if block.stacked is not None:
                 losses, outputs, lbs = self._train_block(block.stacked,
                                                          n_labels, k)
@@ -442,8 +458,14 @@ class Model:
         if self._multi_step is None or self._multi_step.k_steps != k:
             from ..jit.step_capture import jit_step
             self._multi_step = jit_step(self._eager_step_fn(), k_steps=k)
+        t0 = time.perf_counter()
         loss, outputs = self._multi_step(tuple(ins), tuple(lbs))
+        t1 = time.perf_counter()
         losses = [float(v) for v in np.asarray(loss._data)]
+        t2 = time.perf_counter()
+        # one observation per block, normalized over its K device steps
+        _perf_mod.record_step(t2 - t0, host_s=t1 - t0, device_s=t2 - t1,
+                              steps=k)
         return losses, _to_list(outputs), lbs
 
     def _run_eval(self, eval_loader, cbks, n_labels):

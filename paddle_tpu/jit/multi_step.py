@@ -151,6 +151,8 @@ class MultiStepCapture(CapturedStep):
     sequential single-step replays: same carry chaining of the device
     step scalars, same RNG split-per-step chain, same donated state."""
 
+    _perf_kind = "multi"       # K-step blocks get their own ledger kind
+
     def __init__(self, fn, k_steps: int):
         if int(k_steps) < 2:
             raise ValueError(f"k_steps must be >= 2, got {k_steps} "

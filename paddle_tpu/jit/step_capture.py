@@ -73,6 +73,7 @@ from ..core import tensor as tensor_mod
 from ..core.tensor import Tensor
 from ..observability import flight_recorder as _flight_mod
 from ..observability import metrics as _metrics_mod
+from ..observability import perf as _perf_mod
 from ..observability import tracing as _tracing
 from ..ops import dispatcher
 from ..optimizer import lr as lr_mod
@@ -505,12 +506,13 @@ class _Captured:
     the CapturedStep — two static variants of one step can differ in
     exactly those host effects."""
 
-    __slots__ = ("jfn", "disc", "out_is_tensor", "tracebox")
+    __slots__ = ("jfn", "disc", "out_is_tensor", "tracebox", "perf")
 
     def __init__(self, jfn, disc, tracebox):
         self.jfn = jfn
         self.disc = disc
         self.out_is_tensor = None
+        self.perf = None       # ExecutableLedger row, when the plane is on
         self.tracebox = tracebox
 
 
@@ -519,6 +521,8 @@ class _Captured:
 class CapturedStep:
     """Result of :func:`jit_step`: a training-step function that, once
     its structure is stable, replays as one donated XLA executable."""
+
+    _perf_kind = "step"        # ledger kind; multi_step overrides
 
     def __init__(self, fn: Callable):
         self._fn = fn
@@ -642,6 +646,21 @@ class CapturedStep:
 
         snap = _HostSnapshot(d)
         jfn = jax.jit(self._wrap_body(step_fn), donate_argnums=(0, 1, 2, 3))
+        perf_lower = None
+        if _perf_mod.enabled():
+            try:
+                # aval snapshot BEFORE the donating launch, so the
+                # ledger can lower+compile for cost analysis at report
+                # time without the live buffers
+                avals = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    (state_arrs, grads_in, packs, self._dev_key,
+                     lrs, dyn_arrays))
+                perf_lower = (lambda f=jfn, av=avals:
+                              f.lower(*av).compile())
+            except Exception:
+                pass   # cost model is fail-open; capture must not care
+        t_cap = _perf_mod.clock()
         hook = _span_hook()
         try:
             if hook is not None:
@@ -661,6 +680,16 @@ class CapturedStep:
         d.refresh_baked_versions()
         entry = _Captured(jfn, d, tracebox)
         entry.out_is_tensor = (outbox["tree"], outbox["is_tensor"])
+        if _perf_mod.enabled():
+            # the entry key already folds flags.version, so an off->on
+            # toggle re-captures with a ledger row and on->off drops it
+            led = _perf_mod.ledger()
+            cap_s = _perf_mod.clock() - t_cap
+            entry.perf = led.register(
+                ("step_capture", key), self._perf_kind,
+                name=self._perf_kind, lower=perf_lower, compile_s=cap_s)
+            led.tick(entry.perf)
+            led.commit(entry.perf, cap_s)
         self._put_entry(key, entry)
         tracebox.pop("ran", None)
         # the trace itself executed the step's host side (step counts,
@@ -720,6 +749,9 @@ class CapturedStep:
             self._dev_key = generator.next_key()
         hook = _span_hook()
         snap = _HostSnapshot(d)   # a surprise retrace runs host effects
+        pe = entry.perf
+        p_sample = _perf_mod.ledger().tick(pe) if pe is not None else False
+        t_rep = _perf_mod.clock()
         try:
             if hook is not None:
                 with hook("step_capture"):
@@ -765,6 +797,16 @@ class CapturedStep:
                 self._fallback("replay failed",
                                f"{type(e).__name__}: {e}")
             return None
+        if pe is not None:
+            wall = _perf_mod.clock() - t_rep
+            ready = None
+            if p_sample:
+                try:     # sampled replay: device-time via a timed sync
+                    jax.block_until_ready(outs)
+                    ready = _perf_mod.clock() - t_rep
+                except Exception:
+                    pass
+            _perf_mod.ledger().commit(pe, wall, ready)
         # if jax silently re-traced, the step's host side already ran
         host_effects = not entry.tracebox.pop("ran", False)
         capture_counters["replays"] += 1

@@ -56,6 +56,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..observability import metrics as _metrics_mod
+from ..observability import perf as _perf_mod
 from ..observability import tracing as _tracing
 from ..ops.dispatcher import call_op
 from .generation import PagedKVCache
@@ -773,6 +774,18 @@ class ContinuousBatchingEngine:
         np.cumsum(qlen, out=cu[1:])
 
         _t0_ns = _tracing.now_ns()
+        # synthetic ledger row for the whole ragged step: it has no
+        # single jax.jit of its own (the model dispatches through the
+        # per-op exec cache, whose entries carry the FLOPs/HBM), but the
+        # step IS the serving unit of device work — and its host sync
+        # below makes the device-time measurement free
+        _pe = _p_sample = None
+        if _perf_mod.enabled():
+            _led = _perf_mod.ledger()
+            _pe = _led.register(
+                ("serving", self.max_batch, self.token_budget),
+                "serving", name="serving_step")
+            _p_sample = _led.tick(_pe)
         view = _RaggedView(
             self.cache,
             Tensor(jnp.asarray(slot_vec, jnp.int32)),
@@ -789,10 +802,16 @@ class ContinuousBatchingEngine:
                           Tensor(jnp.asarray(keys)),
                           Tensor(jnp.asarray(stream_pos, jnp.int32)),
                           **self.sampling)
+        _td_ns = _tracing.now_ns()       # async dispatch returned
         self.steps += 1
         _M_STEPS.inc()
         _M_STEP_TOKENS.inc(t)
         sampled = np.asarray(nxt._data).reshape(-1)
+        if _pe is not None:
+            _perf_mod.ledger().commit(
+                _pe, (_td_ns - _t0_ns) / 1e9,
+                ((_tracing.now_ns() - _t0_ns) / 1e9
+                 if _p_sample else None))
         # retroactive, on the thread timeline (untraced: one ragged step
         # serves many requests): model call through the host sync above
         _tracing.record_span(

@@ -2,7 +2,8 @@
 
 Prints the process-wide observability dumps: Prometheus text exposition
 (``prometheus``), the JSON metrics snapshot (``json``), the Chrome-trace
-span dump (``trace``), or all three (default). Mostly useful under
+span dump (``trace``), the performance-attribution view (``perfz``, the
+CLI twin of the /perfz endpoint), or the first three (default). Mostly useful under
 ``-i`` / in a notebook kernel or subprocess that has already imported
 paddle_tpu and done work — a fresh interpreter only shows import-time
 activity, which is still a handy smoke test that the registries and the
@@ -22,11 +23,15 @@ def main(argv=None) -> int:
         prog="python -m paddle_tpu.observability",
         description="print paddle_tpu observability dumps")
     p.add_argument("what", nargs="?", default="all",
-                   choices=("prometheus", "json", "trace", "all"),
+                   choices=("prometheus", "json", "trace", "perfz", "all"),
                    help="which dump to print (default: all)")
     p.add_argument("--indent", type=int, default=2,
                    help="JSON indent for json/trace dumps (default: 2)")
     args = p.parse_args(argv)
+    if args.what == "perfz":
+        from . import perf as _perf
+        sys.stdout.write(_perf.format_perfz(_perf.perfz_snapshot()) + "\n")
+        return 0
     if args.what in ("prometheus", "all"):
         sys.stdout.write(dump_prometheus())
     if args.what in ("json", "all"):

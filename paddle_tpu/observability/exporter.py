@@ -19,10 +19,16 @@ Endpoints:
   200 iff the engine phase is ``ready``. Nothing attached: 200
   (process-alive).
 * ``/statusz`` — plain-text operator page: flags fingerprint +
-  values, jax/jaxlib versions, the replica table, and the flight
+  values, jax/jaxlib versions, process vitals (uptime, RSS,
+  last-step-progress age), the replica table, and the flight
   recorder tail.
 * ``/trace`` — the tracing ring as Chrome-trace JSON (PR 13's
   ``to_chrome``), load it in ``chrome://tracing`` / Perfetto.
+* ``/perfz`` — the performance-attribution plane as JSON: top-K
+  executables by device time (calls, compile seconds, FLOPs, HBM
+  footprint, achieved FLOP/s vs the roofline, bound classification),
+  the step-time decomposition summary, and the AOT projected-vs-
+  achieved join (``perf.perfz_snapshot``).
 
 Lifecycle: ``FLAGS_telemetry_port`` is -1 (off) by default; 0 binds a
 free port (tests), >0 binds that port. :func:`attach_fleet` (called by
@@ -46,6 +52,7 @@ from typing import Any, Dict, List, Optional
 from .. import flags as _flags
 from . import flight_recorder as _flight
 from . import metrics as _metrics
+from . import perf as _perf
 from . import tracing as _tracing
 
 __all__ = ["serve", "shutdown", "port", "attach_fleet", "attach_engine",
@@ -57,6 +64,23 @@ _M_SCRAPES = _REG.counter(
 _M_SCRAPE_SECONDS = _REG.histogram(
     "telemetry.scrape_seconds",
     help="/metrics request handling wall time (server side)")
+
+def _rss_bytes() -> Optional[int]:
+    """Resident set size; /proc when available, ru_maxrss (a high-water
+    mark, close enough for an ops page) elsewhere, None if neither."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+    except Exception:
+        return None
+
 
 class TelemetryServer:
     """One HTTP server thread over the process registry. Use the
@@ -215,8 +239,19 @@ class TelemetryServer:
                 "status": "ok" if ok else "unavailable", "phase": phase}
         return 200, {"status": "ok", "detail": "process alive"}
 
+    def _perfz_body(self) -> str:
+        return json.dumps(_perf.perfz_snapshot(), indent=1) + "\n"
+
     def _statusz_body(self) -> str:
         lines: List[str] = ["paddle_tpu telemetry", ""]
+        rss = _rss_bytes()
+        age = _perf.last_step_age_s()
+        lines.append(
+            f"uptime_s: {_perf.process_uptime_s():.1f}   "
+            f"rss_mb: "
+            f"{'n/a' if rss is None else format(rss / 2**20, '.1f')}   "
+            f"last_step_age_s: "
+            f"{'n/a' if age is None else format(age, '.3f')}")
         lines.append(f"flags.version: {_flags.version}")
         for name in sorted(_flags._REGISTRY):
             lines.append(f"  FLAGS_{name} = {_flags._REGISTRY[name].value!r}")
@@ -288,6 +323,9 @@ def _make_handler(server: TelemetryServer):
                                "text/plain; charset=utf-8")
                 elif path == "/trace":
                     self._send(200, server._trace_body(),
+                               "application/json")
+                elif path == "/perfz":
+                    self._send(200, server._perfz_body(),
                                "application/json")
                 else:
                     self._send(404, "not found\n", "text/plain")

@@ -117,6 +117,14 @@ METRIC_NAMES = frozenset({
     "telemetry.scrapes", "telemetry.scrape_seconds",
     # observability/tracing.py (end-to-end span subsystem)
     "tracing.spans", "tracing.events",
+    # observability/perf.py (executable ledger + step decomposition)
+    "perf.samples", "perf.regression", "perf.ledger.dropped",
+    "perf.executable.calls", "perf.executable.wall_seconds",
+    "perf.executable.device_seconds", "perf.executable.flops_per_s",
+    "perf.executable.bytes_per_s", "perf.executable.mfu",
+    "perf.step.seconds", "perf.step.data_wait_seconds",
+    "perf.step.host_dispatch_seconds", "perf.step.device_seconds",
+    "perf.step.other_seconds",
     # this module's ambient gauges + jax.monitoring listener
     "device.live_array_bytes", "device.live_arrays", "device.count",
     "jit.compiles", "jit.compile_seconds",
@@ -429,6 +437,10 @@ class MetricsRegistry:
                 with m._lock:
                     n = m._n
                 prev = state.get(key, 0)
+                if n < prev:
+                    state[key] = n   # instrument was reset: reseed, ship
+                    continue         # nothing (a negative increment would
+                                     # corrupt the merged child)
                 if n != prev:
                     state[key] = n
                     rec = {"k": "c", "n": m.name, "v": n - prev}
@@ -446,6 +458,12 @@ class MetricsRegistry:
                     cnt, tot = m._count, m._sum
                     mn, mx = m._min, m._max
                 pb, pc, ps = state.get(key, (None, 0, 0.0))
+                if cnt < pc:         # reset since last delta: reseed quietly
+                    state[key] = (buckets, cnt, tot)
+                    continue
+                # never-observed histograms (cnt == pc == 0) ship
+                # nothing — cold replicas must not emit empty series
+                # the SLI joins would divide by
                 if cnt != pc:
                     if pb is None:
                         pb = [0] * len(buckets)

@@ -116,6 +116,12 @@ SPAN_NAMES = frozenset({
     "anomaly.verdict",         # event: non-OK AnomalyDetector verdict
     "checkpoint.snapshot",     # span: foreground device->host snapshot
     "checkpoint.commit",       # span: background serialize+fsync+commit
+    # observability/perf.py — retro step-decomposition segments laid
+    # over each recorded step's interval
+    "perf.step.data_wait",     # span (retro): blocked on the data pipeline
+    "perf.step.host_dispatch",  # span (retro): step call -> async launch out
+    "perf.step.device",        # span (retro): launch -> results host-visible
+    "perf.step.other",         # span (retro): remainder (callbacks, logging)
     # this module's jax.monitoring listener
     "jit.compile",             # span (retro): one XLA backend compile
 })

@@ -41,6 +41,7 @@ from ..core import generator
 from ..core.tensor import Tensor
 from ..observability import flight_recorder as _flight_mod
 from ..observability import metrics as _metrics_mod
+from ..observability import perf as _perf_mod
 
 # -- always-on observability (observability/): one counter inc per dispatch
 # plus a flag-gated flight-recorder ring write; both stay inside the 1us/op
@@ -206,6 +207,14 @@ def _get_exec(op_name: str, attrs_key: Tuple, present_mask: Tuple[bool, ...],
         return (res,)
 
     fwd = jax.jit(fwd_flat) if use_jit else fwd_flat
+    if use_jit and _perf_mod.enabled():
+        # ledger wrap baked in at build time: the cache key folds
+        # flags.version (fver), so toggling FLAGS_perf_attribution
+        # rebuilds these executables with/without instrumentation and
+        # the off path stays literally untouched
+        fwd = _perf_mod.ledger().wrap(
+            ("op", op_name, attrs_key, present_mask, fver), "op", fwd,
+            name=f"op:{op_name}")
 
     def vjp_run(diff_primals, other_primals, cts_float):
         di, oi = iter(diff_primals), iter(other_primals)

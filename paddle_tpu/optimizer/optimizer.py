@@ -22,6 +22,7 @@ from .. import flags as _flags
 from ..core.tensor import Tensor
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
+from ..observability import perf as _perf_mod
 from ..observability import tracing as _tracing
 from ..nn.clip import ClipGradBase, ClipGradByGlobalNorm
 from .lr import LRScheduler
@@ -718,6 +719,13 @@ def _apply_pytree_update(opt, static_key, p_tuple, g_tuple, s_tuple, lr, step,
             return new_p, new_s
 
         fn = jax.jit(run, donate_argnums=(0, 2))
+        if _perf_mod.enabled():
+            # this cache's key has no flags.version, so instrumentation
+            # lands only on programs built while the plane is on (the
+            # wrapper itself re-checks the flag per call)
+            fn = _perf_mod.ledger().wrap(
+                ("opt", cache_key), "opt", fn,
+                name=f"opt:{type(opt).__name__}")
         _JIT_CACHE[cache_key] = (ref, fn)
     else:
         fn = ent[1]
@@ -833,6 +841,12 @@ def _apply_fused_update(opt, plan, p_tuple, g_tuple, s_tuple, lr, step,
             return new_p, new_s, lows, ()
 
         fn = jax.jit(run, donate_argnums=(0, 2))
+        if _perf_mod.enabled():
+            # cache_key folds flags.version: toggling the plane rebuilds
+            # this route with/without the ledger wrapper
+            fn = _perf_mod.ledger().wrap(
+                ("opt_fused", cache_key), "opt_fused", fn,
+                name=f"opt_fused:{type(opt).__name__}")
         _FUSED_JIT_CACHE[cache_key] = (ref, fn)
     else:
         fn = ent[1]

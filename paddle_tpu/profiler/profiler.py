@@ -450,6 +450,10 @@ class Profiler:
         if self._result.metrics:
             from ..observability import format_metrics
             print(format_metrics(self._result.metrics))
+        from ..observability import perf as _perf
+        rows = _perf.ledger().stats()
+        if rows:
+            print(_perf.format_table(rows))
 
     def get_profiler_result(self) -> Optional[ProfilerResult]:
         return self._result

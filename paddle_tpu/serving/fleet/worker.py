@@ -21,7 +21,8 @@ to stdout, one JSON per line:
 * ``{"ev":"hb","phase":...,"qd":N,"m":{...}?}`` — periodic heartbeat;
   ``m`` (present only when something moved) is the registry delta
   since the previous beat (``metrics.MetricsRegistry.delta_update``
-  over the ``serving.*``/``jit.*`` families) — the parent merges it
+  over the ``serving.*``/``jit.*``/``perf.*`` families) — the parent
+  merges it
   into its own registry labeled by replica name, so a router scrape
   shows every replica's engine series, and a SIGKILLed replica's
   counters survive as their last-merged values
@@ -129,7 +130,7 @@ def main() -> int:
     # metric piggyback state: one dict per process lifetime, mutated by
     # delta_update so each beat ships only what moved since the last
     hb_state: dict = {}
-    hb_prefixes = ("serving.", "jit.")
+    hb_prefixes = ("serving.", "jit.", "perf.")
 
     def hb_event() -> dict:
         ev = {"ev": "hb", "phase": eng.phase,

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..observability import metrics as _obs_metrics
+from ..observability import perf as _perf_mod
 from .graph import Operator, Program, Variable
 
 _M_EXEC_RUNS = _obs_metrics.registry().counter(
@@ -200,6 +201,12 @@ class Executor:
                 return [env[n] for n in fetch_names]
 
             compiled = jax.jit(fn)
+            if _perf_mod.enabled():
+                # passthrough when the plane is off at compile time (the
+                # executor cache is not version-keyed, so programs built
+                # before an off->on toggle stay uninstrumented)
+                compiled = _perf_mod.ledger().wrap(
+                    ("exec", cache_key), "exec", compiled, name="exec")
             self._cache[cache_key] = compiled
 
         outs = compiled(feed_arrays, param_arrays,

@@ -221,7 +221,8 @@ class TestServingFleetMicro:
         d = r["detail"]
         if (r["value"] < 1.0 or d["overload_sheds"] == 0
                 or d["tracing_overhead_pct"] >= 3.0
-                or d["scrape_overhead_pct"] >= 3.0):      # timing gates
+                or d["scrape_overhead_pct"] >= 3.0
+                or d["perf_overhead_pct"] >= 3.0):        # timing gates
             r = bench.bench_serving_fleet(False, quick=True)
             d = r["detail"]
         assert r["metric"] == "serving_fleet_goodput"
@@ -249,12 +250,25 @@ class TestServingFleetMicro:
         assert d["scrape_count"] >= 1
         assert d["scrape_latency_p99_ms"] > 0.0
         assert d["scrape_overhead_pct"] < d["scrape_gate_pct"], d
+        # ISSUE 17 gate: the executable ledger's sampling tax during a
+        # load round must compose to <3% of round CPU, and the recorded
+        # /perfz rows must carry the serving step AND a captured train
+        # step with cost-model fields
+        assert d["perf_calls_per_round"] > 0
+        assert d["perf_samples_per_round"] > 0
+        assert d["perf_overhead_pct"] < d["perf_gate_pct"], d
+        kinds = {row["kind"] for row in d["perfz_top"]}
+        assert "serving" in kinds and "step" in kinds, d["perfz_top"]
+        assert any(row["flops"] for row in d["perfz_top"])
+        assert any(row["hbm_bytes"] for row in d["perfz_top"])
         # the endpoint the micro started must be gone afterwards
         from paddle_tpu.observability import exporter as telemetry
         assert telemetry.port() is None
-        # the flag the micro toggles must be restored afterwards
+        # the flags the micro toggles must be restored afterwards
         import paddle_tpu as paddle
-        assert paddle.get_flags(["FLAGS_tracing"])["FLAGS_tracing"] is True
+        got = paddle.get_flags(["FLAGS_tracing", "FLAGS_perf_attribution"])
+        assert got["FLAGS_tracing"] is True
+        assert got["FLAGS_perf_attribution"] is False
         assert r["value"] == 1.0, r
 
 
