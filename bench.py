@@ -1392,6 +1392,12 @@ def bench_serving_fleet(on_tpu: bool, quick: bool = False):
     composed-estimate idiom; the perf phase also runs a tiny captured
     train step so the recorded /perfz rows carry a training-step
     executable next to the serving ones.
+
+    A seventh phase (incident-forensics tax, PR18) microbenches the
+    ``FLAGS_incident_recorder=False`` probe (must cost one flag read)
+    and one full bundle assembly, composing the worst case the per-kind
+    rate limiter admits — every kind flapping at its limit — against
+    the rate-limit window (<1% of one core).
     """
     import shutil
     import tempfile
@@ -1689,6 +1695,44 @@ def bench_serving_fleet(on_tpu: bool, quick: bool = False):
         finally:
             paddle.set_flags(pa_entry)
 
+        # phase G: incident-forensics tax (PR18). Triggers are terminal
+        # events — none fire in a healthy round — so the steady-state
+        # cost is the disabled probe (one flag read) plus whatever the
+        # per-kind rate limiter admits: at most one bundle per kind per
+        # FLAGS_incident_rate_limit_s of wall time. The composed
+        # worst-case ceiling is every kind flapping at its limit:
+        # kinds x bundle-assembly CPU / rate-limit window, as a percent
+        # of one core.
+        from paddle_tpu.observability import incident as ptpu_incident
+        inc_entry = paddle.get_flags(
+            ["FLAGS_incident_recorder", "FLAGS_incident_rate_limit_s"])
+        rate_window_s = max(
+            float(inc_entry["FLAGS_incident_rate_limit_s"]), 1.0)
+        paddle.set_flags({"FLAGS_incident_recorder": False})
+        try:
+            n_probe = 20000
+            probe_s = float("inf")
+            for _ in range(5):
+                t0g = time.perf_counter()
+                for _ in range(n_probe):
+                    ptpu_incident.record_incident("debug.manual")
+                probe_s = min(probe_s,
+                              (time.perf_counter() - t0g) / n_probe)
+            paddle.set_flags({"FLAGS_incident_recorder": True,
+                              "FLAGS_incident_rate_limit_s": 0.0})
+            g_dir = os.path.join(work, "bench_incidents")
+            bundle_cost_s = float("inf")
+            for _ in range(3):
+                t0g = time.process_time()
+                ptpu_incident.record_incident("debug.manual", root=g_dir)
+                bundle_cost_s = min(bundle_cost_s,
+                                    time.process_time() - t0g)
+            incident_overhead_pct = (
+                len(ptpu_incident.INCIDENT_KINDS) * bundle_cost_s
+                / rate_window_s * 100.0)
+        finally:
+            paddle.set_flags(inc_entry)
+
         # byte-identity: one plain engine, same gids, same seed
         ref = ContinuousBatchingEngine(model, **eng_kw)
         for g in sorted(delivered):
@@ -1764,6 +1808,17 @@ def bench_serving_fleet(on_tpu: bool, quick: bool = False):
                          "load round; overhead_pct = calls x per-call "
                          "cost + samples x per-sample cost / round CPU "
                          "(ISSUE 17 <3% gate)",
+            "incident_disabled_probe_ns": round(probe_s * 1e9, 1),
+            "incident_bundle_cost_ms": round(bundle_cost_s * 1e3, 3),
+            "incident_rate_window_s": rate_window_s,
+            "incident_overhead_pct": round(incident_overhead_pct, 4),
+            "incident_gate_pct": 1.0,
+            "incident_note": "worst case the per-kind rate limiter "
+                             "admits — every kind flapping at its "
+                             "limit: kinds x bundle-assembly CPU / "
+                             "rate-limit window, percent of one core; "
+                             "the disabled probe is one flag read "
+                             "(PR18 <1% gate)",
             "perfz_top": [
                 {"key": r["key"], "kind": r["kind"], "calls": r["calls"],
                  "dev_s": r["device_seconds"], "flops": r["flops"],
@@ -3137,7 +3192,9 @@ def bench_compare(baseline_path: str,
     abs_base = os.path.abspath(baseline_path)
     hist = [p for p in rounds if os.path.abspath(p) <= abs_base] or rounds
     tol = _cmp_noise_tol_pct([_cmp_metrics(p) for p in hist])
-    shared = [m for m in base if m in cand and base[m]]
+    # a zero value on either side is an unmeasured round (wrong device,
+    # failed rung), not a measurement: skip it rather than gate on it
+    shared = [m for m in base if m in cand and base[m] and cand[m]]
     rows, regressed = [], []
     for m in sorted(shared):
         d = _cmp_direction(m)
@@ -3163,8 +3220,8 @@ def bench_compare(baseline_path: str,
               f"{dp:>+8.2f} {t:>6.1f}  {v}")
     skipped = len(base) - len(shared)
     if skipped:
-        print(f"({skipped} baseline metrics absent from candidate or "
-              f"zero-valued: not gated)")
+        print(f"({skipped} metrics absent from candidate or zero-valued "
+              f"on either side: not gated)")
     if regressed:
         print(f"REGRESSION: {len(regressed)} metric(s) beyond their "
               f"noise band: {', '.join(regressed)}")

@@ -1,4 +1,4 @@
-"""taxonomy-discipline: fallback reasons and metric names cannot fork.
+"""taxonomy-discipline: reasons, metric names and incident kinds cannot fork.
 
 Observability is only as good as its label discipline: a typo'd
 ``_fallback("trace failled")`` or a re-registered
@@ -6,13 +6,15 @@ Observability is only as good as its label discipline: a typo'd
 dashboards and the flight recorder then under-count the real reason.
 The runtime half of the defense is the frozen constant sets
 (``step_capture.FALLBACK_REASONS``, ``tp_attention.TP_FALLBACK_REASONS``,
-``metrics.METRIC_NAMES``) validated on the hot path; this rule is the
-static half, so the typo is caught at lint time, not mid-run.
+``metrics.METRIC_NAMES``, ``incident.INCIDENT_KINDS``) validated on the
+hot path; this rule is the static half, so the typo is caught at lint
+time, not mid-run.
 
 Mechanics: a cross-file ``begin`` pass collects every module-level
-``<NAME>_REASONS = frozenset({...})`` (reason taxonomy) and
-``METRIC_NAMES = frozenset({...})`` (metric taxonomy). ``check`` then
-verifies
+``<NAME>_REASONS = frozenset({...})`` (reason taxonomy),
+``METRIC_NAMES = frozenset({...})`` (metric taxonomy) and
+``INCIDENT_KINDS = frozenset({...})`` (incident taxonomy). ``check``
+then verifies
 
 * every STRING LITERAL in the reason position of a reason-bearing call
   (``_fallback``/``record_fallback``/``abort``/``CaptureAbort``) is a
@@ -21,6 +23,12 @@ verifies
 * every literal metric name registered through
   ``...registry().counter/gauge/histogram("name", ...)`` is a member of
   ``METRIC_NAMES``;
+* every literal kind passed to ``record_incident(...)`` is a member of
+  ``INCIDENT_KINDS`` — f-strings in the kind position are flagged (the
+  varying part belongs in ``attrs``), and every INCIDENT_KINDS entry
+  must appear at some analyzed call site (a kind no trigger records is
+  a dead incident class — same arming condition as the metric dead
+  check);
 * every METRIC_NAMES entry is registered SOMEWHERE in the analyzed
   sources — a frozen name nothing registers is a dead scrape series
   (the taxonomy rotted past the code). Liveness collection is
@@ -34,8 +42,9 @@ verifies
   spray false "dead" findings.
 
 Non-literal arguments are skipped: they were literals somewhere else,
-where this rule saw them. User code registering its own metrics is out
-of scope — the rule runs on framework sources only (src profile).
+where this rule saw them. User code registering its own metrics or
+recording its own incidents is out of scope — the rule runs on
+framework sources only (src profile).
 """
 
 from __future__ import annotations
@@ -85,6 +94,17 @@ def _is_metric_registration(call: ast.Call) -> bool:
     return isinstance(recv, ast.Name) and recv.id == "_REGISTRY"
 
 
+def _incident_kind_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The node in the frozen-kind position of a record_incident call:
+    first positional arg, else the ``kind=`` keyword."""
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "kind":
+            return kw.value
+    return None
+
+
 @register
 class TaxonomyRule(Rule):
     id = "taxonomy"
@@ -108,6 +128,13 @@ class TaxonomyRule(Rule):
         self.reg_files: Set[str] = set()
         # METRIC_NAMES definition sites: sf.path -> {name: lineno}
         self.metric_defs: Dict[str, Dict[str, int]] = {}
+        # incident taxonomy (observability/incident.py INCIDENT_KINDS)
+        self.incident_kinds: Set[str] = set()
+        self.saw_incident_set = False
+        self.incident_used: Set[str] = set()       # literal call-site kinds
+        self.incident_files: Set[str] = set()
+        # INCIDENT_KINDS definition sites: sf.path -> {kind: lineno}
+        self.incident_defs: Dict[str, Dict[str, int]] = {}
 
     def begin(self, files: Sequence[SourceFile]) -> None:
         for sf in files:
@@ -129,9 +156,16 @@ class TaxonomyRule(Rule):
                     defs = self.metric_defs.setdefault(sf.path, {})
                     for e in node.value.args[0].elts:
                         defs[e.value] = e.lineno
+                elif t.id == "INCIDENT_KINDS":
+                    self.incident_kinds |= vals
+                    self.saw_incident_set = True
+                    defs = self.incident_defs.setdefault(sf.path, {})
+                    for e in node.value.args[0].elts:
+                        defs[e.value] = e.lineno
             for node in ast.walk(sf.tree):
                 if isinstance(node, ast.Call):
                     self._collect_registration(sf, node)
+                    self._collect_incident_use(sf, node)
 
     def _collect_registration(self, sf: SourceFile, call: ast.Call) -> None:
         f = call.func
@@ -147,13 +181,23 @@ class TaxonomyRule(Rule):
             self.registered_prefixes.add(arg.left.value)
             self.reg_files.add(sf.path)
 
+    def _collect_incident_use(self, sf: SourceFile, call: ast.Call) -> None:
+        if terminal_name(call.func) != "record_incident":
+            return
+        arg = _incident_kind_arg(call)
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            self.incident_used.add(arg.value)
+            self.incident_files.add(sf.path)
+
     def check(self, sf: SourceFile) -> Iterator[Finding]:
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue
             yield from self._check_reason_site(sf, node)
             yield from self._check_metric_site(sf, node)
+            yield from self._check_incident_site(sf, node)
         yield from self._check_dead_entries(sf)
+        yield from self._check_dead_kinds(sf)
 
     def _check_reason_site(self, sf, call) -> Iterator[Finding]:
         if not self.saw_reason_set:
@@ -203,6 +247,47 @@ class TaxonomyRule(Rule):
                 f"METRIC_NAMES entry {name!r} is registered by no "
                 f"analyzed source — dead taxonomy entry: delete it or "
                 f"register the instrument it promises")
+
+    def _check_incident_site(self, sf, call) -> Iterator[Finding]:
+        if not self.saw_incident_set:
+            return
+        if terminal_name(call.func) != "record_incident":
+            return
+        arg = _incident_kind_arg(call)
+        if arg is None:
+            return
+        if isinstance(arg, ast.JoinedStr):
+            yield self.finding(
+                sf, arg.lineno,
+                "f-string in the incident-kind position of "
+                "record_incident() — kinds are frozen grouping keys; "
+                "pass an INCIDENT_KINDS member and put the varying "
+                "part in attrs")
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in self.incident_kinds:
+                yield self.finding(
+                    sf, arg.lineno,
+                    f"incident kind {arg.value!r} passed to "
+                    f"record_incident() is not a member of "
+                    f"observability.incident.INCIDENT_KINDS — taxonomy "
+                    f"fork (typo?) or a missing registration")
+
+    def _check_dead_kinds(self, sf: SourceFile) -> Iterator[Finding]:
+        """Every INCIDENT_KINDS entry must be recorded by some analyzed
+        trigger site — same arming condition as the metric dead check."""
+        defs = self.incident_defs.get(sf.path)
+        if not defs:
+            return
+        if len(self.incident_files - {sf.path}) < self.MIN_REG_FILES:
+            return
+        for kind in sorted(defs):
+            if kind in self.incident_used:
+                continue
+            yield self.finding(
+                sf, defs[kind],
+                f"INCIDENT_KINDS entry {kind!r} is recorded by no "
+                f"analyzed trigger site — dead incident class: delete "
+                f"it or wire the trigger it promises")
 
     def _check_metric_site(self, sf, call) -> Iterator[Finding]:
         if not self.saw_metric_set or not _is_metric_registration(call):

@@ -25,6 +25,7 @@ so a post-mortem can reconstruct exactly why a generation ended.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Optional, Tuple
@@ -32,6 +33,7 @@ from typing import Any, Callable, Optional, Tuple
 import numpy as np
 
 from ...observability import flight_recorder as _flight
+from ...observability import incident as _incident
 from ...observability import metrics as _metrics
 from ...observability import perf as _perf_mod
 from ..checkpoint.save_load import latest_checkpoint
@@ -111,6 +113,10 @@ class ResilientTrainer:
         if elastic is not None and install_signal:
             from ..fleet.elastic import PreemptionHandler
             self.handler = PreemptionHandler(elastic).install(signum)
+        # incident bundles land next to the checkpoint generations, the
+        # artifact an operator already inspects after a bad run
+        self._incident_root = os.path.join(checkpointer.root, "incidents")
+        _incident.attach_root(self._incident_root)
         self._comm_timeout = threading.Event()
         self._watchdog = watchdog
         if watchdog is not None:
@@ -127,6 +133,14 @@ class ResilientTrainer:
             self._comm_timeout.set()
             _record("resilience.comm_timeout",
                     (task.name, f"{task.elapsed():.1f}s"))
+            # forensics before the RESTART exit: the classified stacks
+            # name the thread wedged in the collective (the post-restart
+            # log only knows the timeout fired)
+            _incident.record_incident(
+                "trainer.comm_timeout",
+                root=self._incident_root,
+                attrs={"task": task.name,
+                       "elapsed_s": round(task.elapsed(), 1)})
 
     # -- restore -------------------------------------------------------------
     def restore(self) -> int:
@@ -202,6 +216,13 @@ class ResilientTrainer:
         _M_REWINDS.inc()
         _M_REWIND_SECONDS.observe(time.monotonic() - t0)
         _record("anomaly.rewind", (step, resume, first_bad))
+        # the rewind destroys the in-process evidence (params, optimizer
+        # state, anomaly history are all restored over): bundle the
+        # metrics/flight/trace view of the poisoned window first
+        _incident.record_incident(
+            "trainer.rewind", root=self._incident_root, step=step,
+            attrs={"resume_step": resume, "first_bad_step": first_bad,
+                   "restored_from": path})
         if self.anomaly is not None:
             self.anomaly.reset()
         return resume

@@ -254,6 +254,26 @@ define_flag("perf_attribution", False,
             "classification per executable on /perfz. Off (default) the "
             "hot path pays ~zero (trace-time caches rebuild without the "
             "instrumentation; coarse sites pay one flag read)")
+define_flag("incident_recorder", True,
+            "incident forensics plane (observability/incident.py): on a "
+            "terminal transition — serving step hang, trainer comm "
+            "timeout, anomaly rewind, fleet failover, perf-regression "
+            "sentinel breach, uncaught exception — assemble ONE committed "
+            "incident-<step>-<uid>/ bundle (classified host stacks, trace "
+            "ring, flight-recorder tail, metrics + perf snapshots, flags "
+            "fingerprint) under the attached root. False short-circuits "
+            "every trigger to a single flag read")
+define_flag("incident_dir", "",
+            "explicit incident-bundle root; empty (default) = the root "
+            "the serving engine / trainer / router attached (their own "
+            "<root>/incidents)")
+define_flag("incident_keep", 8,
+            "keep-K retention: committed incident bundles beyond the "
+            "newest K are pruned after each new commit")
+define_flag("incident_rate_limit_s", 30.0,
+            "minimum seconds between two bundles of the SAME incident "
+            "kind (a flapping sentinel must not fill the disk); 0 = "
+            "unlimited")
 define_flag("perf_sample_every", 16,
             "device-time sampling period for the executable ledger: every "
             "Nth call of a registered executable is timed through "

@@ -23,11 +23,22 @@ the process been doing?" at ALL times, at near-zero cost:
 * a **live ops endpoint** (:mod:`.exporter`) — a stdlib-HTTP thread
   serving ``/metrics`` (Prometheus text), ``/healthz`` (fleet/engine
   readiness), ``/statusz`` (flags, versions, replica table, flight-
-  recorder tail) and ``/trace`` (Chrome-trace JSON), gated by
+  recorder tail), ``/trace`` (Chrome-trace JSON) and ``/debugz``
+  (classified stacks + incident index), gated by
   ``FLAGS_telemetry_port`` (-1 off, 0 free port). On a fleet router
   one scrape shows every replica: workers piggyback registry deltas on
   their heartbeats and the router merges them under a
-  ``replica="<name>"`` label.
+  ``replica="<name>"`` label;
+* the **incident forensics plane** (:mod:`.debug` + :mod:`.incident`) —
+  on-demand all-thread host stack capture classified against the
+  frames the framework owns (data wait / jit compile / device call /
+  collective / journal fsync / lock), and an :class:`IncidentRecorder`
+  that assembles ONE committed ``incident-<step>-<uid>/`` bundle
+  (stacks, trace ring, flight tail, metrics, perf ledger, flags
+  fingerprint) at every terminal transition — serving step hang,
+  trainer comm timeout, anomaly rewind, fleet failover, perf
+  regression, uncaught exception — gated by ``FLAGS_incident_recorder``
+  with kinds frozen in :data:`incident.INCIDENT_KINDS`.
 
 ``python -m paddle_tpu.observability`` prints all three dumps.
 
@@ -81,7 +92,8 @@ Typical use::
 
 from __future__ import annotations
 
-from . import flight_recorder, metrics, tracing  # noqa: F401
+from . import debug, flight_recorder, metrics, tracing  # noqa: F401
+from . import incident  # noqa: F401  (uses debug + the three above)
 from . import exporter  # noqa: F401  (after its siblings: it uses all three)
 from .exporter import (  # noqa: F401
     TelemetryServer,
@@ -89,6 +101,19 @@ from .exporter import (  # noqa: F401
     attach_fleet as attach_telemetry_fleet,
     serve as serve_telemetry,
     shutdown as shutdown_telemetry,
+)
+from .debug import (  # noqa: F401
+    STACK_CLASSES,
+    capture_stacks,
+    classify_frames,
+    format_stacks,
+)
+from .incident import (  # noqa: F401
+    INCIDENT_KINDS,
+    IncidentRecorder,
+    attach_root as attach_incident_root,
+    recent_incidents,
+    record_incident,
 )
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
@@ -144,4 +169,7 @@ __all__ = [
     "instant", "event", "dump_trace", "current_trace_id",
     "exporter", "TelemetryServer", "serve_telemetry", "shutdown_telemetry",
     "attach_telemetry_fleet", "attach_telemetry_engine",
+    "debug", "STACK_CLASSES", "capture_stacks", "classify_frames",
+    "format_stacks", "incident", "INCIDENT_KINDS", "IncidentRecorder",
+    "record_incident", "recent_incidents", "attach_incident_root",
 ]

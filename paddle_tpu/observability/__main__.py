@@ -3,7 +3,9 @@
 Prints the process-wide observability dumps: Prometheus text exposition
 (``prometheus``), the JSON metrics snapshot (``json``), the Chrome-trace
 span dump (``trace``), the performance-attribution view (``perfz``, the
-CLI twin of the /perfz endpoint), or the first three (default). Mostly useful under
+CLI twin of the /perfz endpoint), the live classified-stack +
+recent-incident view (``debugz``, the CLI twin of the /debugz
+endpoint), or the first three (default). Mostly useful under
 ``-i`` / in a notebook kernel or subprocess that has already imported
 paddle_tpu and done work — a fresh interpreter only shows import-time
 activity, which is still a handy smoke test that the registries and the
@@ -23,11 +25,20 @@ def main(argv=None) -> int:
         prog="python -m paddle_tpu.observability",
         description="print paddle_tpu observability dumps")
     p.add_argument("what", nargs="?", default="all",
-                   choices=("prometheus", "json", "trace", "perfz", "all"),
+                   choices=("prometheus", "json", "trace", "perfz",
+                            "debugz", "all"),
                    help="which dump to print (default: all)")
     p.add_argument("--indent", type=int, default=2,
                    help="JSON indent for json/trace dumps (default: 2)")
     args = p.parse_args(argv)
+    if args.what == "debugz":
+        from . import debug as _debug
+        from . import incident as _incident
+        sys.stdout.write(_debug.format_stacks())
+        for inc in _incident.recent_incidents():
+            sys.stdout.write(f"incident {inc['kind']} step={inc['step']} "
+                             f"trace={inc['trace_id']} {inc['path']}\n")
+        return 0
     if args.what == "perfz":
         from . import perf as _perf
         sys.stdout.write(_perf.format_perfz(_perf.perfz_snapshot()) + "\n")

@@ -29,6 +29,11 @@ Endpoints:
   footprint, achieved FLOP/s vs the roofline, bound classification),
   the step-time decomposition summary, and the AOT projected-vs-
   achieved join (``perf.perfz_snapshot``).
+* ``/debugz`` — live incident forensics: every thread's host stack
+  classified against the frames the framework owns (data wait / jit
+  compile / device call / collective / journal fsync / lock), the
+  recent-incident index, and — with ``?record=1`` — an on-demand
+  committed incident bundle (kind ``debug.manual``).
 
 Lifecycle: ``FLAGS_telemetry_port`` is -1 (off) by default; 0 binds a
 free port (tests), >0 binds that port. :func:`attach_fleet` (called by
@@ -50,7 +55,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from .. import flags as _flags
+from . import debug as _debug
 from . import flight_recorder as _flight
+from . import incident as _incident
 from . import metrics as _metrics
 from . import perf as _perf
 from . import tracing as _tracing
@@ -284,6 +291,34 @@ class TelemetryServer:
     def _trace_body(self) -> str:
         return json.dumps(_tracing.to_chrome())
 
+    def _debugz_body(self, record: bool = False) -> str:
+        """Live forensics page: classified all-thread stacks + the
+        recent-incident index; ``record=True`` commits an on-demand
+        ``debug.manual`` bundle first and reports where it landed."""
+        lines: List[str] = ["paddle_tpu debugz", ""]
+        if record:
+            path = _incident.record_incident("debug.manual")
+            if path is None:
+                path = ("NOT RECORDED (recorder off, rate-limited, or "
+                        "no root attached)")
+            lines.append(f"bundle: {path}")
+            lines.append("")
+        snap = _debug.stacks_snapshot()
+        by_cls = ", ".join(f"{k}={v}"
+                           for k, v in sorted(snap["by_class"].items()))
+        lines.append(f"threads: {snap['threads']}   classes: {by_cls}")
+        lines.append("")
+        lines.append(_debug.format_stacks(snap["stacks"]).rstrip("\n"))
+        recent = _incident.recent_incidents()
+        lines += ["", f"recent incidents ({len(recent)}):"]
+        for inc in recent:
+            lines.append(
+                f"  {inc['kind']:<20} step={inc['step']} "
+                f"trace={inc['trace_id'] or '-':<17} {inc['path']}")
+        if not recent:
+            lines.append("  (none recorded by this process)")
+        return "\n".join(lines) + "\n"
+
 
 def _make_handler(server: TelemetryServer):
     class _Handler(BaseHTTPRequestHandler):
@@ -327,6 +362,12 @@ def _make_handler(server: TelemetryServer):
                 elif path == "/perfz":
                     self._send(200, server._perfz_body(),
                                "application/json")
+                elif path == "/debugz":
+                    query = self.path.partition("?")[2]
+                    self._send(200,
+                               server._debugz_body(
+                                   record="record=1" in query),
+                               "text/plain; charset=utf-8")
                 else:
                     self._send(404, "not found\n", "text/plain")
             except BrokenPipeError:

@@ -117,6 +117,8 @@ METRIC_NAMES = frozenset({
     "telemetry.scrapes", "telemetry.scrape_seconds",
     # observability/tracing.py (end-to-end span subsystem)
     "tracing.spans", "tracing.events",
+    # observability/incident.py (incident forensics plane)
+    "incident.recorded", "incident.dropped", "incident.write_seconds",
     # observability/perf.py (executable ledger + step decomposition)
     "perf.samples", "perf.regression", "perf.ledger.dropped",
     "perf.executable.calls", "perf.executable.wall_seconds",

@@ -410,6 +410,15 @@ class ExecutableLedger:
                 "perf.regression",
                 (label, f"thr={thr:.3g}", f"hwm={hwm:.3g}",
                  f"drop>{REGRESSION_DROP_PCT:.0f}%"))
+            # forensics: bundle the ledger + stacks while the slow
+            # executable is still resident (lazy import — incident pulls
+            # perfz_snapshot from here at assembly time)
+            from . import incident as _incident
+            _incident.record_incident(
+                "perf.regression",
+                attrs={"label": label, "throughput": thr,
+                       "high_water_mark": hwm,
+                       "drop_pct": REGRESSION_DROP_PCT})
 
     def wrap(self, key: Any, kind: str, fn: Callable, name: str = "",
              lower: Any = None) -> Callable:
@@ -597,6 +606,12 @@ def record_step(total_s: float, host_s: float = 0.0,
         data_wait = _pending_data_wait
         _pending_data_wait = 0.0
     data_wait = min(data_wait, total_s)
+    # host dispatch and launch-to-ready are measured as overlapping
+    # intervals; on tiny graphs their sum can exceed the step wall.
+    # Clamp in priority order so the documented invariant (components
+    # sum to the wall EXACTLY) survives the overlap artifact.
+    host_s = min(host_s, total_s - data_wait)
+    device_s = min(device_s, total_s - data_wait - host_s)
     other = max(0.0, total_s - data_wait - host_s - device_s)
     _H_STEP_TOTAL.observe(total_s)
     _H_DATA_WAIT.observe(data_wait)

@@ -116,6 +116,8 @@ SPAN_NAMES = frozenset({
     "anomaly.verdict",         # event: non-OK AnomalyDetector verdict
     "checkpoint.snapshot",     # span: foreground device->host snapshot
     "checkpoint.commit",       # span: background serialize+fsync+commit
+    # observability/incident.py — forensic bundle assembly
+    "observability.incident",  # span: one incident bundle commit
     # observability/perf.py — retro step-decomposition segments laid
     # over each recorded step's interval
     "perf.step.data_wait",     # span (retro): blocked on the data pipeline

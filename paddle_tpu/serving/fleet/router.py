@@ -52,6 +52,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...observability import flight_recorder as _flight
+from ...observability import incident as _incident
 from ...observability import metrics as _metrics
 from ...observability import tracing as _tracing
 from ..resilience.journal import RequestJournal
@@ -212,6 +213,12 @@ class ReplicaRouter:
         # fleet catches up, router-side so it sees subprocess fleets)
         self._slo_window_s = 5.0
         self._completions: deque = deque(maxlen=512)
+        # router-side incident bundles land beside the replica roots
+        # (their common parent), so a failover's router bundle and the
+        # victim's own hang/crash bundle sit in one tree
+        any_root = next(iter(self._replicas.values())).root
+        self._incident_root = os.path.join(
+            os.path.dirname(os.path.abspath(any_root)), "incidents")
 
     @property
     def dropped_requests(self) -> int:
@@ -459,6 +466,9 @@ class ReplicaRouter:
         _tracing.instant("fleet.replica_dead",
                          attrs={"replica": name, "victims": len(victims)})
         if not victims:
+            _incident.record_incident(
+                "fleet.failover", root=self._incident_root,
+                attrs={"replica": name, "victims": 0})
             _M_HANDOFF.observe(time.monotonic() - t0)
             return
         state = RequestJournal(
@@ -496,6 +506,16 @@ class ReplicaRouter:
                            "disposition": "parked",
                            "watermark": len(toks)})
         self._place_parked()
+        # router-side failover incident: carries every victim's trace
+        # id so this bundle correlates with the dead replica's own
+        # journal/bundle (the victim submit spans share those ids)
+        traced = [o.trace for o in victims if o.trace is not None]
+        _incident.record_incident(
+            "fleet.failover", root=self._incident_root,
+            trace_id=traced[0][0] if traced else None,
+            attrs={"replica": name, "victims": len(victims),
+                   "victim_gids": [o.gid for o in victims],
+                   "victim_traces": [f"{t[0]:016x}" for t in traced]})
         _M_HANDOFF.observe(time.monotonic() - t0)
 
     def _place_parked(self) -> None:

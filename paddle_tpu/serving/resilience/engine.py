@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ...models.serving import ContinuousBatchingEngine
 from ...observability import flight_recorder as _flight
+from ...observability import incident as _incident
 from ...observability import metrics as _metrics
 from ...observability import tracing as _tracing
 from .journal import RequestJournal
@@ -105,6 +106,12 @@ class ResilientServingEngine:
         self.root = root
         self.journal = RequestJournal(os.path.join(root, "journal"))
         self.warm_root = os.path.join(root, "warmcache")
+        # incident bundles land NEXT TO the journal: the relaunch (or
+        # the operator) finds the hang attribution in the same root the
+        # recovery reads. Also soft-attached process-wide so rootless
+        # triggers (crash excepthook, /debugz) have somewhere to commit.
+        self._incident_root = os.path.join(root, "incidents")
+        _incident.attach_root(self._incident_root)
         self.drain_deadline_s = float(drain_deadline_s)
         self.journal_flush_every = max(1, int(journal_flush_every))
         self.snapshot_every = max(0, int(snapshot_every))
@@ -508,6 +515,22 @@ class ResilientServingEngine:
         return dt
 
     # -- step-hang watchdog --------------------------------------------------
+    def _journal_watermarks(self) -> Dict[str, Any]:
+        """Cheap journal state for an incident bundle: per-rid committed
+        watermarks, buffered-but-unflushed record count and the on-disk
+        segment cursor — what the post-restart replay will see vs what
+        the hang lost. Read-only and allocation-light (safe on the
+        watchdog scan thread microseconds before ``os._exit``)."""
+        try:
+            return {
+                "watermarks": dict(self._watermark),
+                "outputs_delivered": len(self.outputs),
+                "pending_records": self.journal.pending_records,
+                "next_segment": self.journal._next_seg,
+            }
+        except Exception:
+            return {}          # forensics must not throw on the scan thread
+
     def _start_watchdog(self, timeout_s: float,
                         first_step_timeout_s: Optional[float]) -> None:
         def scan():
@@ -534,6 +557,21 @@ class ResilientServingEngine:
                         _record("serving.resilience.step_hang", (stalled,))
                         _tracing.instant("serving.step_hang",
                                          attrs={"stalled_s": stalled})
+                        # attribute the wedge WHILE it is still wedged:
+                        # the classified all-thread stacks in the bundle
+                        # say device call vs data wait vs lock, which a
+                        # post-restart log line never can. Synchronous on
+                        # this scan thread — with hang_exit the process
+                        # dies in the next statement, and the stderr
+                        # fallback keeps the attribution when the
+                        # recorder is off.
+                        _incident.record_incident(
+                            "serving.hang", root=self._incident_root,
+                            step=self.engine.steps,
+                            attrs={"stalled_s": stalled,
+                                   "hang_exit": self._hang_exit},
+                            journal=self._journal_watermarks(),
+                            fallback_stderr=self._hang_exit)
                     if self._hang_exit:
                         # the main thread is wedged inside a device call
                         # and can never poll(): the journal already holds

@@ -514,15 +514,73 @@ class TestTaxonomyRule:
                                      'm.registry().counter("b.other")\n')])
         assert fs == []
 
+    INCIDENTS = ('INCIDENT_KINDS = frozenset({"serving.hang", '
+                 '"fleet.failover"})\n')
+
+    def _check_incident(self, body, extra=()):
+        return check_src(
+            body, ["taxonomy"],
+            extra_files=[("incident.py", self.INCIDENTS), *extra])
+
+    def test_incident_member_kind_is_clean(self):
+        assert self._check_incident(
+            'def f():\n    record_incident("serving.hang")\n') == []
+        assert self._check_incident(
+            'def f():\n    record_incident(kind="fleet.failover")\n') == []
+
+    def test_incident_kind_typo_fires(self):
+        fs = self._check_incident(
+            'def f():\n    record_incident("serving.hagn")\n')
+        assert len(fs) == 1
+        assert "INCIDENT_KINDS" in fs[0].message
+        assert "'serving.hagn'" in fs[0].message
+
+    def test_incident_fstring_kind_fires(self):
+        fs = self._check_incident(
+            'def f(n):\n    record_incident(f"serving.{n}")\n')
+        assert len(fs) == 1 and "f-string" in fs[0].message
+
+    def test_incident_attrs_are_not_checked(self):
+        assert self._check_incident(
+            'def f(e):\n'
+            '    record_incident("serving.hang", attrs={"e": f"x {e}"})\n'
+        ) == []
+
+    def test_dead_incident_kind_fires_on_its_definition_line(self):
+        # "fleet.failover" defined but recorded nowhere; trigger sites
+        # in >=2 other files arm the check (same rule as dead metrics)
+        defs = ('INCIDENT_KINDS = frozenset({\n'
+                '    "serving.hang",\n'
+                '    "fleet.failover",\n'
+                '})\n')
+        sites = [("eng.py", 'record_incident("serving.hang")\n'),
+                 ("trn.py", 'record_incident("serving.hang")\n')]
+        fs = check_src(defs, ["taxonomy"], rel="incident.py",
+                       extra_files=sites)
+        assert len(fs) == 1
+        assert "'fleet.failover'" in fs[0].message
+        assert "dead incident class" in fs[0].message
+        assert fs[0].line == 3
+
+    def test_dead_incident_check_stays_disarmed_on_scoped_runs(self):
+        defs = 'INCIDENT_KINDS = frozenset({"fleet.failover"})\n'
+        fs = check_src(defs, ["taxonomy"], rel="incident.py",
+                       extra_files=[("eng.py",
+                                     'record_incident("serving.hang")\n')])
+        assert fs == []
+
     def test_frozen_sets_actually_exist_in_package(self):
         # the rule is vacuous without the runtime sets: pin them
         from paddle_tpu.jit.step_capture import FALLBACK_REASONS
+        from paddle_tpu.observability.incident import INCIDENT_KINDS
         from paddle_tpu.observability.metrics import METRIC_NAMES
         from paddle_tpu.ops.kernels.pallas.tp_attention import \
             TP_FALLBACK_REASONS
         assert "trace failed" in FALLBACK_REASONS
         assert "flags_off" in TP_FALLBACK_REASONS
         assert "step_capture.static_screened" in METRIC_NAMES
+        assert "serving.hang" in INCIDENT_KINDS
+        assert "incident.recorded" in METRIC_NAMES
 
     def test_runtime_validation_rejects_unknown_reason(self):
         import paddle_tpu as paddle
@@ -536,6 +594,11 @@ class TestTaxonomyRule:
             cap._fallback("no such reason")
         with pytest.raises(ValueError, match="unregistered"):
             tpa.record_fallback("flash", "no_such_key", "detail")
+
+    def test_runtime_validation_rejects_unknown_incident_kind(self):
+        from paddle_tpu.observability import incident
+        with pytest.raises(ValueError, match="INCIDENT_KINDS"):
+            incident.IncidentRecorder().record("no.such.kind")
 
 
 # ---------------------------------------------------------------------------

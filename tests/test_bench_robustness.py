@@ -13,7 +13,8 @@ import sys
 
 import pytest
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 import bench
 
@@ -217,14 +218,16 @@ class TestServingFleetMicro:
         delivered stream byte-identical to the single-engine reference.
         Goodput and the tracing tax are wall-clock gates: one retry
         absorbs a busy host."""
-        r = bench.bench_serving_fleet(False, quick=True)
-        d = r["detail"]
-        if (r["value"] < 1.0 or d["overload_sheds"] == 0
-                or d["tracing_overhead_pct"] >= 3.0
-                or d["scrape_overhead_pct"] >= 3.0
-                or d["perf_overhead_pct"] >= 3.0):        # timing gates
+        for _attempt in range(3):                         # timing gates
             r = bench.bench_serving_fleet(False, quick=True)
             d = r["detail"]
+            if not (r["value"] < 1.0 or d["overload_sheds"] == 0
+                    or d["tracing_overhead_pct"] >= 3.0
+                    or d["scrape_overhead_pct"] >= 3.0
+                    or d["perf_overhead_pct"] >= 3.0
+                    or d["incident_overhead_pct"] >= 1.0
+                    or d["incident_disabled_probe_ns"] >= 1000.0):
+                break
         assert r["metric"] == "serving_fleet_goodput"
         assert d["replicas"] == 2
         assert d["base_delivered"] == d["base_offered"]
@@ -257,6 +260,12 @@ class TestServingFleetMicro:
         assert d["perf_calls_per_round"] > 0
         assert d["perf_samples_per_round"] > 0
         assert d["perf_overhead_pct"] < d["perf_gate_pct"], d
+        # PR18 gate: one worst-case incident bundle per load round must
+        # compose to <1% of round CPU, and the disabled trigger probe
+        # must stay in one-flag-read territory (sub-microsecond)
+        assert d["incident_bundle_cost_ms"] > 0.0
+        assert d["incident_disabled_probe_ns"] < 1000.0, d
+        assert d["incident_overhead_pct"] < d["incident_gate_pct"], d
         kinds = {row["kind"] for row in d["perfz_top"]}
         assert "serving" in kinds and "step" in kinds, d["perfz_top"]
         assert any(row["flops"] for row in d["perfz_top"])
@@ -439,6 +448,68 @@ class TestObservabilityMicro:
         got = paddle.get_flags(["FLAGS_metrics", "FLAGS_flight_recorder"])
         assert got["FLAGS_metrics"] is True
         assert got["FLAGS_flight_recorder"] is True
+
+
+class TestCompareGate:
+    """bench.py --compare rc contract (ISSUE PR18 satellite): the
+    noise-aware regression gate must pass every recorded adjacent round
+    pair (rc 0, zero REGRESSED verdicts — history is ground truth, any
+    flag there is a false positive), fail a genuinely poisoned
+    candidate with rc 1, and report usage errors with rc 2."""
+
+    ROUNDS = [os.path.join(REPO, f"BENCH_r0{i}.json") for i in range(1, 7)]
+
+    def test_recorded_rounds_exist(self):
+        for p in self.ROUNDS:
+            assert os.path.exists(p), f"missing recorded round {p}"
+
+    @pytest.mark.parametrize("i", range(5))
+    def test_adjacent_pairs_have_no_false_regressions(self, i, capsys):
+        rc = bench.bench_compare(self.ROUNDS[i], self.ROUNDS[i + 1])
+        out = capsys.readouterr().out
+        assert rc == 0, f"false regression r0{i+1}->r0{i+2}:\n{out}"
+        assert "REGRESSED" not in out
+
+    def test_poisoned_candidate_fails_with_rc_1(self, tmp_path, capsys):
+        # worsen every direction-gated metric far past any noise band
+        base = self.ROUNDS[4]
+        with open(base) as f:
+            rec = json.load(f)
+        parsed = rec.get("parsed", rec)
+        records = [parsed] + list(
+            (parsed.get("detail") or {}).get("configs") or [])
+        poisoned = []
+        for r in records:
+            d = bench._cmp_direction(str(r.get("metric")))
+            if d and isinstance(r.get("value"), (int, float)) and r["value"]:
+                r["value"] = r["value"] * (0.01 if d > 0 else 100.0)
+                poisoned.append(r["metric"])
+        assert poisoned, "no direction-gated metric in the round record"
+        cand = tmp_path / "poisoned.json"
+        cand.write_text(json.dumps(rec))
+        rc = bench.bench_compare(base, str(cand))
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSED" in out
+
+    def test_zero_valued_candidate_metric_is_not_gated(self, capsys):
+        # r06's headline was recorded on the wrong device (value 0.0):
+        # an unmeasured rung must be skipped, not flagged as -100%
+        rc = bench.bench_compare(self.ROUNDS[4], self.ROUNDS[5])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "not gated" in out
+
+    def test_missing_baseline_arg_exits_2(self, monkeypatch):
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--compare"])
+        with pytest.raises(SystemExit) as ei:
+            bench.main()
+        assert ei.value.code == 2
+
+    def test_no_rounds_next_to_baseline_is_rc_2(self, tmp_path, capsys):
+        lone = tmp_path / "lone.json"
+        lone.write_text("{}")
+        assert bench.bench_compare(str(lone)) == 2
 
 
 pytestmark = pytest.mark.smoke

@@ -444,6 +444,49 @@ class TestFleetRouter:
         finally:
             router.close()
 
+    def test_failover_commits_incident_with_victim_trace(self, model,
+                                                         tmp_path):
+        """The death transition is a terminal event (PR18 tentpole):
+        the router must commit a fleet.failover bundle whose
+        victim_traces carry the ORIGINAL submit trace ids — the one
+        key that correlates this bundle with the dead replica's own
+        journal and trace ring."""
+        saved = paddle.get_flags(["FLAGS_incident_rate_limit_s"])
+        paddle.set_flags({"FLAGS_incident_rate_limit_s": 0.0})
+        router, reps = _mk_fleet(model, tmp_path)
+        try:
+            gids = [router.submit(p, max_new_tokens=5)
+                    for p in _prompts(5, rng_seed=11)]
+            victim_name = router._outstanding[gids[-1]].replica
+            victim_traces = {
+                f"{o.trace[0]:016x}"
+                for o in router._outstanding.values()
+                if o.replica == victim_name and o.trace is not None}
+            assert victim_traces, "submit spans must carry trace ids"
+            next(r for r in reps if r.name == victim_name).kill()
+            router.drain_all(timeout_s=120.0)
+            inc_dir = tmp_path / "incidents"
+            matched = []
+            for d in os.listdir(inc_dir):
+                if not d.startswith("incident-"):
+                    continue
+                with open(inc_dir / d / "incident.json") as f:
+                    hdr = json.load(f)
+                if (hdr["kind"] == "fleet.failover"
+                        and hdr["attrs"]["replica"] == victim_name
+                        and hdr["attrs"]["victims"] > 0):
+                    matched.append(hdr)
+            assert matched, "no failover incident for the victim"
+            hdr = matched[0]
+            assert hdr["trace_id"] in victim_traces
+            assert set(hdr["attrs"]["victim_traces"]) <= victim_traces
+            assert set(hdr["attrs"]["victim_gids"]) <= set(gids)
+            assert router.dropped_requests == 0
+            _assert_byte_identical(router, model)
+        finally:
+            router.close()
+            paddle.set_flags(saved)
+
     def test_submit_routes_around_dead_transport(self, model, tmp_path):
         router, reps = _mk_fleet(model, tmp_path)
         try:

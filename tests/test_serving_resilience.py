@@ -1023,6 +1023,48 @@ class TestStepHangWatchdog:
         assert e1.poll() == ServingAction.RESTART
         e1.close()
 
+    def test_hang_commits_incident_bundle(self, model, tmp_path,
+                                          monkeypatch):
+        """The watchdog's RESTART transition is a terminal event: it
+        must leave ONE committed incident bundle under the engine's own
+        <root>/incidents attributing the wedge (PR18 tentpole)."""
+        saved = paddle.get_flags(["FLAGS_incident_rate_limit_s"])
+        paddle.set_flags({"FLAGS_incident_rate_limit_s": 0.0})
+        try:
+            root = str(tmp_path / "h")
+            e1 = ResilientServingEngine(model, root,
+                                        step_timeout_s=0.3, **ENG)
+            e1.add_request([3, 1, 4], max_new_tokens=4)
+            e1.step()
+            deadline = time.time() + 5.0
+            while (e1.poll() != ServingAction.RESTART
+                   and time.time() < deadline):
+                time.sleep(0.05)
+            assert e1.poll() == ServingAction.RESTART
+            e1.close()
+            inc_dir = os.path.join(root, "incidents")
+            bundles = [d for d in os.listdir(inc_dir)
+                       if d.startswith("incident-")]
+            assert len(bundles) == 1
+            bundle = os.path.join(inc_dir, bundles[0])
+            md = read_committed_marker(bundle)
+            assert md is not None and md["kind"] == "serving.hang"
+            with open(os.path.join(bundle, "incident.json")) as f:
+                hdr = json.load(f)
+            assert hdr["kind"] == "serving.hang"
+            assert hdr["attrs"]["stalled_s"] >= 0.3
+            assert hdr["attrs"]["hang_exit"] is False
+            assert set(hdr["stack_classes"]) <= set(
+                paddle.observability.STACK_CLASSES)
+            with open(os.path.join(bundle, "journal.json")) as f:
+                jr = json.load(f)
+            assert "watermarks" in jr and "pending_records" in jr
+            for part in ("stacks.json", "stacks.txt", "metrics.json",
+                         "flight.txt"):
+                assert os.path.exists(os.path.join(bundle, part)), part
+        finally:
+            paddle.set_flags(saved)
+
     def test_no_hang_while_stepping_or_idle(self, model, tmp_path):
         e1 = ResilientServingEngine(model, str(tmp_path / "h"),
                                     step_timeout_s=0.5, **ENG)
@@ -1159,6 +1201,90 @@ class TestServingChaos:
         p = self._spawn(tmp_path, attempt=5)
         assert p.wait(timeout=240) == 0
         assert self._result(tmp_path, 5)["outputs"] == ref
+
+
+_HANG_EXIT_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving.resilience import ResilientServingEngine
+paddle.seed(0)
+cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                  num_hidden_layers=1, num_attention_heads=2,
+                  num_key_value_heads=2, max_position_embeddings=128)
+m = LlamaForCausalLM(cfg)
+m.eval()
+eng = ResilientServingEngine(m, sys.argv[1], step_timeout_s=0.3,
+                             hang_exit=True, max_batch=2, num_blocks=32,
+                             block_size=8, temperature=0.0)
+eng.add_request([1, 2, 3], max_new_tokens=8)
+eng.step()                   # steady state: the watchdog now polices
+print("STEPPED", flush=True)
+time.sleep(120)              # the wedge — only os._exit(75) ends this
+sys.exit(99)                 # unreachable if the watchdog fires
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.heavy
+class TestHangExitChaos:
+    """Satellite (PR18): ``hang_exit`` previously destroyed all
+    evidence — ``os._exit(75)`` from the scan thread left NOTHING
+    saying why the process died. The watchdog must now bundle-then-die:
+    one committed incident under the engine's root survives the exit
+    (recorder on), or the classified stacks land on stderr (recorder
+    off). Either way the supervisor's exit code 75 has an attribution
+    artifact next to it."""
+
+    def _run_child(self, tmp_path, extra_env=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        env.update(extra_env or {})
+        return subprocess.run(
+            [sys.executable, "-c", _HANG_EXIT_CHILD,
+             str(tmp_path / "serve")],
+            env=env, capture_output=True, text=True, timeout=240)
+
+    def test_hang_exit_commits_bundle_then_dies_75(self, tmp_path):
+        out = self._run_child(tmp_path)
+        assert out.returncode == 75, (out.returncode, out.stderr[-2000:])
+        assert "STEPPED" in out.stdout
+        inc_dir = tmp_path / "serve" / "incidents"
+        bundles = [d for d in os.listdir(inc_dir)
+                   if d.startswith("incident-")]
+        assert len(bundles) == 1, bundles   # exactly ONE, despite _exit
+        bundle = inc_dir / bundles[0]
+        md = read_committed_marker(str(bundle))
+        assert md is not None and md["kind"] == "serving.hang"
+        with open(bundle / "incident.json") as f:
+            hdr = json.load(f)
+        assert hdr["attrs"]["hang_exit"] is True
+        assert hdr["attrs"]["stalled_s"] >= 0.3
+        # the wedged main thread is attributed, not just listed: the
+        # child parks in time.sleep, so its class is a known bucket
+        with open(bundle / "stacks.json") as f:
+            stacks = json.load(f)
+        assert set(stacks["by_class"]) <= set(
+            paddle.observability.STACK_CLASSES)
+        main_th = [s for s in stacks["stacks"]
+                   if s["name"] == "MainThread"]
+        assert main_th and main_th[0]["frames"]
+        with open(bundle / "journal.json") as f:
+            jr = json.load(f)
+        assert "watermarks" in jr
+        for part in ("stacks.txt", "metrics.json", "flight.txt"):
+            assert (bundle / part).exists(), part
+
+    def test_hang_exit_recorder_off_stderr_fallback(self, tmp_path):
+        out = self._run_child(
+            tmp_path, {"FLAGS_incident_recorder": "False"})
+        assert out.returncode == 75, (out.returncode, out.stderr[-2000:])
+        assert not (tmp_path / "serve" / "incidents").exists() or not \
+            os.listdir(tmp_path / "serve" / "incidents")
+        assert "kind=serving.hang" in out.stderr
+        assert "threads:" in out.stderr      # classified stacks dumped
 
 
 pytestmark = pytest.mark.smoke

@@ -441,6 +441,60 @@ class TestExporter:
         finally:
             srv.shutdown()
 
+    def test_endpoints_survive_dead_weakrefs(self):
+        # satellite (PR18): the exporter observes the serving stack via
+        # weakrefs only — after the router and engine are garbage
+        # collected every endpoint must degrade to its process-level
+        # view (healthz back to process-alive), never 500
+        import gc
+        srv, port = self._server()
+        eng = _FakeEngine()
+        router = _FakeRouter({"r0": "ready"})
+        try:
+            srv.attach_engine(eng)
+            srv.attach_fleet(router)
+            del eng, router
+            gc.collect()
+            for path in ("/metrics", "/healthz", "/statusz", "/perfz",
+                         "/debugz"):
+                code, _, _ = _get(port, path)
+                assert code == 200, f"{path} -> {code} after refs died"
+            code, body, _ = _get(port, "/healthz")
+            assert json.loads(body)["status"] == "ok"
+        finally:
+            srv.shutdown()
+
+    def test_debugz_live_stacks_and_on_demand_bundle(self, tmp_path):
+        # tentpole surface: /debugz shows every thread classified, and
+        # ?record=1 commits a debug.manual bundle on demand
+        from paddle_tpu.observability import incident as incident_mod
+        srv, port = self._server()
+        saved = paddle.get_flags(
+            ["FLAGS_incident_dir", "FLAGS_incident_rate_limit_s"])
+        try:
+            paddle.set_flags({
+                "FLAGS_incident_dir": str(tmp_path),
+                "FLAGS_incident_rate_limit_s": 0.0})
+            code, body, ctype = _get(port, "/debugz")
+            assert code == 200 and "text/plain" in ctype
+            assert "thread" in body and "classes:" in body
+            code, body, _ = _get(port, "/debugz?record=1")
+            assert code == 200
+            bundles = [d for d in os.listdir(tmp_path)
+                       if d.startswith("incident-")]
+            assert len(bundles) == 1
+            assert os.path.exists(
+                os.path.join(tmp_path, bundles[0], "COMMITTED"))
+            assert bundles[0] in body
+            # the bundle shows up in the incident index on a re-scrape
+            code, body, _ = _get(port, "/debugz")
+            assert "debug.manual" in body
+        finally:
+            paddle.set_flags(saved)
+            srv.shutdown()
+            with incident_mod._RECORDER._lock:
+                incident_mod._RECORDER._recent.clear()
+
     def test_unknown_path_404(self):
         srv, port = self._server()
         try:
