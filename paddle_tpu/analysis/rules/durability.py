@@ -2,7 +2,9 @@
 protocol, never bare.
 
 Everything under ``distributed/resilience/`` and ``serving/resilience/``
-exists to make crashes recoverable, which only holds if every file it
+— plus the persistent executable cache ``jit/exec_store.py``, whose
+entries outlive processes by design — exists to make crashes
+recoverable, which only holds if every file it
 produces is torn-write-safe: written to a tmp sibling, fsynced,
 atomically renamed, made visible by a COMMITTED marker
 (:mod:`paddle_tpu.utils.durability`). A bare ``open(path, "w")`` or a
@@ -32,7 +34,8 @@ from typing import Iterator, Set
 
 from ..core import Finding, Rule, SourceFile, attr_chain, register
 
-_CONFINED_PATHS = ("distributed/resilience/", "serving/resilience/")
+_CONFINED_PATHS = ("distributed/resilience/", "serving/resilience/",
+                   "jit/exec_store.py")
 
 _WRITER_HELPERS = {"fsync_write", "_fsync_write"}
 
@@ -70,9 +73,10 @@ def _open_write_mode(node: ast.Call) -> bool:
 @register
 class DurabilityRule(Rule):
     id = "durability"
-    help = ("resilience code (distributed/resilience/, serving/resilience/) "
-            "must write files via utils.durability's fsync/commit helpers, "
-            "not bare open(...,'w')/os.rename/serializer-to-path")
+    help = ("resilience code (distributed/resilience/, serving/resilience/, "
+            "jit/exec_store.py) must write files via utils.durability's "
+            "fsync/commit helpers, not bare "
+            "open(...,'w')/os.rename/serializer-to-path")
     profiles = ("src",)
 
     def check(self, sf: SourceFile) -> Iterator[Finding]:

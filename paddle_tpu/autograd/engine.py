@@ -517,6 +517,12 @@ def _make_runner(plan: _FusedPlan):
 
 def _build_fused_runner(plan: _FusedPlan):
     runner = jax.jit(_make_runner(plan))
+    # persistent exec store: identity on the lowered HLO digest, so the
+    # process-local pieces of plan.signature never reach disk
+    from ..jit import exec_store as _exec_store
+    runner = _exec_store.persistent(
+        runner, "fused_bwd", label="fused_bwd",
+        perf_key=("fused_bwd", plan.signature))
     if _perf_mod.enabled():
         # one ledger row per stable tape structure, under the same
         # signature that keys the fused cache (wrap() is a passthrough

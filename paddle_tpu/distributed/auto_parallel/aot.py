@@ -272,10 +272,33 @@ def plan_llama3_8b_v5p64(tp: int = 8, dp: int = 8,
 
     Returns compile stats: per-chip HBM bytes (argument/temp/total),
     collective schedule counts, compile wall time. `layers` shrinks depth
-    for fast tests; None = the real 32."""
+    for fast tests; None = the real 32.
+
+    The plan is pure in its arguments plus the environment fingerprint
+    (jax/jaxlib/framework versions, flags, mesh epoch), so with a
+    persistent exec store attached the whole stats dict is cached on
+    disk: a second process's plan build short-circuits here — before
+    the topology client (seconds) and the XLA compile (minutes) — and
+    is read-bound."""
     import paddle_tpu as paddle
     from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
                                    LlamaPretrainingCriterion)
+    from ...jit import exec_store as _exec_store
+
+    plan_key = ("llama3_8b_v5p64", topology, tp, dp, batch_per_dp, seq,
+                layers, zero1)
+    st = _exec_store.store()
+    if st is not None and compile_now:
+        cached = st.get_json("aot_plan", plan_key)
+        if cached is not None:
+            cached["cached"] = True
+            try:
+                from ...observability import perf as _perf_mod
+                _perf_mod.note_projection(
+                    f"llama3_8b_v5p64:tp{tp}xdp{dp}", cached["projected"])
+            except Exception:
+                pass   # /perfz join is advisory; the plan's own output stands
+            return cached
 
     cfg = LlamaConfig(
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,
@@ -368,4 +391,28 @@ def plan_llama3_8b_v5p64(tp: int = 8, dp: int = 8,
             f"llama3_8b_v5p64:tp{tp}xdp{dp}", out["projected"])
     except Exception:
         pass   # /perfz join is advisory; the plan's own output stands
+    if st is not None:
+        _persist_plan(st, plan_key, out, compiled, topology)
     return out
+
+
+def _persist_plan(st, plan_key, out, compiled, topology) -> None:
+    """Commit the plan stats dict, and best-effort the compiled SPMD
+    artifact + serialized topology description alongside it (deviceless
+    executables and some backends refuse serialization: fail open, the
+    stats dict alone already makes the second process read-bound)."""
+    st.put_json("aot_plan", plan_key, out)
+    try:
+        from jax.experimental import serialize_executable as _se
+        import pickle as _pickle
+        payload = _pickle.dumps(_se.serialize(compiled))
+    except Exception:
+        payload = None
+    if payload is not None:
+        st.put("aot_exec", plan_key, payload, topology=topology)
+    try:
+        blob = _topology_desc(topology, "tpu").serialize()
+    except Exception:
+        blob = None
+    if blob is not None:
+        st.put("topology", (topology,), bytes(blob))

@@ -646,6 +646,13 @@ class CapturedStep:
 
         snap = _HostSnapshot(d)
         jfn = jax.jit(self._wrap_body(step_fn), donate_argnums=(0, 1, 2, 3))
+        # persistent exec store: lower() still traces the body exactly
+        # once (tracebox/outbox fill during the trace), so a disk hit
+        # skips only the XLA compile; CaptureAbort propagates unchanged
+        from . import exec_store as _exec_store
+        jfn = _exec_store.persistent(
+            jfn, self._perf_kind, label="step_capture",
+            perf_key=("step_capture", key))
         perf_lower = None
         if _perf_mod.enabled():
             try:

@@ -44,8 +44,8 @@ __all__ = ["STACK_CLASSES", "capture_stacks", "classify_frames",
 # the frozen attribution vocabulary (/debugz, incident bundles and the
 # chaos tests key on these — same discipline as METRIC_NAMES)
 STACK_CLASSES = frozenset({
-    "data_wait", "jit_compile", "device_call", "collective",
-    "journal_fsync", "lock_wait", "idle", "other",
+    "data_wait", "jit_compile", "exec_cache_load", "device_call",
+    "collective", "journal_fsync", "lock_wait", "idle", "other",
 })
 
 # (class, filename substrings, function names) — a frame matches when
@@ -59,6 +59,10 @@ _FRAME_RULES: Tuple[Tuple[str, Tuple[str, ...], Tuple[str, ...]], ...] = (
      ()),
     ("data_wait", ("io/dataloader", "dataloader", "reader"),
      ("fill_ring", "next_batch", "_prefetch", "__next__", "get")),
+    # before jit_compile: a thread parked deserializing a cached
+    # executable is a cache LOAD, not a compile — warm-MTTR attribution
+    # in incident bundles depends on the distinction
+    ("exec_cache_load", ("jit/exec_store",), ()),
     ("jit_compile", ("jax/_src/interpreters", "jax/_src/pjit",
                      "jax/_src/compiler", "jax/_src/dispatch",
                      "jit/step_capture", "jit/multi_step"),

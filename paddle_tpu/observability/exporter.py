@@ -282,6 +282,26 @@ class TelemetryServer:
         engine = self._engine()
         if engine is not None:
             lines += ["", f"engine: phase={engine.phase}"]
+        try:
+            from ..jit import exec_store as _exec_store
+            cache = _exec_store.state()
+        except Exception:
+            cache = None   # statusz must render even if the store can't
+        if cache is None:
+            lines += ["", "exec cache: off"]
+        else:
+            kinds = ", ".join(f"{k}={v}"
+                              for k, v in sorted(cache["kinds"].items()))
+            lines += [
+                "", "exec cache:",
+                f"  dir: {cache['dir']}  scope: "
+                f"{cache['scope'] or '-'}  keep: {cache['keep']}",
+                f"  entries: {cache['entries']}"
+                + (f"  ({kinds})" if kinds else ""),
+                f"  hits: {cache['hits']}  misses: {cache['misses']}  "
+                f"loaded_mb: {cache['loaded_bytes'] / 2**20:.2f}  "
+                f"written: {cache['written']}",
+            ]
         tail = _flight.recorder().entries()[-20:]
         lines += ["", f"flight recorder tail ({len(tail)} of ring):"]
         for e in tail:

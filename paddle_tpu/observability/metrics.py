@@ -130,6 +130,9 @@ METRIC_NAMES = frozenset({
     # this module's ambient gauges + jax.monitoring listener
     "device.live_array_bytes", "device.live_arrays", "device.count",
     "jit.compiles", "jit.compile_seconds",
+    # jit/exec_store.py — the persistent executable cache
+    "jit.cache.hits", "jit.cache.misses", "jit.cache.load_seconds",
+    "jit.cache.bytes",
 })
 
 # default histogram bounds: geometric, 1µs .. ~67s — sized for wall-time

@@ -160,7 +160,7 @@ class _Entry:
     ledger's tick/commit under the per-entry lock."""
 
     __slots__ = (
-        "key", "kind", "label", "compile_s",
+        "key", "kind", "label", "compile_s", "cached",
         "flops", "bytes_accessed", "arg_bytes", "out_bytes", "temp_bytes",
         "cost_state", "_lower",
         "calls", "wall_s", "samples", "device_s", "_warmed",
@@ -174,6 +174,7 @@ class _Entry:
         self.kind = kind
         self.label = label
         self.compile_s = None
+        self.cached = False      # loaded from the persistent exec store
         self.flops = None
         self.bytes_accessed = None
         self.arg_bytes = None
@@ -334,6 +335,18 @@ class ExecutableLedger:
         with self._lock:
             return self._entries.get(key)
 
+    def mark_cached(self, key: Any, load_s: Optional[float] = None) -> None:
+        """Flag ``key``'s row as deserialized from the persistent exec
+        store (jit/exec_store.py) rather than compiled; ``load_s``
+        stands in for compile_seconds so /perfz totals stay meaningful.
+        No-op when the plane is off or the key was never registered."""
+        e = self.entry(key)
+        if e is None:
+            return
+        e.cached = True
+        if load_s is not None and e.compile_s is None:
+            e.compile_s = load_s
+
     def entries(self) -> List[_Entry]:
         with self._lock:
             return list(self._entries.values())
@@ -478,6 +491,7 @@ class ExecutableLedger:
                 "key": e.label, "kind": e.kind, "calls": e.calls,
                 "samples": e.samples,
                 "compile_seconds": e.compile_s,
+                "cached": e.cached,
                 "flops": e.flops, "bytes_accessed": e.bytes_accessed,
                 "hbm": {"arg_bytes": e.arg_bytes,
                         "out_bytes": e.out_bytes,

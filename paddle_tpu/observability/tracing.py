@@ -126,6 +126,8 @@ SPAN_NAMES = frozenset({
     "perf.step.other",         # span (retro): remainder (callbacks, logging)
     # this module's jax.monitoring listener
     "jit.compile",             # span (retro): one XLA backend compile
+    # jit/exec_store.py — the persistent executable cache
+    "jit.cache.load",          # span: deserialize one cached executable
 })
 
 _EVENTS_MAX = 256             # per-span event cap (rings bound everything else)

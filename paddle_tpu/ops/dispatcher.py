@@ -207,14 +207,22 @@ def _get_exec(op_name: str, attrs_key: Tuple, present_mask: Tuple[bool, ...],
         return (res,)
 
     fwd = jax.jit(fwd_flat) if use_jit else fwd_flat
+    perf_key = ("op", op_name, attrs_key, present_mask, fver)
+    if use_jit:
+        # persistent exec store (jit/exec_store.py): a no-op returning
+        # fwd unchanged unless a store is attached at build time — the
+        # cache key folds flags.version (fver), so attaching via
+        # set_flags rebuilds these executables onto the disk spine
+        from ..jit import exec_store as _exec_store
+        fwd = _exec_store.persistent(
+            fwd, "op", label=f"op:{op_name}", perf_key=perf_key)
     if use_jit and _perf_mod.enabled():
         # ledger wrap baked in at build time: the cache key folds
         # flags.version (fver), so toggling FLAGS_perf_attribution
         # rebuilds these executables with/without instrumentation and
         # the off path stays literally untouched
         fwd = _perf_mod.ledger().wrap(
-            ("op", op_name, attrs_key, present_mask, fver), "op", fwd,
-            name=f"op:{op_name}")
+            perf_key, "op", fwd, name=f"op:{op_name}")
 
     def vjp_run(diff_primals, other_primals, cts_float):
         di, oi = iter(diff_primals), iter(other_primals)
@@ -232,6 +240,10 @@ def _get_exec(op_name: str, attrs_key: Tuple, present_mask: Tuple[bool, ...],
         return vjp(tuple(cts_float))
 
     vjp_j = jax.jit(vjp_run) if use_jit else vjp_run
+    if use_jit:
+        from ..jit import exec_store as _exec_store
+        vjp_j = _exec_store.persistent(
+            vjp_j, "op_vjp", label=f"op_vjp:{op_name}")
     return fwd, vjp_j
 
 

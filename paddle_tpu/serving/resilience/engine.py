@@ -102,6 +102,7 @@ class ResilientServingEngine:
                  install_signal: bool = False,
                  elastic=None, signum: Optional[int] = None,
                  finish_hook: Optional[Callable[[Any], None]] = None,
+                 exec_store_dir: Optional[str] = None,
                  **engine_kwargs: Any):
         self.root = root
         self.journal = RequestJournal(os.path.join(root, "journal"))
@@ -130,8 +131,23 @@ class ResilientServingEngine:
         # the next rewrite-on-snapshot compaction drops them from the WAL
         self._retired: set = set()
 
+        # persistent executable cache (jit/exec_store.py), attached
+        # BEFORE recovery and before any serving step: replay
+        # re-admission and warmup() then load serialized ragged
+        # executables instead of paying cold compiles —
+        # relaunch-to-READY becomes replay-bound, and a rolling
+        # deploy's second replica records ~zero jit.compiles. Two-phase:
+        # unscoped while the weights fingerprint is still being
+        # computed (its probe ops are value-independent programs), then
+        # re-scoped to the fingerprint so executables written against
+        # different weights refuse to resolve.
+        if exec_store_dir:
+            from ...jit import exec_store as _exec_store
+            _exec_store.attach(exec_store_dir)
         state = self.journal.load()
         model_fp = _model_fingerprint(model)
+        if exec_store_dir:
+            _exec_store.attach(exec_store_dir, scope=model_fp)
         if state.config is not None:
             # replay against DIFFERENT weights would splice two models'
             # tokens into one output with no error — refuse up front,

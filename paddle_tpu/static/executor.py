@@ -201,6 +201,13 @@ class Executor:
                 return [env[n] for n in fetch_names]
 
             compiled = jax.jit(fn)
+            # persistent exec store: the entry's disk identity is the
+            # lowered HLO digest, so the process-local program serial in
+            # cache_key never poisons a cross-process hit
+            from ..jit import exec_store as _exec_store
+            compiled = _exec_store.persistent(
+                compiled, "exec", label="exec",
+                perf_key=("exec", cache_key))
             if _perf_mod.enabled():
                 # passthrough when the plane is off at compile time (the
                 # executor cache is not version-keyed, so programs built

@@ -328,6 +328,26 @@ class TestDurabilityRule:
                  ".encode()))\n")
         assert check_src(idiom, ["durability"], rel=self.REL) == []
 
+    def test_exec_store_is_confined(self):
+        # the persistent executable cache (ISSUE 19) writes entries that
+        # outlive processes: planted violations must fire there exactly
+        # like in the resilience trees
+        rel = "paddle_tpu/jit/exec_store.py"
+        planted = ("import os, pickle\n"
+                   "def put(path, payload):\n"
+                   "    with open(path + '.tmp', 'wb') as f:\n"
+                   "        pickle.dump(payload, f)\n"
+                   "    os.rename(path + '.tmp', path)\n")
+        fs = check_src(planted, ["durability"], rel=rel)
+        assert len(fs) == 3   # bare open-for-write + serializer + rename
+        idiom = ("from paddle_tpu.utils.durability import fsync_write\n"
+                 "def put(path, payload):\n"
+                 "    fsync_write(path, lambda f: f.write(payload))\n")
+        assert check_src(idiom, ["durability"], rel=rel) == []
+        # the shipped module itself must be clean under the rule
+        shipped = open(os.path.join(PKG, "jit", "exec_store.py")).read()
+        assert check_src(shipped, ["durability"], rel=rel) == []
+
     def test_reads_deletes_and_outside_paths_are_clean(self):
         src = ("import os, shutil, numpy as np\n"
                "def load(path):\n"

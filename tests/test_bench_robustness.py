@@ -226,7 +226,9 @@ class TestServingFleetMicro:
                     or d["scrape_overhead_pct"] >= 3.0
                     or d["perf_overhead_pct"] >= 3.0
                     or d["incident_overhead_pct"] >= 1.0
-                    or d["incident_disabled_probe_ns"] >= 1000.0):
+                    or d["incident_disabled_probe_ns"] >= 1000.0
+                    or d["cache_compile_ratio"] < 2.0
+                    or d["cache_warm_ready_s"] >= d["cache_cold_ready_s"]):
                 break
         assert r["metric"] == "serving_fleet_goodput"
         assert d["replicas"] == 2
@@ -266,6 +268,15 @@ class TestServingFleetMicro:
         assert d["incident_bundle_cost_ms"] > 0.0
         assert d["incident_disabled_probe_ns"] < 1000.0, d
         assert d["incident_overhead_pct"] < d["incident_gate_pct"], d
+        # ISSUE 19 gates: the warm relaunch must load every dispatcher
+        # executable from the persistent store (hard invariants), and
+        # the compile-seconds ratio is a wall-clock gate (the measured
+        # ratio is ~5x; >=2x here absorbs a busy host via the retry)
+        assert d["cache_hits"] > 0 and d["cache_entries"] > 0
+        assert d["cache_warm_compiles"] < d["cache_cold_compiles"]
+        assert d["cache_second_replica_compiles"] <= 2, d
+        assert d["cache_byte_identical"] is True
+        assert d["cache_compile_ratio"] >= 2.0, d
         kinds = {row["kind"] for row in d["perfz_top"]}
         assert "serving" in kinds and "step" in kinds, d["perfz_top"]
         assert any(row["flops"] for row in d["perfz_top"])

@@ -44,8 +44,8 @@ def no_rate_limit():
 class TestClassifyFrames:
     def test_vocabulary_is_frozen(self):
         assert STACK_CLASSES == frozenset({
-            "data_wait", "jit_compile", "device_call", "collective",
-            "journal_fsync", "lock_wait", "idle", "other"})
+            "data_wait", "jit_compile", "exec_cache_load", "device_call",
+            "collective", "journal_fsync", "lock_wait", "idle", "other"})
 
     def test_queue_get_is_data_wait_not_lock_wait(self):
         # innermost frame of a queue.get IS threading.Condition.wait:
@@ -72,6 +72,16 @@ class TestClassifyFrames:
                    "backend_compile"),
                   ("paddle_tpu/jit/step_capture.py", 100, "_capture")]
         assert classify_frames(frames) == "jit_compile"
+
+    def test_cache_deserialize_is_exec_cache_load_not_jit_compile(self):
+        # a thread parked deserializing a cached executable would also
+        # match jit_compile's jax-internals patterns further down the
+        # stack — warm-MTTR attribution needs the cache-load label
+        frames = [("site-packages/jax/_src/compiler.py", 500,
+                   "backend_compile"),
+                  ("paddle_tpu/jit/exec_store.py", 420, "_deserialize"),
+                  ("paddle_tpu/jit/step_capture.py", 100, "_capture")]
+        assert classify_frames(frames) == "exec_cache_load"
 
     def test_block_until_ready_is_device_call_any_file(self):
         frames = [("site-packages/jax/_src/array.py", 600,
