@@ -279,6 +279,17 @@ define_flag("perf_sample_every", 16,
             "Nth call of a registered executable is timed through "
             "block_until_ready when FLAGS_perf_attribution is on; 1 = "
             "time every call (bench mode), larger = lower sampling tax")
+define_flag("kv_cache_dtype", "auto",
+            "paged KV pool storage dtype for serving: 'auto' (model "
+            "compute dtype), 'bf16', or 'int8' (per-token-slot absmax "
+            "scales ride the block table; dequant happens inside the "
+            "attention tile load so HBM reads stay at int8 bytes)")
+define_flag("speculative_k", 0,
+            "speculative decoding draft length K for the continuous "
+            "batching engine: 0 disables; K>0 drafts K candidate tokens "
+            "per decode row (greedy n-gram self-draft by default) and "
+            "verifies them as one q_len=K+1 ragged row inside the "
+            "existing token budget — still one executable per budget")
 define_flag("default_dtype", "float32", "default floating-point dtype")
 define_flag("seed", 0, "global random seed")
 define_flag("rng_impl", "rbg",

@@ -46,6 +46,28 @@ class KVCache:
                        pos, attn_mask)
 
 
+def kv_pool_blocks(kv_pool_bytes: int, block_size: int, num_kv_heads: int,
+                   head_dim: int, num_layers: int, dtype="float32",
+                   kv_dtype: str = "auto") -> int:
+    """Blocks a fixed HBM byte budget buys at a storage regime — the
+    admission-capacity side of FLAGS_kv_cache_dtype: sizing a pool in
+    bytes instead of blocks lets int8 nearly double block count (and
+    with it continuous-batching occupancy and prefix-cache headroom)
+    for the same memory, scale rows included in the denominator."""
+    if kv_dtype in (None, "", "auto"):
+        kv_dtype = "auto"
+    store = {"auto": dtype, "bf16": "bfloat16",
+             "bfloat16": "bfloat16", "int8": "int8"}.get(kv_dtype)
+    if store is None:
+        raise ValueError(
+            f"unsupported kv_dtype {kv_dtype!r}: expected 'auto', "
+            f"'bf16' or 'int8' (FLAGS_kv_cache_dtype)")
+    per_tok = 2 * num_kv_heads * head_dim * jnp.dtype(store).itemsize
+    if kv_dtype == "int8":
+        per_tok += 2 * num_kv_heads * 4       # f32 scale per token slot
+    return max(1, int(kv_pool_bytes) // (per_tok * num_layers * block_size))
+
+
 class PagedKVCache:
     """Block-pool cache with per-sequence block tables (paged attention).
 
@@ -56,15 +78,40 @@ class PagedKVCache:
 
     def __init__(self, num_layers: int, batch: int, num_blocks: int,
                  block_size: int, num_kv_heads: int, head_dim: int,
-                 max_blocks_per_seq: int, dtype="float32"):
+                 max_blocks_per_seq: int, dtype="float32",
+                 kv_dtype: str = "auto"):
         self.block_size = block_size
         self.num_layers = num_layers
+        # kv_dtype: "auto" stores at the compute dtype; "bf16" halves
+        # bf16-vs-f32 bytes; "int8" quantizes on append with per-token-
+        # slot per-kv-head f32 scales [NB, BS, KV] riding the block
+        # table (FLAGS_kv_cache_dtype; dequant happens inside the
+        # attention kernels' tile loads)
+        if kv_dtype in (None, "", "auto"):
+            kv_dtype = "auto"
+        store = {"auto": dtype, "bf16": "bfloat16",
+                 "bfloat16": "bfloat16", "int8": "int8"}.get(kv_dtype)
+        if store is None:
+            raise ValueError(
+                f"unsupported kv_dtype {kv_dtype!r}: expected 'auto', "
+                f"'bf16' or 'int8' (FLAGS_kv_cache_dtype)")
+        self.kv_dtype = "int8" if kv_dtype == "int8" else str(store)
+        self.quantized = kv_dtype == "int8"
         self.k = [Tensor(jnp.zeros((num_blocks, block_size, num_kv_heads,
-                                    head_dim), dtype=dtype))
+                                    head_dim), dtype=store))
                   for _ in range(num_layers)]
         self.v = [Tensor(jnp.zeros((num_blocks, block_size, num_kv_heads,
-                                    head_dim), dtype=dtype))
+                                    head_dim), dtype=store))
                   for _ in range(num_layers)]
+        if self.quantized:
+            self.k_scale = [Tensor(jnp.zeros(
+                (num_blocks, block_size, num_kv_heads), jnp.float32))
+                for _ in range(num_layers)]
+            self.v_scale = [Tensor(jnp.zeros(
+                (num_blocks, block_size, num_kv_heads), jnp.float32))
+                for _ in range(num_layers)]
+        else:
+            self.k_scale = self.v_scale = None
         self._free = list(range(num_blocks - 1, -1, -1))
         self.block_tables = np.zeros((batch, max_blocks_per_seq), np.int32)
         self.context_lens = np.zeros((batch,), np.int32)
@@ -80,6 +127,45 @@ class PagedKVCache:
 
     def set_decode_override(self, slots: Optional[Tensor]):
         self._decode_override = slots
+
+    def write(self, layer: int, k_new: Tensor, v_new: Tensor,
+              slots: Tensor):
+        """THE pool write: every append path (prefill bulk, decode
+        override, ragged step, slot view) funnels here so the int8
+        quantize-on-append and the plain write stay one implementation."""
+        if self.quantized:
+            self.k[layer], self.k_scale[layer] = call_op(
+                "paged_cache_write_q", self.k[layer], self.k_scale[layer],
+                k_new, slots)
+            self.v[layer], self.v_scale[layer] = call_op(
+                "paged_cache_write_q", self.v[layer], self.v_scale[layer],
+                v_new, slots)
+        else:
+            self.k[layer] = call_op("paged_cache_write", self.k[layer],
+                                    k_new, slots)
+            self.v[layer] = call_op("paged_cache_write", self.v[layer],
+                                    v_new, slots)
+        return self.k[layer], self.v[layer]
+
+    def scale_kwargs(self, layer: int) -> dict:
+        """Dequant-scale kwargs for the paged/ragged attention ops
+        (empty for an unquantized pool)."""
+        if not self.quantized:
+            return {}
+        return dict(k_scale=self.k_scale[layer],
+                    v_scale=self.v_scale[layer])
+
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes one token's K+V occupies across all layers —
+        including the f32 scale bytes for the int8 pool (the honest
+        bandwidth denominator the serving.kv.bytes_per_token gauge
+        reports)."""
+        kv, d = self.k[0].shape[2], self.k[0].shape[3]
+        item = jnp.dtype(self.k[0]._data.dtype).itemsize
+        per = 2 * kv * d * item
+        if self.quantized:
+            per += 2 * kv * 4                     # [NB, BS, KV] f32 x2
+        return per * self.num_layers
 
     # -- host-side allocator -------------------------------------------------
     def _ensure_block(self, seq: int, pos: int) -> int:
@@ -144,10 +230,7 @@ class PagedKVCache:
             blk = self._ensure_block(b, int(pos))
             slots.append(blk * self.block_size + int(pos) % self.block_size)
         slot_ids = Tensor(jnp.asarray(slots, jnp.int32))
-        self.k[layer] = call_op("paged_cache_write", self.k[layer], k_new,
-                                slot_ids)
-        self.v[layer] = call_op("paged_cache_write", self.v[layer], v_new,
-                                slot_ids)
+        self.write(layer, k_new, v_new, slot_ids)
         # advance lengths at the FIRST layer's write: forward order is
         # write(i) → attend(i) → write(i+1)..., so every layer (including
         # layer 0) must already see the just-written token in its mask
@@ -162,11 +245,7 @@ class PagedKVCache:
     def update(self, layer: int, k_new: Tensor, v_new: Tensor, pos):
         b, s = k_new.shape[0], k_new.shape[1]
         if self._decode_override is not None and s == 1:
-            self.k[layer] = call_op("paged_cache_write", self.k[layer],
-                                    k_new, self._decode_override)
-            self.v[layer] = call_op("paged_cache_write", self.v[layer],
-                                    v_new, self._decode_override)
-            return self.k[layer], self.v[layer]
+            return self.write(layer, k_new, v_new, self._decode_override)
         p0 = int(np.asarray(pos._data)) if isinstance(pos, Tensor) \
             else int(pos)
         if s == 1 and self._prefill_kv:
@@ -179,10 +258,7 @@ class PagedKVCache:
                               for seq in range(b)])
             self._slots = Tensor(jnp.asarray(slots.reshape(-1), jnp.int32))
             self._slot_cache_key = (p0, s)
-        self.k[layer] = call_op("paged_cache_write", self.k[layer], k_new,
-                                self._slots)
-        self.v[layer] = call_op("paged_cache_write", self.v[layer], v_new,
-                                self._slots)
+        self.write(layer, k_new, v_new, self._slots)
         if layer == 0:
             self.context_lens[:] = np.maximum(self.context_lens, p0 + s)
         if s > 1:
@@ -198,7 +274,8 @@ class PagedKVCache:
             return call_op("paged_attention", q, self.k[layer],
                            self.v[layer],
                            Tensor(jnp.asarray(self.block_tables)),
-                           Tensor(jnp.asarray(self.context_lens)))
+                           Tensor(jnp.asarray(self.context_lens)),
+                           **self.scale_kwargs(layer))
         s = q.shape[1]
         if s > 1:
             p0 = int(np.asarray(pos._data)) if isinstance(pos, Tensor) \
@@ -218,7 +295,8 @@ class PagedKVCache:
                 "left-padded batches need the contiguous KVCache")
         return call_op("paged_attention", q, self.k[layer], self.v[layer],
                        Tensor(jnp.asarray(self.block_tables)),
-                       Tensor(jnp.asarray(self.context_lens)))
+                       Tensor(jnp.asarray(self.context_lens)),
+                       **self.scale_kwargs(layer))
 
 
 class GenerationMixin:
@@ -247,6 +325,8 @@ class GenerationMixin:
                 f"prompt+max_new_tokens={total} exceeds "
                 f"max_position_embeddings={cfg.max_position_embeddings} "
                 f"(rope table would clamp positions)")
+        from .. import flags as _flags
+        kv_dtype = _flags.get_flag("kv_cache_dtype")
         if cache_type == "paged":
             mb = -(-(max_cache_len or total) // block_size)
             cache = PagedKVCache(
@@ -255,8 +335,15 @@ class GenerationMixin:
                 num_kv_heads=cfg.num_key_value_heads,
                 head_dim=cfg.hidden_size // cfg.num_attention_heads,
                 max_blocks_per_seq=mb,
-                dtype=getattr(cfg, "dtype", "float32"))
+                dtype=getattr(cfg, "dtype", "float32"),
+                kv_dtype=kv_dtype)
         else:
+            if kv_dtype == "int8":
+                from ..ops.kernels.serving import record_fallback
+                record_fallback(
+                    "kv", "kv_int8_dense_cache",
+                    "contiguous KVCache has no quantized layout; "
+                    "cache stays at the compute dtype")
             cache = KVCache(cfg.num_hidden_layers, b,
                             max_cache_len or total,
                             cfg.num_key_value_heads,

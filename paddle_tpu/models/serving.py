@@ -59,7 +59,7 @@ from ..observability import metrics as _metrics_mod
 from ..observability import perf as _perf_mod
 from ..observability import tracing as _tracing
 from ..ops.dispatcher import call_op
-from .generation import PagedKVCache
+from .generation import PagedKVCache, kv_pool_blocks
 
 __all__ = ["Request", "ContinuousBatchingEngine", "GangScheduledEngine",
            "PrefixCache", "QueueFull"]
@@ -124,6 +124,21 @@ _M_QWAIT = _M.histogram(
     "serving.queue_wait_seconds", "request arrival -> row-slot admission")
 _M_REJECTED = _M.counter(
     "serving.rejected", "requests rejected at intake (queue full)")
+_M_KV_BPT = _M.gauge(
+    "serving.kv.bytes_per_token",
+    "HBM bytes one token's K+V occupies across all layers (int8 pool "
+    "includes its f32 scale bytes) — the decode bandwidth denominator")
+_M_KV_DEQ = _M.counter(
+    "serving.kv.dequant_blocks",
+    "pool blocks dequantized inside attention tile loads (int8 pool)")
+_M_SPEC_PROP = _M.counter(
+    "serving.spec.proposed", "draft tokens packed into verify rows")
+_M_SPEC_ACC = _M.counter(
+    "serving.spec.accepted", "draft tokens accepted by exact-match verify")
+_M_SPEC_REJ = _M.counter(
+    "serving.spec.rejected", "draft tokens rejected at verify")
+_M_SPEC_ROWS = _M.counter(
+    "serving.spec.verify_rows", "decode rows that carried draft tokens")
 
 # per-tenant children of the admission counters, cached so the hot path
 # pays one dict hit instead of the registry lock. Tenant cardinality is
@@ -270,8 +285,7 @@ class _SlotView:
             else int(pos)
         sl = Tensor(jnp.asarray(
             c.alloc_slots(slot, p0, k_new.shape[1]), jnp.int32))
-        c.k[layer] = call_op("paged_cache_write", c.k[layer], k_new, sl)
-        c.v[layer] = call_op("paged_cache_write", c.v[layer], v_new, sl)
+        c.write(layer, k_new, v_new, sl)
         self._stash[layer] = (k_new, v_new)
         return c.k[layer], c.v[layer]
 
@@ -296,18 +310,14 @@ class _RaggedView:
         self._cu = cu
 
     def update(self, layer: int, k_new: Tensor, v_new: Tensor, pos):
-        c = self._c
-        c.k[layer] = call_op("paged_cache_write", c.k[layer], k_new,
-                             self._slots)
-        c.v[layer] = call_op("paged_cache_write", c.v[layer], v_new,
-                             self._slots)
-        return c.k[layer], c.v[layer]
+        return self._c.write(layer, k_new, v_new, self._slots)
 
     def attend(self, layer: int, q: Tensor, pos=None, attn_mask=None):
         b, s, h, d = q.shape
         out = call_op("ragged_paged_attention", q.reshape([s, h, d]),
                       self._c.k[layer], self._c.v[layer],
-                      self._tables, self._lens, self._cu)
+                      self._tables, self._lens, self._cu,
+                      **self._c.scale_kwargs(layer))
         return out.reshape([b, s, h, d])
 
 
@@ -321,7 +331,8 @@ class ContinuousBatchingEngine:
     prompts are sliced into, so a long admission never stalls decode
     for more than one chunk's worth of compute."""
 
-    def __init__(self, model, max_batch: int, num_blocks: int,
+    def __init__(self, model, max_batch: int,
+                 num_blocks: Optional[int] = None,
                  block_size: int = 64,
                  max_blocks_per_seq: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
@@ -331,19 +342,52 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  enable_prefix_cache: bool = True, seed: int = 0,
                  max_queue: Optional[int] = None,
-                 on_finish=None):
+                 on_finish=None, kv_dtype: Optional[str] = None,
+                 speculative_k: Optional[int] = None,
+                 draft_proposer=None,
+                 kv_pool_bytes: Optional[int] = None):
+        from .. import flags as _flags
         cfg = model.config
         self.model = model
         self.eos = eos_token_id
         self.sampling = dict(temperature=temperature, top_k=top_k,
                              top_p=top_p)
+        if kv_dtype is None:
+            kv_dtype = _flags.get_flag("kv_cache_dtype")
+        if num_blocks is None:
+            # pool sized in BYTES: the admission math below is all in
+            # blocks, so the storage regime's capacity win (int8 buys
+            # ~2x blocks per byte) flows straight into occupancy
+            if kv_pool_bytes is None:
+                raise ValueError(
+                    "pass num_blocks or kv_pool_bytes to size the pool")
+            num_blocks = kv_pool_blocks(
+                kv_pool_bytes, block_size, cfg.num_key_value_heads,
+                cfg.hidden_size // cfg.num_attention_heads,
+                cfg.num_hidden_layers,
+                dtype=getattr(cfg, "dtype", "float32"), kv_dtype=kv_dtype)
         mb = max_blocks_per_seq or (
             -(-cfg.max_position_embeddings // block_size))
         self.cache = PagedKVCache(
             cfg.num_hidden_layers, max_batch, num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=cfg.num_key_value_heads,
             head_dim=cfg.hidden_size // cfg.num_attention_heads,
-            max_blocks_per_seq=mb, dtype=getattr(cfg, "dtype", "float32"))
+            max_blocks_per_seq=mb, dtype=getattr(cfg, "dtype", "float32"),
+            kv_dtype=kv_dtype)
+        _M_KV_BPT.set(self.cache.kv_bytes_per_token())
+        # speculative decoding: K draft tokens per decode row, verified
+        # as one q_len=K+1 ragged row out of the leftover token budget.
+        # Acceptance is EXACT-MATCH against the row's keyed sample at
+        # each stream position, so spec-on output is byte-identical to
+        # spec-off at any temperature — schedule independence and
+        # replay determinism hold with speculation on for free
+        if speculative_k is None:
+            speculative_k = int(_flags.get_flag("speculative_k"))
+        self.spec_k = max(0, int(speculative_k))
+        if self.spec_k and draft_proposer is None:
+            from .speculative import NGramProposer
+            draft_proposer = NGramProposer()
+        self.proposer = draft_proposer
         self.block_size = block_size
         self.max_batch = max_batch
         self.prefill_chunk = prefill_chunk or block_size
@@ -509,9 +553,16 @@ class ContinuousBatchingEngine:
         # reused for every COW), not an eager full-pool .at[].set
         bs = self.cache.block_size
         slots = Tensor(jnp.asarray(fresh * bs + np.arange(bs), jnp.int32))
+        pools = [self.cache.k, self.cache.v]
+        if self.cache.quantized:
+            # int8 pool: the per-token-slot scale rows move with their
+            # block (paged_cache_write is shape-generic over the
+            # trailing dims, so the [NB,BS,KV] scale pools ride the
+            # same one-block scatter executable)
+            pools += [self.cache.k_scale, self.cache.v_scale]
         for layer in range(self.cache.num_layers):
-            for pool in (self.cache.k, self.cache.v):
-                rows = Tensor(pool[layer]._data[blk][None])  # [1,BS,KV,D]
+            for pool in pools:
+                rows = Tensor(pool[layer]._data[blk][None])  # [1,BS,...]
                 pool[layer] = call_op("paged_cache_write", pool[layer],
                                       rows, slots)
         self.cache.block_tables[i, blk_idx] = fresh
@@ -730,31 +781,68 @@ class ContinuousBatchingEngine:
             if not gave:
                 break
 
+        # speculative drafts out of the LEFTOVER budget: each decode row
+        # may carry up to spec_k draft tokens, turning its q_len=1 row
+        # into a q_len=1+K' verify row (a prefill-chunk shape the step
+        # executable already compiles for). The emission cap keeps
+        # write positions inside the admission-time worst case, so the
+        # block reservation math is untouched by speculation.
+        drafts: Dict[int, np.ndarray] = {}
+        if self.spec_k and left > 0:
+            for i in decode_rows:
+                req = self.slots[i]
+                cap = min(self.spec_k,
+                          req.max_new_tokens - len(req.out_tokens) - 1,
+                          left)
+                if cap <= 0:
+                    continue
+                # proposal depends ONLY on this request's committed
+                # tokens — never batch composition — so speculative
+                # output stays schedule-independent
+                hist = np.concatenate(
+                    [req.prompt, np.asarray(req.out_tokens, np.int32)])
+                d = self.proposer.propose(hist, cap)
+                if len(d):
+                    drafts[i] = np.asarray(d, np.int32)
+                    left -= len(d)
+                if left <= 0:
+                    break
+
+        # L sample lanes per row: lane j of a verify row samples stream
+        # position len(out)+j from the logits of packed token t+j. With
+        # spec off L=1 and the arrays are exactly the legacy geometry.
+        L = self.spec_k + 1
         ids = np.zeros((B,), np.int32)
         pos = np.zeros((B,), np.int32)
         slot_vec = np.full((B,), self._trash_slot, np.int64)
         qlen = np.zeros((R,), np.int32)
         lens = np.zeros((R,), np.int32)
-        sample_idx = np.zeros((R,), np.int32)
-        stream_pos = np.zeros((R,), np.int32)
-        keys = np.zeros((R, self._key_w), np.uint32)
+        sample_idx = np.zeros((R * L,), np.int32)
+        stream_pos = np.zeros((R * L,), np.int32)
+        keys = np.zeros((R * L, self._key_w), np.uint32)
         post = []                      # (row, is_decode, n) commit plan
         t = 0
         for i in range(R):
             req = self.slots[i]
             if req is None:
                 continue
-            if req.ctx >= req.target:                       # decode row
+            if req.ctx >= req.target:           # decode / verify row
+                d = drafts.get(i)
+                n = 1 + (0 if d is None else len(d))
                 ids[t] = self.tok[i]
-                pos[t] = req.ctx
-                slot_vec[t] = self._write_slots(i, req.ctx, 1)[0]
-                qlen[i] = 1
-                lens[i] = req.ctx + 1
-                sample_idx[i] = t
-                stream_pos[i] = len(req.out_tokens)
-                keys[i] = req.key_data
-                post.append((i, True, 1))
-                t += 1
+                if n > 1:
+                    ids[t + 1:t + n] = d
+                pos[t:t + n] = np.arange(req.ctx, req.ctx + n)
+                slot_vec[t:t + n] = self._write_slots(i, req.ctx, n)
+                qlen[i] = n
+                lens[i] = req.ctx + n
+                sample_idx[i * L:(i + 1) * L] = t   # spare lanes: dup t
+                sample_idx[i * L:i * L + n] = np.arange(t, t + n)
+                stream_pos[i * L:i * L + n] = (len(req.out_tokens)
+                                               + np.arange(n))
+                keys[i * L:(i + 1) * L] = req.key_data
+                post.append((i, True, n))
+                t += n
             else:                                           # prefill chunk
                 n = grants.get(i, 0)
                 lens[i] = req.ctx + n
@@ -765,9 +853,9 @@ class ContinuousBatchingEngine:
                 slot_vec[t:t + n] = self._write_slots(i, req.ctx, n)
                 qlen[i] = n
                 if req.ctx + n == req.target and not req.out_tokens:
-                    sample_idx[i] = t + n - 1   # first token: last logits
-                    stream_pos[i] = 0
-                    keys[i] = req.key_data
+                    sample_idx[i * L] = t + n - 1  # first tok: last logits
+                    stream_pos[i * L] = 0
+                    keys[i * L] = req.key_data
                 post.append((i, False, n))
                 t += n
         cu = np.zeros((R + 1,), np.int32)
@@ -783,7 +871,8 @@ class ContinuousBatchingEngine:
         if _perf_mod.enabled():
             _led = _perf_mod.ledger()
             _pe = _led.register(
-                ("serving", self.max_batch, self.token_budget),
+                ("serving", self.max_batch, self.token_budget,
+                 self.spec_k, self.cache.kv_dtype),
                 "serving", name="serving_step")
             _p_sample = _led.tick(_pe)
         view = _RaggedView(
@@ -818,15 +907,47 @@ class ContinuousBatchingEngine:
             "serving.step", _t0_ns, _tracing.now_ns(),
             attrs={"tokens": t, "decode_rows": len(decode_rows),
                    "prefill_rows": len(prefill_rows)})
+        if self.cache.quantized:
+            # every attended block is dequantized in-tile each step:
+            # bandwidth accounting for the int8 pool (per layer, per row)
+            _M_KV_DEQ.inc(sum((int(lens[i]) + bs - 1) // bs
+                              for i, _, _ in post)
+                          * self.cache.num_layers)
         now = time.time()
         finished: List[Request] = []
         for i, is_decode, n in post:
             req = self.slots[i]
-            req.ctx += n
-            self.cache.context_lens[i] = req.ctx
             if is_decode:
-                self._append_token(req, i, int(sampled[i]), now, finished)
+                # exact-match verify: draft j is accepted iff it equals
+                # the keyed sample at its stream position — so spec-on
+                # output is byte-identical to spec-off at ANY temperature
+                # (the samples themselves are the ground truth). Accepted
+                # drafts validate the NEXT lane's logits; the first
+                # mismatch invalidates everything after it.
+                d = drafts.get(i)
+                nd = n - 1
+                base = i * L
+                a = 0
+                while a < nd and int(sampled[base + a]) == int(d[a]):
+                    a += 1
+                if nd:
+                    _M_SPEC_PROP.inc(nd)
+                    _M_SPEC_ACC.inc(a)
+                    _M_SPEC_REJ.inc(nd - a)
+                    _M_SPEC_ROWS.inc()
+                # rejected-draft KV rows (positions ctx+1+a..ctx+n-1) are
+                # garbage: context_lens hides them and the next step
+                # overwrites those slots in place
+                req.ctx += 1 + a
+                self.cache.context_lens[i] = req.ctx
+                for j in range(a + 1):
+                    self._append_token(req, i, int(sampled[base + j]),
+                                       now, finished)
+                    if req.done:
+                        break
             else:
+                req.ctx += n
+                self.cache.context_lens[i] = req.ctx
                 _M_PREFILL_TOKENS.inc(n)
                 _tracing.instant(
                     "serving.prefill_chunk", trace=_req_trace(req),
@@ -836,8 +957,8 @@ class ContinuousBatchingEngine:
                     if req.out_tokens:  # resumed: next input pre-sampled
                         self.tok[i] = req.out_tokens[-1]
                     else:
-                        self._append_token(req, i, int(sampled[i]), now,
-                                           finished)
+                        self._append_token(req, i, int(sampled[i * L]),
+                                           now, finished)
         if self.on_finish is not None:
             for req in finished:
                 self.results.pop(req.rid, None)
@@ -899,6 +1020,7 @@ class GangScheduledEngine:
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, preempt_after: Optional[int] = None):
+        from .. import flags as _flags
         cfg = model.config
         self.model = model
         self.eos = eos_token_id
@@ -910,7 +1032,14 @@ class GangScheduledEngine:
             cfg.num_hidden_layers, max_batch, num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=cfg.num_key_value_heads,
             head_dim=cfg.hidden_size // cfg.num_attention_heads,
-            max_blocks_per_seq=mb, dtype=getattr(cfg, "dtype", "float32"))
+            max_blocks_per_seq=mb, dtype=getattr(cfg, "dtype", "float32"),
+            kv_dtype=str(_flags.get_flag("kv_cache_dtype")))
+        if int(_flags.get_flag("speculative_k")) > 0:
+            # the gang engine's decode path is strictly batch-wide
+            # single-token; speculation only exists in the ragged engine
+            from ..ops.kernels.serving import record_fallback
+            record_fallback("spec", "spec_gang_engine",
+                            "gang-scheduled engine ignores speculative_k")
         self.block_size = block_size
         self.max_batch = max_batch
         # one reserved block absorbs the masked writes of inactive slots

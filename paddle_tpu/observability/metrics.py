@@ -95,6 +95,12 @@ METRIC_NAMES = frozenset({
     "serving.prefix_cache.shared_tokens", "serving.prefix_cache.evictions",
     "serving.cow_copies", "serving.ttft_seconds", "serving.tpot_seconds",
     "serving.queue_wait_seconds", "serving.rejected",
+    # int8 paged KV pool + speculative decoding (models/serving.py,
+    # ops/kernels/serving.py)
+    "serving.kv.bytes_per_token", "serving.kv.dequant_blocks",
+    "serving.kv.fallback", "serving.spec.proposed",
+    "serving.spec.accepted", "serving.spec.rejected",
+    "serving.spec.verify_rows", "serving.spec.fallback",
     # serving/resilience/ (request journal + replay, drain, warm-start)
     "serving.resilience.journal_records",
     "serving.resilience.journal_flushes",

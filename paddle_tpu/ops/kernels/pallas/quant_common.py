@@ -1,0 +1,40 @@
+"""Shared symmetric-absmax quantization helpers.
+
+One implementation for every dequant-in-kernel consumer: the weight-only
+GEMMs (`weight_only_gemm.py`, per-channel / per-group weight scales) and
+the int8 paged KV pool (`ops/kernels/serving.py`, per-token-slot scales
+riding the block table). Symmetric scheme throughout:
+
+    scale = absmax(x, axis) / bound        # bound: 127 int8, 7 int4
+    q     = clip(round(x / scale), -bound, bound)
+    x~    = q * scale
+
+`EPS` guards all-zero groups (scale 0 -> divide keeps q at 0).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INT8_BOUND = 127.0
+INT4_BOUND = 7.0
+EPS = 1e-10
+
+
+def absmax_scale(x, axis, bound: float = INT8_BOUND):
+    """f32 scale(s) along `axis` (kept-dims follow jnp.max semantics)."""
+    return (jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis)
+            / bound).astype(jnp.float32)
+
+
+def quantize_symmetric(x, scales, bound: float = INT8_BOUND):
+    """Round-to-nearest symmetric quantization; `scales` must broadcast
+    against `x` (callers expand dims to taste). Returns int8 codes —
+    int4 callers pack nibbles themselves."""
+    q = jnp.round(x.astype(jnp.float32) / jnp.maximum(scales, EPS))
+    return jnp.clip(q, -bound, bound).astype(jnp.int8)
+
+
+def dequantize_symmetric(q, scales, dtype=jnp.float32):
+    """Codes * scales (broadcast) -> `dtype`."""
+    return (q.astype(jnp.float32) * scales.astype(jnp.float32)).astype(dtype)

@@ -55,8 +55,12 @@ def supported(q_shape, pool_shape) -> bool:
     return h % kv == 0 and d == pd
 
 
-def _kernel(row_ref, qp0_ref, qc_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
-            m_scr, l_scr, acc_scr, *, bs, mb, kv, g, scale):
+def _kernel(row_ref, qp0_ref, qc_ref, tbl_ref, q_ref, k_ref, v_ref, *rest,
+            bs, mb, kv, g, scale, quantized):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     t, j = pl.program_id(0), pl.program_id(1)
     qc = qc_ref[t]
 
@@ -71,8 +75,16 @@ def _kernel(row_ref, qp0_ref, qc_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when((qc > 0) & (j * bs <= qp0_ref[t] + qc - 1))
     def _():
         q = q_ref[0].astype(jnp.float32)                       # [KV, TG, D]
-        k = jnp.swapaxes(k_ref[0].astype(jnp.float32), 0, 1)   # [KV, BS, D]
-        v = jnp.swapaxes(v_ref[0].astype(jnp.float32), 0, 1)
+        kf = k_ref[0].astype(jnp.float32)                      # [BS, KV, D]
+        vf = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # int8 pool: dequant at the VMEM tile — the block arrived
+            # from HBM at int8 bytes; one [BS, KV] scale tile rode the
+            # same block-table index (weight_only_gemm playbook)
+            kf = kf * ks_ref[0][..., None]
+            vf = vf * vs_ref[0][..., None]
+        k = jnp.swapaxes(kf, 0, 1)                             # [KV, BS, D]
+        v = jnp.swapaxes(vf, 0, 1)
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale        # [KV, TG, BS]
@@ -101,11 +113,18 @@ def _kernel(row_ref, qp0_ref, qc_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
-                           cu_q_lens, scale=None):
+                           cu_q_lens, scale=None, k_scale=None,
+                           v_scale=None):
     """q [T, H, D] packed over rows; pools [NB, BS, KV, D];
     block_tables [R, MB] int32; context_lens [R] visible tokens per row
     AFTER this step's write; cu_q_lens [R+1] ragged row segmentation of
-    the packed token axis. Returns [T, H, D]."""
+    the packed token axis. Returns [T, H, D].
+
+    k_scale/v_scale [NB, BS, KV] f32 (int8 pool): per-token-slot
+    per-kv-head dequant scales riding the block table — each kv block's
+    scale tile is DMA'd by the same index map as the block itself and
+    the dequant happens inside the VMEM tile load, so HBM reads stay at
+    int8 bytes."""
     T, H, D = q.shape
     NB, BS, KV, _ = k_pool.shape
     R, MB = block_tables.shape
@@ -140,32 +159,41 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
                .transpose(0, 2, 1, 3, 4)
                .reshape(NT, KV, TG, D))
 
+    quantized = k_scale is not None
+    block_spec = pl.BlockSpec((1, BS, KV, D),
+                              lambda t, j, row, qp0, qc, tbl:
+                              (tbl[row[t], j], 0, 0, 0))
+    scale_spec = pl.BlockSpec((1, BS, KV),
+                              lambda t, j, row, qp0, qc, tbl:
+                              (tbl[row[t], j], 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, KV, TG, D), lambda t, j, *_: (t, 0, 0, 0)),
+        block_spec, block_spec,
+    ]
+    operands = [q_tiles, k_pool, v_pool]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(NT, MB),
-        in_specs=[
-            pl.BlockSpec((1, KV, TG, D), lambda t, j, *_: (t, 0, 0, 0)),
-            pl.BlockSpec((1, BS, KV, D),
-                         lambda t, j, row, qp0, qc, tbl:
-                         (tbl[row[t], j], 0, 0, 0)),
-            pl.BlockSpec((1, BS, KV, D),
-                         lambda t, j, row, qp0, qc, tbl:
-                         (tbl[row[t], j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, KV, TG, D), lambda t, j, *_: (t, 0, 0, 0)),
         scratch_shapes=[pltpu.VMEM((KV, TG, 1), jnp.float32),
                         pltpu.VMEM((KV, TG, 1), jnp.float32),
                         pltpu.VMEM((KV, TG, D), jnp.float32)],
     )
+    out_dtype = q.dtype
     out = pl.pallas_call(
         functools.partial(_kernel, bs=BS, mb=MB, kv=KV, g=G,
-                          scale=float(scale)),
+                          scale=float(scale), quantized=quantized),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((NT, KV, TG, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((NT, KV, TG, D), out_dtype),
         interpret=_interpret(),
     )(row_of, qpos0, qcount,
       jnp.clip(block_tables.astype(jnp.int32), 0, NB - 1),
-      q_tiles, k_pool, v_pool)
+      *operands)
 
     # unpack tiles back to the packed token axis; tokens past cu[R]
     # (step padding) read the appended zero row

@@ -319,7 +319,8 @@ def sharded_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
 
 def sharded_ragged_paged_attention(q, k_pool, v_pool, block_tables,
                                    context_lens, cu_q_lens, mesh,
-                                   head_axis, batch_axis=None, scale=None):
+                                   head_axis, batch_axis=None, scale=None,
+                                   k_scale=None, v_scale=None):
     """Ragged mixed prefill+decode serving attention with q heads AND
     the pool's kv heads sharded over `head_axis`. The packed token axis
     is ragged (cu_q_lens segments it), so rows CANNOT co-shard over a
@@ -348,23 +349,38 @@ def sharded_ragged_paged_attention(q, k_pool, v_pool, block_tables,
             f"ragged; running head-sharded with rows replicated")
     if scale is None:
         scale = D ** -0.5
+    quantized = k_scale is not None
 
     def build():
         qspec = P(None, head_axis, None)
         pspec = P(None, None, head_axis, None)
+        # int8 pool scales [NB, BS, KV]: kv heads shard with the pool
+        sspec = P(None, None, head_axis)
         rep2, rep1 = P(None, None), P(None)
 
-        def local(q_, kp, vp, tbl, lens, cu):
-            return rpa.ragged_paged_attention(q_, kp, vp, tbl, lens, cu,
-                                              scale=scale)
+        if quantized:
+            def local(q_, kp, vp, tbl, lens, cu, ks, vs):
+                return rpa.ragged_paged_attention(
+                    q_, kp, vp, tbl, lens, cu, scale=scale,
+                    k_scale=ks, v_scale=vs)
+            in_specs = (qspec, pspec, pspec, rep2, rep1, rep1,
+                        sspec, sspec)
+        else:
+            def local(q_, kp, vp, tbl, lens, cu):
+                return rpa.ragged_paged_attention(q_, kp, vp, tbl, lens,
+                                                  cu, scale=scale)
+            in_specs = (qspec, pspec, pspec, rep2, rep1, rep1)
 
         return jax.jit(shard_map(
-            local, mesh=mesh,
-            in_specs=(qspec, pspec, pspec, rep2, rep1, rep1),
+            local, mesh=mesh, in_specs=in_specs,
             out_specs=qspec, axis_names=frozenset({head_axis}),
             check_vma=False))
 
-    fn = _cached(("ragged", mesh, head_axis, float(scale)), build)
+    fn = _cached(("ragged", mesh, head_axis, float(scale), quantized),
+                 build)
     _M_SHARDED.inc()
-    return fn(q, k_pool, v_pool, block_tables.astype(jnp.int32),
-              context_lens.astype(jnp.int32), cu_q_lens.astype(jnp.int32))
+    args = (q, k_pool, v_pool, block_tables.astype(jnp.int32),
+            context_lens.astype(jnp.int32), cu_q_lens.astype(jnp.int32))
+    if quantized:
+        args += (k_scale.astype(jnp.float32), v_scale.astype(jnp.float32))
+    return fn(*args)

@@ -40,6 +40,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .quant_common import (INT4_BOUND, INT8_BOUND, absmax_scale,
+                           dequantize_symmetric, quantize_symmetric)
+
 
 def _nibbles(qweight):
     """[k//2, n] packed bytes -> (lo, hi) int32 nibble planes, both
@@ -61,14 +64,14 @@ def dequantize(qweight, scales, int4: bool, n: int):
     """Quantized weight -> f32 [k, n]; group size derives from scales' row
     count (scales [n] -> per-channel, [k//gs, n] -> per-group)."""
     w = _unpack_int4(qweight, n) if int4 else qweight
-    w = w.astype(jnp.float32)
     k = w.shape[0]
     sc = scales.astype(jnp.float32)
     if sc.ndim == 1 or sc.shape[0] == 1:
-        return w * sc.reshape(1, n)
+        return dequantize_symmetric(w, sc.reshape(1, n))
     groups = sc.shape[0]
     gs = k // groups
-    return (w.reshape(groups, gs, n) * sc[:, None, :]).reshape(k, n)
+    return dequantize_symmetric(
+        w.reshape(groups, gs, n), sc[:, None, :]).reshape(k, n)
 
 
 def _int4_gemm_kernel(xe_ref, xo_ref, q_ref, o_ref, acc_ref, *, nk):
@@ -188,18 +191,16 @@ def quantize(w, weight_dtype: str = "int8", group_size: int = -1):
         raise ValueError(
             f"weight_only_int4 packs two rows per byte and requires an even "
             f"k (got k={k}); pad the weight's in_features to a multiple of 2")
-    bound = 7.0 if int4 else 127.0
+    bound = INT4_BOUND if int4 else INT8_BOUND
     wf = w.astype(jnp.float32)
     if group_size > 0:
         groups = k // group_size
         wg = wf.reshape(groups, group_size, n)
-        scales = jnp.max(jnp.abs(wg), axis=1) / bound        # [groups, n]
-        q = jnp.round(wg / jnp.maximum(scales[:, None, :], 1e-10))
-        q = q.reshape(k, n)
+        scales = absmax_scale(wg, axis=1, bound=bound)        # [groups, n]
+        q = quantize_symmetric(wg, scales[:, None, :], bound).reshape(k, n)
     else:
-        scales = jnp.max(jnp.abs(wf), axis=0) / bound        # [n]
-        q = jnp.round(wf / jnp.maximum(scales[None, :], 1e-10))
-    q = jnp.clip(q, -bound, bound).astype(jnp.int8)
+        scales = absmax_scale(wf, axis=0, bound=bound)        # [n]
+        q = quantize_symmetric(wf, scales[None, :], bound)
     if int4:
         lo = q[0::2] & 0xF
         hi = q[1::2] & 0xF

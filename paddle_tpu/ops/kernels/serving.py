@@ -18,6 +18,50 @@ import jax.numpy as jnp
 
 from ..dispatcher import register_kernel
 from .nn import scaled_dot_product_attention
+from .pallas.quant_common import (INT8_BOUND, absmax_scale,
+                                  quantize_symmetric)
+from ...observability import flight_recorder as _flight_mod
+from ...observability import metrics as _metrics_mod
+
+# Frozen fallback-reason taxonomies for the quantized-KV and speculative
+# serving prongs (same discipline as tp_attention.TP_FALLBACK_REASONS:
+# graftcheck's taxonomy rule checks literal call sites statically, the
+# runtime membership check below covers computed keys).
+KV_QUANT_FALLBACK_REASONS = frozenset({
+    "kv_int8_gang_pallas",   # pallas gang-decode kernel has no dequant
+                             # tile path; quantized pool takes the XLA
+                             # gather composite
+    "kv_int8_dense_cache",   # dense KVCache has no quantized layout;
+                             # cache stays at the compute dtype
+})
+SPEC_FALLBACK_REASONS = frozenset({
+    "spec_gang_engine",      # gang engine packs no verify rows;
+                             # FLAGS_speculative_k ignored there
+})
+
+_M_KV_FALLBACK = _metrics_mod.registry().counter(
+    "serving.kv.fallback",
+    "quantized-KV dispatches that left the dequant fast path "
+    "(frozen KV_QUANT_FALLBACK_REASONS)")
+_M_SPEC_FALLBACK = _metrics_mod.registry().counter(
+    "serving.spec.fallback",
+    "speculative-decode requests that fell back to plain decode "
+    "(frozen SPEC_FALLBACK_REASONS)")
+
+
+def record_fallback(kind: str, key: str, reason: str) -> None:
+    """Count + flight-record a serving quant/spec fallback. `key` is the
+    frozen taxonomy member; `reason` carries the parameterized detail."""
+    if key not in KV_QUANT_FALLBACK_REASONS | SPEC_FALLBACK_REASONS:
+        raise ValueError(
+            f"unregistered serving fallback reason {key!r} — add it to "
+            f"KV_QUANT_FALLBACK_REASONS / SPEC_FALLBACK_REASONS (frozen "
+            f"so counters cannot fork)")
+    (_M_SPEC_FALLBACK if key in SPEC_FALLBACK_REASONS
+     else _M_KV_FALLBACK).inc()
+    if _flight_mod.enabled():
+        _flight_mod.recorder().record(
+            f"serving.fallback[{kind}]", (reason,), key)
 
 
 @register_kernel("cache_write")
@@ -69,9 +113,34 @@ def paged_cache_write_kernel(pool, new, slot_ids):
     return flat.reshape(pool.shape)
 
 
+@register_kernel("paged_cache_write_q")
+def paged_cache_write_q_kernel(pool, scale_pool, new, slot_ids):
+    """Quantize-on-append paged write: pool[NB,BS,KV,D] int8;
+    scale_pool[NB,BS,KV] f32; new[B,S,KV,D] (compute dtype);
+    slot_ids[B*S] flat token slots → (pool, scale_pool) updated.
+
+    Each token's scale is the absmax of ITS OWN [D] vector per kv head
+    (per-token-slot granularity, K and V pools scaled separately by the
+    caller). A coarser one-scale-per-block scheme would requantize
+    already-written tokens whenever a later append grew the block's
+    absmax — making pool contents depend on the chunking schedule and
+    breaking the engine's byte-identical-replay contract. Per-token
+    scales keep quantization a pure function of the token's values, so
+    every schedule writes bit-identical pool bytes."""
+    nb, bs = pool.shape[0], pool.shape[1]
+    ids = slot_ids.reshape(-1).astype(jnp.int32)
+    flat_new = new.reshape(-1, *new.shape[2:]).astype(jnp.float32)
+    scales = absmax_scale(flat_new, axis=-1)           # [B*S, KV]
+    q = quantize_symmetric(flat_new, scales[..., None], INT8_BOUND)
+    flat = pool.reshape(nb * bs, *pool.shape[2:]).at[ids].set(q)
+    sflat = scale_pool.reshape(nb * bs, *scale_pool.shape[2:]) \
+        .at[ids].set(scales)
+    return flat.reshape(pool.shape), sflat.reshape(scale_pool.shape)
+
+
 @register_kernel("paged_attention")
 def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
-                           scale=None):
+                           k_scale=None, v_scale=None, scale=None):
     """Decode attention over paged KV (block_multi_head_attention analog).
 
     q[B,1,H,D]; pools [NB,BS,KV,D]; block_tables[B,MB] int32 (block ids per
@@ -85,9 +154,16 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
     their reason in the flight recorder).
     """
     from ... import flags
+    quantized = k_scale is not None
     decode_ok = (q.shape[1] == 1 and q.shape[3] == k_pool.shape[3]
                  and q.shape[2] % k_pool.shape[2] == 0)
-    if decode_ok:
+    if decode_ok and quantized and flags.get_flag("use_pallas_kernels"):
+        # the gang-decode Pallas kernel has no dequant tile path (the
+        # ragged kernel is the quantized fast path); composite below
+        record_fallback("paged", "kv_int8_gang_pallas",
+                        "pallas gang decode has no int8 dequant tile; "
+                        "quantized pool takes the XLA gather composite")
+    if decode_ok and not quantized:
         from .pallas import tp_attention as tpa
         ctx = tpa.current_tp_context()
         if ctx is not None:
@@ -111,6 +187,9 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
     tbl = block_tables.astype(jnp.int32)
     k = k_pool[tbl]                    # [B, MB, BS, KV, D]
     v = v_pool[tbl]
+    if quantized:
+        k = k.astype(jnp.float32) * k_scale[tbl][..., None]
+        v = v.astype(jnp.float32) * v_scale[tbl][..., None]
     k = k.reshape(B, mb * bs, *k.shape[3:])
     v = v.reshape(B, mb * bs, *v.shape[3:])
     mask = (jnp.arange(mb * bs, dtype=jnp.int32)[None, None, None, :]
@@ -119,7 +198,7 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, context_lens,
 
 
 def _ragged_composite(q, k_pool, v_pool, block_tables, context_lens,
-                      cu_q_lens, scale=None):
+                      cu_q_lens, scale=None, k_scale=None, v_scale=None):
     """XLA composite for ragged mixed prefill+decode attention: per-token
     expansion of the dense paged gather. Every packed token gathers its
     row's blocks and attends as a batch-1 decode row whose visible
@@ -140,8 +219,13 @@ def _ragged_composite(q, k_pool, v_pool, block_tables, context_lens,
     # discarded) rows still see one finite score instead of all -inf
     qpos = jnp.clip(qpos, 0, None)
     tbl = jnp.clip(block_tables.astype(jnp.int32), 0, nb - 1)[row]
-    k = k_pool[tbl].reshape(T, mb * bs, *k_pool.shape[2:])
-    v = v_pool[tbl].reshape(T, mb * bs, *v_pool.shape[2:])
+    k = k_pool[tbl]                    # [T, MB, BS, KV, D]
+    v = v_pool[tbl]
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[tbl][..., None]
+        v = v.astype(jnp.float32) * v_scale[tbl][..., None]
+    k = k.reshape(T, mb * bs, *k.shape[3:])
+    v = v.reshape(T, mb * bs, *v.shape[3:])
     mask = (jnp.arange(mb * bs, dtype=jnp.int32)[None, None, None, :]
             <= qpos[:, None, None, None])
     out = scaled_dot_product_attention(q[:, None], k, v, attn_mask=mask,
@@ -151,7 +235,8 @@ def _ragged_composite(q, k_pool, v_pool, block_tables, context_lens,
 
 @register_kernel("ragged_paged_attention")
 def ragged_paged_attention_kernel(q, k_pool, v_pool, block_tables,
-                                  context_lens, cu_q_lens, scale=None):
+                                  context_lens, cu_q_lens, k_scale=None,
+                                  v_scale=None, scale=None):
     """ONE kernel for a ragged mix of prefill chunks and decode rows
     over the paged KV pool (Ragged Paged Attention, arXiv:2604.15464).
 
@@ -177,15 +262,17 @@ def ragged_paged_attention_kernel(q, k_pool, v_pool, block_tables,
                 mesh, head_axis, batch_axis = ctx
                 out = tpa.sharded_ragged_paged_attention(
                     q, k_pool, v_pool, block_tables, context_lens,
-                    cu_q_lens, mesh, head_axis, batch_axis, scale)
+                    cu_q_lens, mesh, head_axis, batch_axis, scale,
+                    k_scale=k_scale, v_scale=v_scale)
                 if out is not None:
                     return out
         elif flags.get_flag("use_pallas_kernels"):
             return rpa.ragged_paged_attention(
                 q, k_pool, v_pool, block_tables, context_lens, cu_q_lens,
-                scale)
+                scale, k_scale=k_scale, v_scale=v_scale)
     return _ragged_composite(q, k_pool, v_pool, block_tables, context_lens,
-                             cu_q_lens, scale)
+                             cu_q_lens, scale, k_scale=k_scale,
+                             v_scale=v_scale)
 
 
 def _filter_logits(logits, temperature, top_k, top_p):

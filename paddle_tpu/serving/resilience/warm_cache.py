@@ -105,6 +105,13 @@ def _meta(engine) -> dict:
         "kv_heads": int(pool.shape[2]),
         "head_dim": int(pool.shape[3]),
         "dtype": str(pool.dtype),
+        # storage regime, not just element type: an int8 snapshot is
+        # meaningless without its scales and a float snapshot has none,
+        # so EITHER direction of mismatch (old snapshot + quantized
+        # engine, quantized snapshot + float engine) must refuse — the
+        # any-differing-key check below covers both, including meta
+        # written before this key existed (None != "int8")
+        "kv_dtype": str(c.kv_dtype),
         "model_fingerprint": fp,
     }
 
@@ -159,6 +166,15 @@ def snapshot_prefix_cache(engine, root: str, gen: int,
             else:
                 dtype_name = host.dtype.name
             payload[f"{tag}_{layer}"] = host
+    if engine.cache.quantized:
+        # int8 blocks are unusable without their per-token-slot scales:
+        # the scale rows ride the snapshot under ks_/vs_ keys and replay
+        # through the same paged_cache_write path on preload
+        for layer in range(engine.cache.num_layers):
+            for tag, pool in (("ks", engine.cache.k_scale),
+                              ("vs", engine.cache.v_scale)):
+                payload[f"{tag}_{layer}"] = np.asarray(
+                    jax.device_get(pool[layer]._data[block_ids]))
     meta = _meta(engine)
     meta["payload_dtype"] = dtype_name
     meta["digests"] = digests
@@ -262,6 +278,17 @@ def load_prefix_cache(engine, root: str) -> int:
                     1, n * bs, host.shape[2], host.shape[3])))
                 pool[layer] = call_op("paged_cache_write", pool[layer],
                                       rows, slots)
+        if engine.cache.quantized:
+            # kv_dtype matched above, so the snapshot carries ks_/vs_
+            # scale rows: same one-scatter write, [BS, KV] trailing dims
+            for layer in range(engine.cache.num_layers):
+                for tag, pool in (("ks", engine.cache.k_scale),
+                                  ("vs", engine.cache.v_scale)):
+                    host = z[f"{tag}_{layer}"][:n]
+                    rows = Tensor(jax.numpy.asarray(host.reshape(
+                        1, n * bs, host.shape[2])))
+                    pool[layer] = call_op("paged_cache_write", pool[layer],
+                                          rows, slots)
     preloaded = 0
     for digest, block in zip(digests[:n], blocks):
         if engine._pc.register(digest, block):

@@ -620,6 +620,55 @@ class TestTaxonomyRule:
         with pytest.raises(ValueError, match="INCIDENT_KINDS"):
             incident.IncidentRecorder().record("no.such.kind")
 
+    def test_serving_quant_spec_taxonomies_exist_in_package(self):
+        # the int8-KV / speculative-decode fallback reasons and their
+        # serving metrics are frozen taxonomy, same as the TP reasons
+        from paddle_tpu.observability.metrics import METRIC_NAMES
+        from paddle_tpu.ops.kernels.serving import (
+            KV_QUANT_FALLBACK_REASONS, SPEC_FALLBACK_REASONS)
+        assert "kv_int8_gang_pallas" in KV_QUANT_FALLBACK_REASONS
+        assert "kv_int8_dense_cache" in KV_QUANT_FALLBACK_REASONS
+        assert "spec_gang_engine" in SPEC_FALLBACK_REASONS
+        for name in ("serving.kv.bytes_per_token",
+                     "serving.kv.dequant_blocks", "serving.kv.fallback",
+                     "serving.spec.proposed", "serving.spec.accepted",
+                     "serving.spec.rejected", "serving.spec.verify_rows",
+                     "serving.spec.fallback"):
+            assert name in METRIC_NAMES, name
+
+    def test_planted_kv_quant_reason_typo_fires(self):
+        reasons = ('KV_QUANT_FALLBACK_REASONS = '
+                   'frozenset({"kv_int8_gang_pallas"})\n')
+        fs = check_src(
+            'def f():\n'
+            '    record_fallback("paged", "kv_int8_gang_palas", "d")\n',
+            ["taxonomy"], extra_files=[("s.py", reasons)])
+        assert len(fs) == 1 and "taxonomy fork" in fs[0].message
+
+    def test_planted_spec_reason_fstring_fires(self):
+        reasons = ('SPEC_FALLBACK_REASONS = '
+                   'frozenset({"spec_gang_engine"})\n')
+        fs = check_src(
+            'def f(e):\n'
+            '    record_fallback("spec", f"spec_{e}", "d")\n',
+            ["taxonomy"], extra_files=[("s.py", reasons)])
+        assert len(fs) == 1 and "f-string" in fs[0].message
+
+    def test_planted_spec_metric_typo_fires(self):
+        fs = check_src(
+            'import m\n'
+            'c = m.registry().counter("serving.spec.acccepted")\n',
+            ["taxonomy"],
+            extra_files=[("metrics.py",
+                          'METRIC_NAMES = frozenset({'
+                          '"serving.spec.accepted"})\n')])
+        assert len(fs) == 1 and "METRIC_NAMES" in fs[0].message
+
+    def test_runtime_validation_rejects_unknown_serving_fallback(self):
+        from paddle_tpu.ops.kernels import serving as ksrv
+        with pytest.raises(ValueError, match="unregistered"):
+            ksrv.record_fallback("kv", "no_such_key", "detail")
+
 
 # ---------------------------------------------------------------------------
 # spans

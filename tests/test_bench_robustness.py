@@ -184,6 +184,40 @@ class TestServingRaggedMicro:
         assert r["vs_baseline"] > 1.2, r
 
 
+class TestServingRegimesMicro:
+    def test_matrix_runs_and_meets_gates(self):
+        """bench.py serving_regimes smoke (ISSUE 20 acceptance): the
+        kv_dtype x spec matrix on a decode-heavy stream. The bench
+        itself asserts byte-identical spec-on/spec-off outputs and the
+        deterministic capacity facts (bytes/token ratio, blocks per
+        byte budget); this smoke re-pins those from the artifact and
+        drives the >=1.3x spec-on wall-clock gate with retries to
+        absorb a busy host."""
+        import gc
+        for _attempt in range(5):
+            gc.collect()                       # see TestServingFleetMicro
+            r = bench.bench_serving_regimes(False, quick=True)
+            d = r["detail"]
+            if (d["spec_speedup_bf16"] >= 1.3
+                    and d["spec_speedup_int8"] >= 1.3):
+                break
+        assert r["metric"] == "serving_spec_decode_speedup"
+        assert r["unit"] == "ratio"
+        # int8 pool halves the decode bandwidth denominator (gauge)
+        assert d["kv_bytes_ratio"] <= 0.55, d
+        assert (d["kv_bytes_per_token_int8"]
+                < d["kv_bytes_per_token_bf16"])
+        blocks = d["pool_blocks_per_64mb"]
+        assert blocks["int8"] >= 1.8 * blocks["bf16"], blocks
+        # spec-on finishes in fewer steps at both dtypes — a schedule
+        # fact, independent of host load
+        assert d["steps_bf16_spec6"] < d["steps_bf16_spec0"], d
+        assert d["steps_int8_spec6"] < d["steps_int8_spec0"], d
+        # the decode-heavy wall-clock gate, retried above
+        assert d["spec_speedup_bf16"] >= 1.3, r
+        assert d["spec_speedup_int8"] >= 1.3, r
+
+
 class TestServingRecoveryMicro:
     def test_micro_runs_and_warm_beats_cold(self):
         """bench.py serving_recovery smoke (ISSUE 9 acceptance): the
@@ -216,16 +250,22 @@ class TestServingFleetMicro:
         artifact — base-rate goodput, overload sheds with a retry-after
         hint, a rolling drain, zero dropped requests, and every
         delivered stream byte-identical to the single-engine reference.
-        Goodput and the tracing tax are wall-clock gates: one retry
-        absorbs a busy host."""
-        for _attempt in range(3):                         # timing gates
+        Goodput and the tracing tax are wall-clock gates: retries
+        absorb a busy host."""
+        import gc
+        for _attempt in range(5):                         # timing gates
+            # deep into a serial full-suite run the heap holds millions of
+            # live objects and a cyclic-GC pass landing inside one side of
+            # a paired on/off round skews the overhead subtraction; start
+            # each attempt collected (same hygiene as the dispatch gate)
+            gc.collect()
             r = bench.bench_serving_fleet(False, quick=True)
             d = r["detail"]
             if not (r["value"] < 1.0 or d["overload_sheds"] == 0
                     or d["tracing_overhead_pct"] >= 3.0
                     or d["scrape_overhead_pct"] >= 3.0
                     or d["perf_overhead_pct"] >= 3.0
-                    or d["incident_overhead_pct"] >= 1.0
+                    or d["incident_overhead_pct"] >= d["incident_gate_pct"]
                     or d["incident_disabled_probe_ns"] >= 1000.0
                     or d["cache_compile_ratio"] < 2.0
                     or d["cache_warm_ready_s"] >= d["cache_cold_ready_s"]):

@@ -392,3 +392,173 @@ class TestScheduleIndependentSampling:
         assert res1[a1] != res1[b1], (
             "identical prompts must draw from DISTINCT per-request "
             "streams (rid folded into the key)")
+
+
+class TestQuantizedKV:
+    def test_int8_identical_across_schedules_and_budgets(self, model):
+        """Per-token-slot quantization is a pure function of each
+        token's own K/V values, so the int8 pool must be byte-identical
+        across schedules and budgets exactly like float — per-BLOCK
+        absmax would requantize schedule-dependently and break this."""
+        rng = np.random.RandomState(12)
+        prompts = [rng.randint(0, 128, n).tolist() for n in (5, 21, 9)]
+        outs = []
+        for kw in (dict(max_batch=3, token_budget=24, prefill_chunk=16),
+                   dict(max_batch=2, token_budget=8, prefill_chunk=4)):
+            eng = ContinuousBatchingEngine(
+                model, num_blocks=32, block_size=16, temperature=1.0,
+                seed=123, kv_dtype="int8", **kw)
+            rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+            res = eng.run()
+            outs.append([res[r] for r in rids])
+        assert outs[0] == outs[1], (
+            "int8 KV output depended on the batching schedule")
+
+    def test_int8_survives_preemption(self, model):
+        rng = np.random.RandomState(13)
+        pa, pb = (rng.randint(0, 128, 3).tolist() for _ in range(2))
+        ref = ContinuousBatchingEngine(
+            model, max_batch=2, num_blocks=32, block_size=16,
+            temperature=1.0, seed=7, kv_dtype="int8")
+        r1, r2 = (ref.add_request(p, max_new_tokens=14) for p in (pa, pb))
+        want = ref.run()
+        tight = ContinuousBatchingEngine(
+            model, max_batch=2, num_blocks=4, block_size=16,
+            temperature=1.0, seed=7, preempt_after=4, kv_dtype="int8")
+        t1, t2 = (tight.add_request(p, max_new_tokens=14) for p in (pa, pb))
+        got = tight.run()
+        assert tight.preempt_count >= 1, "pool pressure should preempt"
+        assert got[t1] == want[r1] and got[t2] == want[r2]
+
+    def test_int8_quality_band_vs_float(self, model):
+        """The tolerance band for the quantized pool: int8 KV shifts
+        logits slightly, so greedy outputs may diverge at near-ties —
+        but on this model at least 75% of generated tokens must match
+        the float run (empirically ~95%+; a real regression such as
+        missing scales collapses this to near-chance)."""
+        rng = np.random.RandomState(14)
+        prompts = [rng.randint(0, 128, n).tolist() for n in (9, 17, 5, 23)]
+        res = {}
+        for kd in ("auto", "int8"):
+            eng = ContinuousBatchingEngine(
+                model, max_batch=4, num_blocks=64, block_size=16,
+                temperature=0.0, kv_dtype=kd)
+            rids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+            out = eng.run()
+            res[kd] = [out[r] for r in rids]
+        match = sum(a == b
+                    for fa, f8 in zip(res["auto"], res["int8"])
+                    for a, b in zip(fa, f8))
+        total = sum(len(f) for f in res["auto"])
+        assert match / total >= 0.75, (
+            f"int8 KV quality collapsed: {match}/{total} tokens match")
+
+    def test_byte_budget_buys_more_int8_blocks(self, model):
+        """Admission capacity is the point of the int8 pool: the same
+        HBM byte budget must buy ~2x blocks (scales included) when the
+        pool is sized in bytes, and the engine's block-based admission
+        math picks that up untouched."""
+        from paddle_tpu.models.generation import kv_pool_blocks
+        # at a realistic head_dim the bf16->int8 ratio approaches 2x
+        bf16 = kv_pool_blocks(1 << 24, 16, 8, 128, 2, kv_dtype="bf16")
+        q8 = kv_pool_blocks(1 << 24, 16, 8, 128, 2, kv_dtype="int8")
+        assert q8 >= 1.9 * bf16
+        eng_f = ContinuousBatchingEngine(
+            model, max_batch=2, kv_pool_bytes=1 << 20, block_size=16)
+        eng_q = ContinuousBatchingEngine(
+            model, max_batch=2, kv_pool_bytes=1 << 20, block_size=16,
+            kv_dtype="int8")
+        assert eng_q._total_blocks >= 2 * eng_f._total_blocks  # f32 pool
+
+
+class TestSpeculativeDecode:
+    def test_spec_greedy_equals_spec_off_exactly(self, model):
+        """Exact-match verification: accepted drafts ARE the tokens the
+        keyed sampler would have emitted, so spec-on greedy output is
+        byte-identical to spec-off (and to static generate)."""
+        rng = np.random.RandomState(15)
+        prompts = [rng.randint(0, 128, n).tolist() for n in (5, 9, 7)]
+        outs = {}
+        for k in (0, 4):
+            eng = ContinuousBatchingEngine(
+                model, max_batch=4, num_blocks=64, block_size=16,
+                temperature=0.0, speculative_k=k)
+            rids = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+            res = eng.run()
+            outs[k] = [res[r] for r in rids]
+        assert outs[0] == outs[4]
+        for p, got in zip(prompts, outs[4]):
+            assert got == _greedy_reference(model, p, 8)
+
+    def test_spec_stochastic_identical_across_schedules(self, model):
+        """temperature>0 with speculation ON: acceptance rides the
+        per-request threefry streams, so outputs stay byte-identical
+        across schedules AND equal to the spec-off run."""
+        rng = np.random.RandomState(16)
+        prompts = [rng.randint(0, 128, n).tolist() for n in (5, 21, 9)]
+        outs = []
+        for kw in (dict(max_batch=3, token_budget=24, prefill_chunk=16,
+                        speculative_k=0),
+                   dict(max_batch=3, token_budget=24, prefill_chunk=16,
+                        speculative_k=4),
+                   dict(max_batch=2, token_budget=8, prefill_chunk=4,
+                        speculative_k=4)):
+            eng = ContinuousBatchingEngine(
+                model, num_blocks=32, block_size=16, temperature=1.0,
+                seed=123, **kw)
+            rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+            res = eng.run()
+            outs.append([res[r] for r in rids])
+        assert outs[0] == outs[1] == outs[2], (
+            "speculative sampling depended on the schedule")
+
+    def test_one_executable_with_spec_and_int8(self, model):
+        # both prongs on: verify rows reuse the fixed-budget geometry,
+        # so steady-state steps stay pure exec-cache hits
+        rng = np.random.RandomState(17)
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, num_blocks=32, block_size=16,
+            temperature=0.7, seed=3, kv_dtype="int8", speculative_k=4)
+        for n in (5, 9, 7, 3):
+            eng.add_request(rng.randint(0, 128, n).tolist(),
+                            max_new_tokens=6)
+        eng.step()
+        eng.step()
+        compiles0 = _metric("jit.compiles")
+        eng.run()
+        assert _metric("jit.compiles") == compiles0, (
+            "spec/int8 steady-state steps recompiled")
+
+    def test_spec_metrics_flow(self, model):
+        # a highly repetitive prompt: the n-gram proposer must land
+        # accepts, and the serving.spec.* counters must move
+        prop0 = _metric("serving.spec.proposed")
+        acc0 = _metric("serving.spec.accepted")
+        rows0 = _metric("serving.spec.verify_rows")
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, num_blocks=64, block_size=16,
+            temperature=0.0, speculative_k=4)
+        rid = eng.add_request([7, 8, 9] * 6, max_new_tokens=16)
+        base = ContinuousBatchingEngine(
+            model, max_batch=1, num_blocks=64, block_size=16,
+            temperature=0.0)
+        bid = base.add_request([7, 8, 9] * 6, max_new_tokens=16)
+        assert eng.run()[rid] == base.run()[bid]
+        assert _metric("serving.spec.proposed") > prop0
+        assert _metric("serving.spec.verify_rows") > rows0
+        assert _metric("serving.spec.accepted") >= acc0
+        # fewer steps than tokens iff any draft was accepted; at worst
+        # equal (verify rows always emit their one guaranteed token)
+        assert eng.steps <= base.steps
+
+    def test_gang_engine_records_spec_fallback(self, model):
+        import paddle_tpu as paddle
+        fb0 = _metric("serving.spec.fallback")
+        saved = paddle.get_flags(["FLAGS_speculative_k"])
+        paddle.set_flags({"FLAGS_speculative_k": 4})
+        try:
+            GangScheduledEngine(model, max_batch=2, num_blocks=32,
+                                block_size=16, temperature=0.0)
+        finally:
+            paddle.set_flags(saved)
+        assert _metric("serving.spec.fallback") == fb0 + 1

@@ -19,6 +19,7 @@ import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.observability import flight_recorder as fr
 from paddle_tpu.ops.dispatcher import call_op
+from paddle_tpu.ops.kernels.pallas import quant_common
 from paddle_tpu.ops.kernels.pallas import ragged_paged_attention as rpa
 from paddle_tpu.ops.kernels.pallas import tp_attention as tpa
 from paddle_tpu.ops.kernels.serving import _ragged_composite
@@ -64,6 +65,17 @@ def _layout(rng, qlens, ctxs, T, bs=16, nb=32, mb=6, kv=2, h=4, d=32,
     vp = jnp.asarray(rng.randn(nb, bs, kv, d), dtype)
     return (q, kp, vp, jnp.asarray(tbl),
             jnp.asarray(ctxs, jnp.int32), jnp.asarray(cu))
+
+
+def _quantize_pools(kp, vp):
+    """Per-token-slot per-kv-head symmetric int8, as paged_cache_write_q
+    produces: scales [NB, BS, KV] f32 riding the block table."""
+    from paddle_tpu.ops.kernels.pallas import quant_common
+    ks = quant_common.absmax_scale(kp, axis=-1)
+    vs = quant_common.absmax_scale(vp, axis=-1)
+    kq = quant_common.quantize_symmetric(kp, ks[..., None])
+    vq = quant_common.quantize_symmetric(vp, vs[..., None])
+    return kq, vq, ks, vs
 
 
 def _reference(q, kp, vp, tbl, ctx, cu, bs):
@@ -158,6 +170,39 @@ class TestRaggedKernel:
         comp = np.asarray(_ragged_composite(q, kp, vp, tbl, ctx, cu))
         assert np.isfinite(comp).all()
 
+    def test_int8_pallas_equals_dequantized_pools_exactly(self):
+        # dequant inside the VMEM tile load must be numerically
+        # IDENTICAL to pre-dequantizing the pools and running the float
+        # kernel — same values enter the same flash-attention math
+        rng = np.random.RandomState(7)
+        qlens, ctxs, T = [1, 12, 10, 1], [20, 12, 37, 49], 32
+        q, kp, vp, tbl, ctx, cu = _layout(rng, qlens, ctxs, T)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        got = rpa.ragged_paged_attention(q, kq, vq, tbl, ctx, cu,
+                                         k_scale=ks, v_scale=vs)
+        kd = quant_common.dequantize_symmetric(kq, np.asarray(ks)[..., None])
+        vd = quant_common.dequantize_symmetric(vq, np.asarray(vs)[..., None])
+        want = rpa.ragged_paged_attention(q, kd, vd, tbl, ctx, cu)
+        assert got.dtype == q.dtype
+        np.testing.assert_array_equal(np.asarray(got)[:cu[-1]],
+                                      np.asarray(want)[:cu[-1]])
+
+    def test_int8_pallas_matches_composite_and_reference(self):
+        rng = np.random.RandomState(8)
+        qlens, ctxs, T = [8, 1, 1, 16], [8, 30, 1, 16], 32
+        q, kp, vp, tbl, ctx, cu = _layout(rng, qlens, ctxs, T)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        got = np.asarray(rpa.ragged_paged_attention(
+            q, kq, vq, tbl, ctx, cu, k_scale=ks, v_scale=vs))
+        comp = np.asarray(_ragged_composite(
+            q, kq, vq, tbl, ctx, cu, k_scale=ks, v_scale=vs))
+        np.testing.assert_allclose(got[:cu[-1]], comp[:cu[-1]],
+                                   atol=2e-5, rtol=2e-5)
+        # and both sit inside the int8 quantization band of the float ref
+        ref = _reference(q, kp, vp, tbl, ctx, cu, bs=16)
+        np.testing.assert_allclose(got[:cu[-1]], ref[:cu[-1]],
+                                   atol=5e-2, rtol=5e-2)
+
     def test_op_dispatch_routes_pallas_and_composite(self):
         rng = np.random.RandomState(6)
         qlens, ctxs = [1, 12], [17, 12]
@@ -193,6 +238,24 @@ class TestShardedRagged:
                                    np.asarray(ref)[:cu[-1]],
                                    atol=2e-5, rtol=2e-5)
         # heads really ride the mp axis
+        assert out.sharding.spec[1] == "mp"
+
+    def test_int8_sharded_matches_unsharded_quantized(self):
+        # scale tiles shard with the pool's kv-head axis: the sharded
+        # quantized build must agree with the unsharded quantized kernel
+        rng = np.random.RandomState(12)
+        qlens, ctxs = [1, 12, 10, 1], [20, 12, 37, 49]
+        q, kp, vp, tbl, ctx, cu = _layout(rng, qlens, ctxs, 32, kv=4, h=8)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        mesh = jax.make_mesh((4,), ("mp",))
+        out = tpa.sharded_ragged_paged_attention(
+            q, kq, vq, tbl, ctx, cu, mesh, "mp", k_scale=ks, v_scale=vs)
+        assert out is not None
+        ref = rpa.ragged_paged_attention(q, kq, vq, tbl, ctx, cu,
+                                         k_scale=ks, v_scale=vs)
+        np.testing.assert_allclose(np.asarray(out)[:cu[-1]],
+                                   np.asarray(ref)[:cu[-1]],
+                                   atol=2e-5, rtol=2e-5)
         assert out.sharding.spec[1] == "mp"
 
     def test_op_dispatch_under_tp_context(self):

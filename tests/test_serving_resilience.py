@@ -780,6 +780,64 @@ class TestWarmStart:
                                        block_size=32, temperature=0.0)
         assert load_prefix_cache(eng, str(tmp_path / "w" / "warmcache")) == 0
 
+    def test_kv_dtype_mismatch_refuses_preload_both_ways(self, model,
+                                                         tmp_path):
+        """An int8 snapshot is meaningless without its scales and a
+        float snapshot has none — BOTH directions of storage-regime
+        mismatch must refuse the preload, not serve garbage KV."""
+        prompts = _requests(2, head_blocks=2, rng_seed=3)
+        e1 = ResilientServingEngine(model, str(tmp_path / "wf"),
+                                    **dict(ENG, temperature=0.0))
+        for p in prompts:
+            e1.add_request(p, max_new_tokens=3)
+        e1.run()
+        e1.snapshot()
+        e1.close()
+        q = ContinuousBatchingEngine(model, kv_dtype="int8",
+                                     **dict(ENG, temperature=0.0))
+        assert load_prefix_cache(q, e1.warm_root) == 0
+
+        e2 = ResilientServingEngine(model, str(tmp_path / "wq"),
+                                    kv_dtype="int8",
+                                    **dict(ENG, temperature=0.0))
+        for p in prompts:
+            e2.add_request(p, max_new_tokens=3)
+        e2.run()
+        e2.snapshot()
+        e2.close()
+        f = ContinuousBatchingEngine(model, **dict(ENG, temperature=0.0))
+        assert load_prefix_cache(f, e2.warm_root) == 0
+        # matched regimes DO preload (scales ride the snapshot)
+        q2 = ContinuousBatchingEngine(model, kv_dtype="int8",
+                                      **dict(ENG, temperature=0.0))
+        assert load_prefix_cache(q2, e2.warm_root) > 0
+
+    def test_int8_warm_preload_identical_output(self, model, tmp_path):
+        """Warm int8 blocks must replay their per-token-slot scales too:
+        a warm-started quantized engine attends preloaded blocks through
+        the dequant path and must emit the same tokens as a cold one."""
+        prompts = _requests(3, head_blocks=3, rng_seed=3)
+        kw = dict(ENG, temperature=0.0, kv_dtype="int8")
+        e1 = ResilientServingEngine(model, str(tmp_path / "wq8"), **kw)
+        for p in prompts:
+            e1.add_request(p, max_new_tokens=4)
+        e1.run()
+        assert e1.snapshot() is not None
+        e1.close()
+
+        hit0 = _counter("serving.prefix_cache.hit_blocks")
+        e2 = ResilientServingEngine(model, str(tmp_path / "wq8"), **kw)
+        assert e2.warm_blocks >= 3
+        probe = prompts[0][:48] + [1, 2, 3]
+        rid = e2.add_request(probe, max_new_tokens=4)
+        e2.run()
+        assert _counter("serving.prefix_cache.hit_blocks") >= hit0 + 3
+        cold = _reference(model, tmp_path, [probe], max_new=4,
+                          name="wq8cold", temperature=0.0,
+                          kv_dtype="int8")
+        assert e2.outputs[rid] == cold[0]
+        e2.close()
+
     def test_prune_spares_fresh_uncommitted_dirs(self, model, tmp_path):
         """An uncommitted gen dir younger than the grace window may be a
         concurrent incarnation's snapshot mid-write — pruning it under
@@ -1090,13 +1148,15 @@ def _assert_journal_loadable(root):
 @pytest.mark.heavy
 class TestServingChaos:
     def _spawn(self, tmp_path, attempt, root="serve", sleep="0.08",
-               deadline="20", add=None):
+               deadline="20", add=None, extra_env=None):
         env = dict(os.environ,
                    SERVE_STEP_SLEEP=sleep,
                    SERVE_DRAIN_DEADLINE=deadline,
                    PYTHONPATH=os.path.dirname(os.path.dirname(_WORKER)))
         if add is not None:
             env["SERVE_ADD"] = add
+        if extra_env:
+            env.update(extra_env)
         (tmp_path / "out").mkdir(exist_ok=True)
         return subprocess.Popen(
             [sys.executable, _WORKER, str(tmp_path / "out"),
@@ -1123,9 +1183,9 @@ class TestServingChaos:
         with open(tmp_path / "out" / f"result_a{attempt}.json") as f:
             return json.load(f)
 
-    def _reference_outputs(self, tmp_path):
+    def _reference_outputs(self, tmp_path, extra_env=None):
         p = self._spawn(tmp_path, attempt=9, root="refserve", sleep="0.0",
-                        add="1")
+                        add="1", extra_env=extra_env)
         assert p.wait(timeout=240) == 0
         return self._result(tmp_path, 9)["outputs"]
 
@@ -1176,6 +1236,31 @@ class TestServingChaos:
         assert p.wait(timeout=240) == 0
         res = self._result(tmp_path, 1)
         assert res["warm_blocks"] > 0         # relaunch started warm
+        assert res["outputs"] == ref
+
+    def test_sigkill_with_spec_and_int8_replays_identically(self,
+                                                            tmp_path):
+        """The ISSUE 20 regime ride: int8 quantized KV pool + K=4
+        speculative verify, SIGKILL mid-stream, relaunch — byte-identical
+        replay must survive accepted/rejected drafts and requantized KV
+        (the reference runs the SAME flags: int8 shifts logits slightly,
+        so only matched regimes compare token-for-token)."""
+        fl = {"FLAGS_kv_cache_dtype": "int8", "FLAGS_speculative_k": "4"}
+        ref = self._reference_outputs(tmp_path, extra_env=fl)
+        p = self._spawn(tmp_path, attempt=0, extra_env=fl)
+        try:
+            self._wait_generated(tmp_path, 0, 12)
+            os.kill(p.pid, signal.SIGKILL)
+            assert p.wait(timeout=60) == -signal.SIGKILL
+        finally:
+            if p.poll() is None:
+                p.kill()
+        st = _assert_journal_loadable(str(tmp_path / "serve"))
+        assert st.unfinished, "kill landed after completion — tune sleep"
+        p = self._spawn(tmp_path, attempt=1, extra_env=fl)
+        assert p.wait(timeout=240) == 0
+        res = self._result(tmp_path, 1)
+        assert res["replayed"] >= 1
         assert res["outputs"] == ref
 
     def test_no_torn_journal_kill_sweep(self, tmp_path):
